@@ -1,0 +1,27 @@
+"""kafka_tpu — a TPU-native LLM agent-serving framework.
+
+A from-scratch rebuild of the capability surface of
+`egrigokhan/kafka-llm-service` (OpenAI-compatible threaded agent serving)
+with the remote LLM gateway replaced by an in-tree JAX/XLA inference engine:
+tensor-parallel Llama via jit+shard_map, Pallas TPU kernels, paged KV-cache
+keyed by thread_id, and continuous batching across threads.
+
+Layout:
+    core/         wire types, sanitization, tool-call accumulation
+    models/       Llama model family in functional JAX + HF loaders
+    ops/          attention/sampling/rope/norm ops (+ Pallas TPU kernels)
+    parallel/     mesh & sharding rules, TP/SP, ring-attention CP
+    runtime/      paged KV cache, continuous-batching scheduler, engine
+    llm/          LLMProvider ABC, TPUProvider, context compaction
+    agents/       tool-calling agent loop
+    tools/        tool providers (local / sandbox / MCP)
+    prompts/      section-composed system prompts
+    sandbox/      sandbox runtime (local HTTP sandboxes, manager, lazy)
+    db/           thread persistence (SQLite; Supabase-compatible duck type)
+    kafka/        orchestrator wiring it all together
+    server/       aiohttp API server + SSE protocol
+    server_tools/ built-in tools (weather, counter, shell, notebook, planner)
+    utils/        config, logging, metrics
+"""
+
+__version__ = "0.1.0"

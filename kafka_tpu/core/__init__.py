@@ -1,0 +1,43 @@
+"""Pure-Python conversation core: types, wire schemas, sanitization."""
+
+from .types import (
+    CompletionResponse,
+    ContextLengthError,
+    LLMProviderError,
+    Message,
+    Role,
+    StreamChunk,
+    Usage,
+    new_completion_id,
+    new_tool_call_id,
+)
+from .sanitize import (
+    convert_to_internal_message,
+    dicts_to_messages,
+    find_safe_split_point,
+    messages_to_dict_list,
+    sanitize_messages_for_openai,
+    validate_message_structure,
+)
+from .toolcalls import ToolCallAccumulator, make_tool_call, parse_tool_arguments
+
+__all__ = [
+    "CompletionResponse",
+    "ContextLengthError",
+    "LLMProviderError",
+    "Message",
+    "Role",
+    "StreamChunk",
+    "Usage",
+    "new_completion_id",
+    "new_tool_call_id",
+    "convert_to_internal_message",
+    "dicts_to_messages",
+    "find_safe_split_point",
+    "messages_to_dict_list",
+    "sanitize_messages_for_openai",
+    "validate_message_structure",
+    "ToolCallAccumulator",
+    "make_tool_call",
+    "parse_tool_arguments",
+]

@@ -1,0 +1,143 @@
+"""Message sanitization for OpenAI-wire compatibility.
+
+Behavior parity with the reference's sanitizer (src/kafka/utils.py:25-61)
+and structural validator (src/llm/context_compaction/base.py:115-168):
+
+* every `tool` message must answer a tool_call in the *most recent*
+  assistant-with-tool_calls message; orphans are dropped;
+* a tool_call_id may be consumed at most once;
+* any non-tool message that is not an assistant-with-tool_calls resets the
+  window of valid ids;
+* empty assistant messages (no content, no tool_calls) are dropped by the
+  structural validator.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Optional
+
+from .types import Message
+
+logger = logging.getLogger("kafka_tpu.core.sanitize")
+
+
+def convert_to_internal_message(chat_msg: Any) -> Message:
+    """Convert an OpenAI-format message (pydantic model or dict) to Message."""
+    if isinstance(chat_msg, dict):
+        return Message.from_dict(chat_msg)
+    return Message(
+        role=chat_msg.role,
+        content=chat_msg.content,
+        name=getattr(chat_msg, "name", None),
+        tool_calls=getattr(chat_msg, "tool_calls", None),
+        tool_call_id=getattr(chat_msg, "tool_call_id", None),
+    )
+
+
+def sanitize_messages_for_openai(messages: List[Message]) -> List[Message]:
+    """Drop tool messages that don't answer a live tool_call.
+
+    Scans forward keeping a window of tool_call_ids opened by the latest
+    assistant-with-tool_calls message; each id may be used once.
+    """
+    if not messages:
+        return messages
+
+    sanitized: List[Message] = []
+    open_ids: set = set()
+
+    for msg in messages:
+        if msg.role == "assistant" and msg.tool_calls:
+            open_ids = {tc.get("id") for tc in msg.tool_calls if tc.get("id")}
+            sanitized.append(msg)
+        elif msg.role == "tool":
+            if msg.tool_call_id and msg.tool_call_id in open_ids:
+                open_ids.discard(msg.tool_call_id)
+                sanitized.append(msg)
+            else:
+                logger.warning(
+                    "skipping orphan tool message (tool_call_id=%s name=%s)",
+                    msg.tool_call_id,
+                    msg.name,
+                )
+        else:
+            open_ids = set()
+            sanitized.append(msg)
+
+    return sanitized
+
+
+def validate_message_structure(
+    messages: List[Dict[str, Any]],
+    logger_: Optional[logging.Logger] = None,
+) -> List[Dict[str, Any]]:
+    """Validate/fix a dict-form message list after compaction surgery.
+
+    Unlike the forward-scanning sanitizer above, this collects tool_call_ids
+    from *all* assistant messages first (compaction may have reordered
+    context), then drops orphan tool results and empty assistant messages.
+    Parity: src/llm/context_compaction/base.py:115-168.
+    """
+    if not messages:
+        return messages
+    log = logger_ or logger
+
+    valid_ids = {
+        tc["id"]
+        for msg in messages
+        if msg.get("role") == "assistant" and msg.get("tool_calls")
+        for tc in msg["tool_calls"]
+        if tc.get("id")
+    }
+
+    validated: List[Dict[str, Any]] = []
+    for msg in messages:
+        if msg.get("role") == "tool" and msg.get("tool_call_id") not in valid_ids:
+            log.warning("removing orphaned tool result id=%s", msg.get("tool_call_id"))
+            continue
+        if (
+            msg.get("role") == "assistant"
+            and not msg.get("content")
+            and not msg.get("tool_calls")
+        ):
+            log.warning("removing empty assistant message")
+            continue
+        validated.append(msg)
+    return validated
+
+
+def find_safe_split_point(messages: List[Dict[str, Any]], target_split: int) -> int:
+    """Largest index <= target_split that does not sever a tool exchange.
+
+    A split is unsafe if it separates an assistant-with-tool_calls message
+    from the tool results that answer it; in that case walk backwards until
+    the boundary no longer cuts through a tool sequence.
+    Parity: src/llm/context_compaction/base.py:68-112.
+    """
+    if target_split <= 0:
+        return 0
+    n = len(messages)
+    if target_split >= n:
+        return n
+
+    split = target_split
+    while split > 0:
+        prev = messages[split - 1]
+        nxt = messages[split] if split < n else None
+        if prev.get("role") == "assistant" and prev.get("tool_calls"):
+            split -= 1
+            continue
+        if nxt is not None and nxt.get("role") == "tool":
+            split -= 1
+            continue
+        break
+    return split
+
+
+def messages_to_dict_list(messages: List[Message]) -> List[Dict[str, Any]]:
+    return [m.to_dict() for m in messages]
+
+
+def dicts_to_messages(dicts: List[Dict[str, Any]]) -> List[Message]:
+    return [Message.from_dict(d) for d in dicts]

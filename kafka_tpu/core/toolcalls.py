@@ -1,0 +1,86 @@
+"""Tool-call delta accumulation and argument parsing.
+
+OpenAI streaming emits tool calls as per-index deltas: the first delta for an
+index carries id/name, later deltas append fragments to
+`function.arguments`.  The accumulator reassembles them in index order.
+Behavior parity: reference src/agents/base.py:285-331 (inline accumulation
+inside the agent loop) — factored out here so the engine, agent loop, and
+server can all share it.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+
+class ToolCallAccumulator:
+    """Reassembles streamed tool-call deltas into complete tool calls."""
+
+    def __init__(self) -> None:
+        self._by_index: Dict[int, Dict[str, Any]] = {}
+
+    def add_delta(self, delta: Dict[str, Any]) -> None:
+        """Merge one tool-call delta (an element of `delta.tool_calls`)."""
+        index = delta.get("index", 0)
+        slot = self._by_index.setdefault(
+            index,
+            {"id": None, "type": "function", "function": {"name": "", "arguments": ""}},
+        )
+        if delta.get("id"):
+            slot["id"] = delta["id"]
+        if delta.get("type"):
+            slot["type"] = delta["type"]
+        fn = delta.get("function") or {}
+        if fn.get("name"):
+            slot["function"]["name"] = fn["name"]
+        if fn.get("arguments"):
+            slot["function"]["arguments"] += fn["arguments"]
+
+    def add_deltas(self, deltas: Optional[List[Dict[str, Any]]]) -> None:
+        for d in deltas or []:
+            self.add_delta(d)
+
+    @property
+    def has_calls(self) -> bool:
+        return bool(self._by_index)
+
+    def result(self) -> List[Dict[str, Any]]:
+        """Completed tool calls in index order (OpenAI wire shape)."""
+        return [self._by_index[i] for i in sorted(self._by_index)]
+
+    def clear(self) -> None:
+        self._by_index.clear()
+
+
+def parse_tool_arguments(tool_call: Dict[str, Any]) -> Dict[str, Any]:
+    """Parse a completed tool call's JSON arguments.
+
+    Empty/whitespace arguments -> {}.  Malformed JSON -> {"_raw": raw} so the
+    unparseable text is preserved for error reporting rather than dropped.
+    Non-dict JSON (e.g. a bare list) -> {"_value": parsed}.
+    """
+    raw = (tool_call.get("function") or {}).get("arguments") or ""
+    if not raw.strip():
+        return {}
+    try:
+        parsed = json.loads(raw)
+    except json.JSONDecodeError:
+        return {"_raw": raw}
+    return parsed if isinstance(parsed, dict) else {"_value": parsed}
+
+
+def make_tool_call(
+    call_id: str, name: str, arguments: Any, index: Optional[int] = None
+) -> Dict[str, Any]:
+    """Build a complete OpenAI-wire tool call dict."""
+    if not isinstance(arguments, str):
+        arguments = json.dumps(arguments)
+    tc: Dict[str, Any] = {
+        "id": call_id,
+        "type": "function",
+        "function": {"name": name, "arguments": arguments},
+    }
+    if index is not None:
+        tc["index"] = index
+    return tc

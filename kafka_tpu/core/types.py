@@ -1,0 +1,247 @@
+"""Core conversation types shared across the whole framework.
+
+These are the wire-level primitives every layer speaks: messages in OpenAI
+chat format, incremental stream chunks, and full completion responses.
+
+Capability parity with the reference service's LLM type layer
+(reference: src/llm/types.py:29-185), but implemented as slotted dataclasses
+rather than pydantic models: these objects are created per-token on the
+decode hot path of the TPU engine, where pydantic validation overhead is
+measurable.  Pydantic is reserved for the HTTP boundary (core/wire.py).
+"""
+
+from __future__ import annotations
+
+import enum
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+class Role(str, enum.Enum):
+    """Message roles following the OpenAI convention."""
+
+    SYSTEM = "system"
+    USER = "user"
+    ASSISTANT = "assistant"
+    TOOL = "tool"
+
+
+# content may be a plain string or OpenAI multi-part content
+# (list of {"type": "text"|"image_url", ...} parts).
+Content = Any
+
+
+@dataclass(slots=True)
+class Message:
+    """A single conversation message in OpenAI chat format.
+
+    Parity: reference src/llm/types.py:29 (Message).
+    """
+
+    role: str
+    content: Optional[Content] = None
+    name: Optional[str] = None
+    tool_calls: Optional[List[Dict[str, Any]]] = None
+    tool_call_id: Optional[str] = None
+    # Opaque provider metadata carried through unmodified (the analog of the
+    # reference's Gemini `thought_signature` passthrough, portkey.py:381-417).
+    metadata: Optional[Dict[str, Any]] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        """OpenAI-wire dict, omitting None fields (APIs reject nulls)."""
+        d: Dict[str, Any] = {"role": self.role}
+        if self.content is not None:
+            d["content"] = self.content
+        if self.name is not None:
+            d["name"] = self.name
+        if self.tool_calls is not None:
+            d["tool_calls"] = self.tool_calls
+        if self.tool_call_id is not None:
+            d["tool_call_id"] = self.tool_call_id
+        if self.metadata is not None:
+            d["metadata"] = self.metadata
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Message":
+        return cls(
+            role=d["role"],
+            content=d.get("content"),
+            name=d.get("name"),
+            tool_calls=d.get("tool_calls"),
+            tool_call_id=d.get("tool_call_id"),
+            metadata=d.get("metadata"),
+        )
+
+    def text(self) -> str:
+        """Flatten content to plain text (joins multi-part text segments)."""
+        if self.content is None:
+            return ""
+        if isinstance(self.content, str):
+            return self.content
+        parts = []
+        for part in self.content:
+            if isinstance(part, dict) and part.get("type") == "text":
+                parts.append(part.get("text", ""))
+        return "".join(parts)
+
+
+@dataclass(slots=True)
+class StreamChunk:
+    """One incremental piece of a streaming completion.
+
+    Parity: reference src/llm/types.py:71 (StreamChunk).
+    finish_reason: None until final; then "stop" | "length" | "tool_calls".
+    """
+
+    content: Optional[str] = None
+    role: Optional[str] = None
+    tool_calls: Optional[List[Dict[str, Any]]] = None
+    finish_reason: Optional[str] = None
+    model: Optional[str] = None
+    id: Optional[str] = None
+    # TPU-engine extras (absent in the reference, which proxied a remote API):
+    token_ids: Optional[List[int]] = None
+    usage: Optional[Dict[str, int]] = None
+
+    @property
+    def delta(self) -> str:
+        return self.content or ""
+
+    @property
+    def is_final(self) -> bool:
+        return self.finish_reason is not None
+
+    def to_openai_dict(self, created: Optional[int] = None) -> Dict[str, Any]:
+        """Render as an OpenAI chat.completion.chunk wire object."""
+        delta: Dict[str, Any] = {}
+        if self.role is not None:
+            delta["role"] = self.role
+        if self.content is not None:
+            delta["content"] = self.content
+        if self.tool_calls is not None:
+            delta["tool_calls"] = self.tool_calls
+        out: Dict[str, Any] = {
+            "id": self.id or new_completion_id(),
+            "object": "chat.completion.chunk",
+            "created": created if created is not None else int(time.time()),
+            "model": self.model or "",
+            "choices": [
+                {"index": 0, "delta": delta, "finish_reason": self.finish_reason}
+            ],
+        }
+        if self.usage is not None:
+            out["usage"] = self.usage
+        return out
+
+
+@dataclass(slots=True)
+class Usage:
+    """Token accounting. The TPU engine reports real counts (the reference
+    returned zeroed usage on the agent path, src/kafka/types.py:93-97)."""
+
+    prompt_tokens: int = 0
+    completion_tokens: int = 0
+    total_tokens: int = 0
+    # Engine extras
+    cached_prompt_tokens: int = 0
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {
+            "prompt_tokens": self.prompt_tokens,
+            "completion_tokens": self.completion_tokens,
+            "total_tokens": self.total_tokens,
+        }
+        if self.cached_prompt_tokens:
+            d["prompt_tokens_details"] = {"cached_tokens": self.cached_prompt_tokens}
+        return d
+
+
+@dataclass(slots=True)
+class CompletionResponse:
+    """Full non-streaming completion result.
+
+    Parity: reference src/llm/types.py:113 (CompletionResponse).
+    """
+
+    content: Optional[str] = None
+    role: str = "assistant"
+    finish_reason: Optional[str] = None
+    model: Optional[str] = None
+    id: Optional[str] = None
+    usage: Optional[Dict[str, int]] = None
+    tool_calls: Optional[List[Dict[str, Any]]] = None
+
+    def to_message(self) -> Message:
+        return Message(role=self.role, content=self.content, tool_calls=self.tool_calls)
+
+    def to_openai_dict(self, created: Optional[int] = None) -> Dict[str, Any]:
+        msg: Dict[str, Any] = {"role": self.role, "content": self.content}
+        if self.tool_calls:
+            msg["tool_calls"] = self.tool_calls
+        return {
+            "id": self.id or new_completion_id(),
+            "object": "chat.completion",
+            "created": created if created is not None else int(time.time()),
+            "model": self.model or "",
+            "choices": [
+                {"index": 0, "message": msg, "finish_reason": self.finish_reason or "stop"}
+            ],
+            "usage": self.usage or Usage().to_dict(),
+        }
+
+
+class LLMProviderError(Exception):
+    """Base error for LLM providers (parity: src/llm/types.py:160)."""
+
+    def __init__(
+        self,
+        message: str,
+        status_code: Optional[int] = None,
+        provider: Optional[str] = None,
+        original_error: Optional[Exception] = None,
+    ):
+        super().__init__(message)
+        self.message = message
+        self.status_code = status_code
+        self.provider = provider
+        self.original_error = original_error
+
+    def __str__(self) -> str:
+        parts = [self.message]
+        if self.provider:
+            parts.insert(0, f"[{self.provider}]")
+        if self.status_code:
+            parts.append(f"(status: {self.status_code})")
+        return " ".join(parts)
+
+
+class ContextLengthError(LLMProviderError):
+    """Raised by the TPU engine when a prompt exceeds the model context.
+
+    The reference could only detect this *after* a remote API rejected the
+    request, by string-matching error text (context_compaction/base.py:10-65).
+    The local engine counts tokens itself and raises this typed error
+    pre-flight; the string form stays compatible with the reference's
+    classifier patterns so both detection paths work.
+    """
+
+    def __init__(self, prompt_tokens: int, max_context: int, provider: str = "tpu"):
+        super().__init__(
+            f"prompt is too long: {prompt_tokens} tokens > {max_context} maximum "
+            f"(context_length_exceeded)",
+            status_code=400,
+            provider=provider,
+        )
+        self.prompt_tokens = prompt_tokens
+        self.max_context = max_context
+
+
+def new_completion_id() -> str:
+    return f"chatcmpl-{uuid.uuid4().hex[:24]}"
+
+
+def new_tool_call_id() -> str:
+    return f"call_{uuid.uuid4().hex[:24]}"

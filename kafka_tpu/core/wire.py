@@ -1,0 +1,114 @@
+"""OpenAI-compatible HTTP wire schemas (pydantic).
+
+These live only at the HTTP boundary; internal layers use the dataclasses in
+core/types.py.  Capability parity: reference src/kafka/types.py:13-107, plus
+engine-specific extensions (seed, tools, response_format, logprobs) the
+reference forwarded blindly to its remote gateway but the local TPU engine
+implements itself.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Union
+
+from pydantic import BaseModel, Field
+
+
+class ChatMessage(BaseModel):
+    """OpenAI-compatible message in requests."""
+
+    role: str
+    content: Optional[Union[str, List[Dict[str, Any]]]] = None
+    name: Optional[str] = None
+    tool_calls: Optional[List[Dict[str, Any]]] = None
+    tool_call_id: Optional[str] = None
+
+
+class ChatCompletionRequest(BaseModel):
+    """OpenAI-compatible chat completion request.
+
+    On the thread endpoints, `messages` carries only the NEW message(s); the
+    server prepends the stored thread history.
+    """
+
+    model: str = Field(..., description="Model ID to use")
+    messages: List[ChatMessage]
+    temperature: Optional[float] = Field(None, ge=0, le=2)
+    max_tokens: Optional[int] = Field(None, gt=0)
+    stream: Optional[bool] = False
+    stop: Optional[Union[str, List[str]]] = None
+    top_p: Optional[float] = Field(None, ge=0, le=1)
+    top_k: Optional[int] = Field(None, ge=0)
+    frequency_penalty: Optional[float] = Field(None, ge=-2, le=2)
+    presence_penalty: Optional[float] = Field(None, ge=-2, le=2)
+    seed: Optional[int] = None
+    user: Optional[str] = None
+    tools: Optional[List[Dict[str, Any]]] = None
+    tool_choice: Optional[Union[str, Dict[str, Any]]] = None
+    response_format: Optional[Dict[str, Any]] = None
+    logprobs: Optional[bool] = None
+    top_logprobs: Optional[int] = Field(None, ge=0, le=20)
+    stream_options: Optional[Dict[str, Any]] = None
+
+
+class AgentRunRequest(BaseModel):
+    """Request body for the agent-run endpoints."""
+
+    messages: List[ChatMessage]
+    model: str = "llama-3.2-1b"
+    temperature: float = 0.7
+    max_tokens: Optional[int] = None
+
+
+class CreateThreadRequest(BaseModel):
+    system_message: Optional[str] = None
+    user_id: Optional[str] = None
+    kafka_profile_id: Optional[str] = None
+    metadata: Optional[Dict[str, Any]] = None
+
+
+class DeltaContent(BaseModel):
+    role: Optional[str] = None
+    content: Optional[str] = None
+    tool_calls: Optional[List[Dict[str, Any]]] = None
+
+
+class StreamChoice(BaseModel):
+    index: int = 0
+    delta: DeltaContent
+    finish_reason: Optional[str] = None
+
+
+class StreamChunkResponse(BaseModel):
+    id: str
+    object: str = "chat.completion.chunk"
+    created: int
+    model: str
+    choices: List[StreamChoice]
+
+
+class MessageContent(BaseModel):
+    role: str = "assistant"
+    content: Optional[str] = None
+    tool_calls: Optional[List[Dict[str, Any]]] = None
+
+
+class Choice(BaseModel):
+    index: int = 0
+    message: MessageContent
+    finish_reason: Optional[str] = None
+
+
+class UsageModel(BaseModel):
+    prompt_tokens: int = 0
+    completion_tokens: int = 0
+    total_tokens: int = 0
+
+
+class ChatCompletionResponse(BaseModel):
+    id: str
+    object: str = "chat.completion"
+    created: int
+    model: str
+    choices: List[Choice]
+    usage: Optional[UsageModel] = None

@@ -1,0 +1,16 @@
+"""Test configuration.
+
+Forces JAX onto CPU with 8 virtual devices so sharding/mesh tests exercise
+real 8-way SPMD partitioning without TPU hardware (the standard JAX recipe:
+--xla_force_host_platform_device_count).  Must run before jax imports.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("JAX_ENABLE_X64", "0")

@@ -1,0 +1,193 @@
+"""Unit tests for the pure conversation core (kafka_tpu.core)."""
+
+from kafka_tpu.core import (
+    CompletionResponse,
+    ContextLengthError,
+    Message,
+    StreamChunk,
+    ToolCallAccumulator,
+    Usage,
+    find_safe_split_point,
+    make_tool_call,
+    new_completion_id,
+    parse_tool_arguments,
+    sanitize_messages_for_openai,
+    validate_message_structure,
+)
+
+
+def tc(id_, name="f", args="{}"):
+    return {"id": id_, "type": "function", "function": {"name": name, "arguments": args}}
+
+
+class TestMessage:
+    def test_to_dict_omits_none(self):
+        m = Message(role="user", content="hi")
+        assert m.to_dict() == {"role": "user", "content": "hi"}
+
+    def test_roundtrip(self):
+        m = Message(role="assistant", content=None, tool_calls=[tc("a")])
+        m2 = Message.from_dict(m.to_dict())
+        assert m2.tool_calls == [tc("a")]
+        assert m2.content is None
+
+    def test_text_flattens_multipart(self):
+        m = Message(
+            role="user",
+            content=[
+                {"type": "text", "text": "a"},
+                {"type": "image_url", "image_url": {"url": "x"}},
+                {"type": "text", "text": "b"},
+            ],
+        )
+        assert m.text() == "ab"
+
+
+class TestStreamChunk:
+    def test_final_and_delta(self):
+        c = StreamChunk(content="hi")
+        assert not c.is_final and c.delta == "hi"
+        assert StreamChunk(finish_reason="stop").is_final
+
+    def test_openai_dict_shape(self):
+        d = StreamChunk(content="x", role="assistant", id="chatcmpl-1", model="m").to_openai_dict(created=5)
+        assert d["object"] == "chat.completion.chunk"
+        assert d["choices"][0]["delta"] == {"role": "assistant", "content": "x"}
+        assert d["created"] == 5
+
+
+class TestCompletionResponse:
+    def test_to_message(self):
+        r = CompletionResponse(content="ok", tool_calls=[tc("a")])
+        m = r.to_message()
+        assert m.role == "assistant" and m.content == "ok" and m.tool_calls
+
+    def test_openai_dict(self):
+        d = CompletionResponse(content="ok", finish_reason="stop", usage=Usage(1, 2, 3).to_dict()).to_openai_dict()
+        assert d["choices"][0]["message"]["content"] == "ok"
+        assert d["usage"]["total_tokens"] == 3
+
+
+class TestSanitize:
+    def test_orphan_tool_dropped(self):
+        msgs = [
+            Message(role="user", content="q"),
+            Message(role="tool", content="r", tool_call_id="nope"),
+        ]
+        out = sanitize_messages_for_openai(msgs)
+        assert [m.role for m in out] == ["user"]
+
+    def test_valid_pair_kept(self):
+        msgs = [
+            Message(role="assistant", tool_calls=[tc("a")]),
+            Message(role="tool", content="r", tool_call_id="a"),
+        ]
+        assert len(sanitize_messages_for_openai(msgs)) == 2
+
+    def test_id_consumed_once(self):
+        msgs = [
+            Message(role="assistant", tool_calls=[tc("a")]),
+            Message(role="tool", content="r1", tool_call_id="a"),
+            Message(role="tool", content="r2", tool_call_id="a"),
+        ]
+        out = sanitize_messages_for_openai(msgs)
+        assert len(out) == 2
+
+    def test_window_reset_by_user(self):
+        msgs = [
+            Message(role="assistant", tool_calls=[tc("a")]),
+            Message(role="user", content="interject"),
+            Message(role="tool", content="r", tool_call_id="a"),
+        ]
+        out = sanitize_messages_for_openai(msgs)
+        assert [m.role for m in out] == ["assistant", "user"]
+
+    def test_empty_list(self):
+        assert sanitize_messages_for_openai([]) == []
+
+
+class TestValidateStructure:
+    def test_drops_orphans_and_empty_assistant(self):
+        msgs = [
+            {"role": "system", "content": "s"},
+            {"role": "assistant", "content": None},
+            {"role": "tool", "content": "r", "tool_call_id": "zzz"},
+            {"role": "assistant", "tool_calls": [tc("a")]},
+            {"role": "tool", "content": "r", "tool_call_id": "a"},
+        ]
+        out = validate_message_structure(msgs)
+        assert [m["role"] for m in out] == ["system", "assistant", "tool"]
+
+    def test_tool_after_later_assistant_kept(self):
+        # Global-id semantics: any assistant tool_call id in the list validates.
+        msgs = [
+            {"role": "tool", "content": "r", "tool_call_id": "a"},
+            {"role": "assistant", "tool_calls": [tc("a")]},
+        ]
+        assert len(validate_message_structure(msgs)) == 2
+
+
+class TestSafeSplit:
+    def test_bounds(self):
+        msgs = [{"role": "user", "content": "x"}] * 4
+        assert find_safe_split_point(msgs, 0) == 0
+        assert find_safe_split_point(msgs, -1) == 0
+        assert find_safe_split_point(msgs, 99) == 4
+        assert find_safe_split_point(msgs, 2) == 2
+
+    def test_never_splits_tool_pair(self):
+        msgs = [
+            {"role": "user", "content": "q"},
+            {"role": "assistant", "tool_calls": [tc("a")]},
+            {"role": "tool", "content": "r", "tool_call_id": "a"},
+            {"role": "assistant", "content": "done"},
+        ]
+        # split=2 would separate the assistant tool_call from its result
+        assert find_safe_split_point(msgs, 2) == 1
+        # split=3 lands after the tool result: safe
+        assert find_safe_split_point(msgs, 3) == 3
+
+    def test_walks_back_through_chained_tools(self):
+        msgs = [
+            {"role": "user", "content": "q"},
+            {"role": "assistant", "tool_calls": [tc("a")]},
+            {"role": "tool", "content": "r", "tool_call_id": "a"},
+            {"role": "tool", "content": "r2", "tool_call_id": "a2"},
+        ]
+        assert find_safe_split_point(msgs, 3) == 1
+
+
+class TestToolCallAccumulator:
+    def test_accumulates_fragmented_arguments(self):
+        acc = ToolCallAccumulator()
+        acc.add_delta({"index": 0, "id": "call_1", "function": {"name": "get_weather"}})
+        acc.add_delta({"index": 0, "function": {"arguments": '{"city": "'}})
+        acc.add_delta({"index": 0, "function": {"arguments": 'Paris"}'}})
+        (call,) = acc.result()
+        assert call["id"] == "call_1"
+        assert call["function"]["name"] == "get_weather"
+        assert parse_tool_arguments(call) == {"city": "Paris"}
+
+    def test_multiple_indices_ordered(self):
+        acc = ToolCallAccumulator()
+        acc.add_delta({"index": 1, "id": "b", "function": {"name": "g", "arguments": "{}"}})
+        acc.add_delta({"index": 0, "id": "a", "function": {"name": "f", "arguments": "{}"}})
+        assert [c["id"] for c in acc.result()] == ["a", "b"]
+
+    def test_invalid_json_preserved_raw(self):
+        assert parse_tool_arguments(make_tool_call("x", "f", "{bad"))["_raw"] == "{bad"
+        assert parse_tool_arguments(tc("x", args="")) == {}
+
+
+class TestContextLengthError:
+    def test_string_matches_reference_patterns(self):
+        e = ContextLengthError(10000, 8192)
+        s = str(e).lower()
+        # Must trip both the Anthropic-style and OpenAI-style classifiers.
+        assert "prompt is too long" in s and "tokens" in s
+        assert "context_length_exceeded" in s
+
+
+def test_completion_ids_unique():
+    assert new_completion_id() != new_completion_id()
+    assert new_completion_id().startswith("chatcmpl-")
