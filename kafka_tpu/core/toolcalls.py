@@ -36,6 +36,15 @@ class ToolCallAccumulator:
             slot["function"]["name"] = fn["name"]
         if fn.get("arguments"):
             slot["function"]["arguments"] += fn["arguments"]
+        # Preserve provider-specific extras (e.g. opaque reasoning signatures
+        # a provider needs echoed back on the next turn): unknown keys pass
+        # through last-write-wins at both levels.
+        for k, v in fn.items():
+            if k not in ("name", "arguments"):
+                slot["function"][k] = v
+        for k, v in delta.items():
+            if k not in ("index", "id", "type", "function"):
+                slot[k] = v
 
     def add_deltas(self, deltas: Optional[List[Dict[str, Any]]]) -> None:
         for d in deltas or []:

@@ -115,7 +115,17 @@ class StreamChunk:
         return self.finish_reason is not None
 
     def to_openai_dict(self, created: Optional[int] = None) -> Dict[str, Any]:
-        """Render as an OpenAI chat.completion.chunk wire object."""
+        """Render as an OpenAI chat.completion.chunk wire object.
+
+        `id` must be set: every chunk of one stream must carry the same
+        completion id (clients group chunks by it), so minting one here
+        per-chunk would silently mis-group the stream.
+        """
+        if self.id is None:
+            raise ValueError(
+                "StreamChunk.id must be set before wire rendering; "
+                "mint one per stream with new_completion_id()"
+            )
         delta: Dict[str, Any] = {}
         if self.role is not None:
             delta["role"] = self.role
@@ -124,7 +134,7 @@ class StreamChunk:
         if self.tool_calls is not None:
             delta["tool_calls"] = self.tool_calls
         out: Dict[str, Any] = {
-            "id": self.id or new_completion_id(),
+            "id": self.id,
             "object": "chat.completion.chunk",
             "created": created if created is not None else int(time.time()),
             "model": self.model or "",

@@ -1,0 +1,173 @@
+"""Model configurations for the Llama family served by the TPU engine.
+
+The reference service routed model names to remote providers by string
+heuristics (src/llm/utils.py:11-29); here a model name resolves to a local
+architecture config + checkpoint path instead.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Optional
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    """Architecture hyperparameters (Llama-style decoder-only transformer)."""
+
+    name: str = "tiny"
+    vocab_size: int = 256
+    hidden_size: int = 64
+    intermediate_size: int = 128
+    num_layers: int = 2
+    num_heads: int = 4
+    num_kv_heads: int = 2
+    head_dim: int = 16
+    rope_theta: float = 500000.0
+    rms_norm_eps: float = 1e-5
+    max_context: int = 8192
+    tie_word_embeddings: bool = True
+    dtype: str = "bfloat16"
+    # Llama-3.x rope scaling (NTK-by-parts). None disables.
+    rope_scaling_factor: Optional[float] = None
+    rope_low_freq_factor: float = 1.0
+    rope_high_freq_factor: float = 4.0
+    rope_original_max_position: int = 8192
+
+    @property
+    def q_per_kv(self) -> int:
+        return self.num_heads // self.num_kv_heads
+
+    @property
+    def activation_dtype(self):
+        return jnp.dtype(self.dtype)
+
+    def replace(self, **kw) -> "ModelConfig":
+        return dataclasses.replace(self, **kw)
+
+
+# Registry of named configs. Sizes follow the published Llama architectures;
+# "tiny"/"debug" variants keep tests fast and fit the CPU mesh.
+CONFIGS = {
+    "tiny": ModelConfig(),
+    "tiny-gqa": ModelConfig(name="tiny-gqa", num_heads=8, num_kv_heads=2, hidden_size=128, head_dim=16),
+    "debug-290m": ModelConfig(
+        name="debug-290m",
+        vocab_size=32000,
+        hidden_size=1024,
+        intermediate_size=2816,
+        num_layers=12,
+        num_heads=16,
+        num_kv_heads=4,
+        head_dim=64,
+    ),
+    "llama-3.2-1b": ModelConfig(
+        name="llama-3.2-1b",
+        vocab_size=128256,
+        hidden_size=2048,
+        intermediate_size=8192,
+        num_layers=16,
+        num_heads=32,
+        num_kv_heads=8,
+        head_dim=64,
+        max_context=131072,
+        tie_word_embeddings=True,
+        rope_scaling_factor=32.0,
+    ),
+    "llama-3.2-3b": ModelConfig(
+        name="llama-3.2-3b",
+        vocab_size=128256,
+        hidden_size=3072,
+        intermediate_size=8192,
+        num_layers=28,
+        num_heads=24,
+        num_kv_heads=8,
+        head_dim=128,
+        max_context=131072,
+        tie_word_embeddings=True,
+        rope_scaling_factor=32.0,
+    ),
+    "llama-3-8b": ModelConfig(
+        name="llama-3-8b",
+        vocab_size=128256,
+        hidden_size=4096,
+        intermediate_size=14336,
+        num_layers=32,
+        num_heads=32,
+        num_kv_heads=8,
+        head_dim=128,
+        max_context=8192,
+        tie_word_embeddings=False,
+    ),
+    "llama-3.1-8b": ModelConfig(
+        name="llama-3.1-8b",
+        vocab_size=128256,
+        hidden_size=4096,
+        intermediate_size=14336,
+        num_layers=32,
+        num_heads=32,
+        num_kv_heads=8,
+        head_dim=128,
+        max_context=131072,
+        tie_word_embeddings=False,
+        rope_scaling_factor=8.0,
+    ),
+    "llama-3-70b": ModelConfig(
+        name="llama-3-70b",
+        vocab_size=128256,
+        hidden_size=8192,
+        intermediate_size=28672,
+        num_layers=80,
+        num_heads=64,
+        num_kv_heads=8,
+        head_dim=128,
+        max_context=8192,
+        tie_word_embeddings=False,
+    ),
+}
+
+
+def get_config(name: str) -> ModelConfig:
+    """Resolve a model name (case/sep-insensitive) to a config."""
+    key = name.lower().replace("_", "-").replace("meta-llama/", "")
+    aliases = {
+        "llama-3.2-1b-instruct": "llama-3.2-1b",
+        "llama-3.2-3b-instruct": "llama-3.2-3b",
+        "llama-3-8b-instruct": "llama-3-8b",
+        "llama-3.1-8b-instruct": "llama-3.1-8b",
+        "llama-3-70b-instruct": "llama-3-70b",
+        "meta-llama-3-8b": "llama-3-8b",
+    }
+    key = aliases.get(key, key)
+    if key not in CONFIGS:
+        raise KeyError(f"unknown model {name!r}; known: {sorted(CONFIGS)}")
+    return CONFIGS[key]
+
+
+def config_from_hf_json(path: str) -> ModelConfig:
+    """Build a ModelConfig from a HuggingFace config.json."""
+    with open(path) as f:
+        hf = json.load(f)
+    rs = hf.get("rope_scaling") or {}
+    return ModelConfig(
+        name=os.path.basename(os.path.dirname(os.path.abspath(path))),
+        vocab_size=hf["vocab_size"],
+        hidden_size=hf["hidden_size"],
+        intermediate_size=hf["intermediate_size"],
+        num_layers=hf["num_hidden_layers"],
+        num_heads=hf["num_attention_heads"],
+        num_kv_heads=hf.get("num_key_value_heads", hf["num_attention_heads"]),
+        head_dim=hf.get("head_dim", hf["hidden_size"] // hf["num_attention_heads"]),
+        rope_theta=hf.get("rope_theta", 10000.0),
+        rms_norm_eps=hf.get("rms_norm_eps", 1e-5),
+        max_context=hf.get("max_position_embeddings", 8192),
+        tie_word_embeddings=hf.get("tie_word_embeddings", False),
+        rope_scaling_factor=rs.get("factor"),
+        rope_low_freq_factor=rs.get("low_freq_factor", 1.0),
+        rope_high_freq_factor=rs.get("high_freq_factor", 4.0),
+        rope_original_max_position=rs.get("original_max_position_embeddings", 8192),
+    )

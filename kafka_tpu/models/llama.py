@@ -1,0 +1,192 @@
+"""Llama-family decoder in pure functional JAX.
+
+Design (TPU-first, not a port — the reference has no model code at all; its
+LLM compute lived behind a remote gateway, src/llm/portkey.py):
+
+* **Stacked layer parameters + `lax.scan`** — all L layers' weights are
+  stored as one pytree of [L, ...] arrays and the layer body is scanned.
+  One compiled layer body instead of L inlined copies: fast compiles, and
+  the leading layer axis is exactly what pipeline-parallel stage splitting
+  shards later.
+* **Pure functions** — `init_params`, `forward`. No module framework; the
+  engine jits/shard_maps these directly with explicit sharding rules
+  (parallel/sharding.py maps each param path to mesh axes).
+* **BSHD activations** ([batch, seq, heads, head_dim]) so the "tp" mesh axis
+  lands on heads/hidden and "sp"/"cp" on seq.
+* **bf16 params/activations, f32 norms & attention softmax** — the standard
+  TPU numerics recipe.
+* Attention runs through ops.attention (XLA reference) or the Pallas
+  kernels on TPU; the choice is a config knob threaded by the engine.
+
+The KV cache here is the *contiguous* [L, B, C, Hkv, D] form addressed by
+absolute position == slot index; the paged cache used for serving lives in
+runtime/kv_cache.py and calls the same layer math with its own gather.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..models.config import ModelConfig
+from ..ops.attention import causal_attention
+from ..ops.norms import rms_norm
+from ..ops.rope import apply_rope, rope_cos_sin, rope_frequencies
+
+Params = Dict[str, Any]
+
+
+class KVCache(NamedTuple):
+    """Contiguous per-layer KV cache: k/v are [L, B, C, Hkv, D]."""
+
+    k: jnp.ndarray
+    v: jnp.ndarray
+
+    @property
+    def capacity(self) -> int:
+        return self.k.shape[2]
+
+
+def init_kv_cache(cfg: ModelConfig, batch: int, capacity: int, dtype=None) -> KVCache:
+    dtype = dtype or cfg.activation_dtype
+    shape = (cfg.num_layers, batch, capacity, cfg.num_kv_heads, cfg.head_dim)
+    return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+
+
+def init_params(cfg: ModelConfig, key: jax.Array, dtype=None) -> Params:
+    """Random-init parameters (layer-stacked). Serving loads checkpoints
+    instead; random init exists for tests and micro-benchmarks."""
+    dtype = dtype or cfg.activation_dtype
+    h, f, d = cfg.hidden_size, cfg.intermediate_size, cfg.head_dim
+    hq, hkv, L = cfg.num_heads, cfg.num_kv_heads, cfg.num_layers
+    keys = jax.random.split(key, 9)
+
+    def norm01(k, shape, fan_in):
+        return (jax.random.normal(k, shape, jnp.float32) * (fan_in**-0.5)).astype(dtype)
+
+    params: Params = {
+        "embed": norm01(keys[0], (cfg.vocab_size, h), h),
+        "final_norm": jnp.ones((h,), dtype),
+        "layers": {
+            "ln_attn": jnp.ones((L, h), dtype),
+            "ln_mlp": jnp.ones((L, h), dtype),
+            "wq": norm01(keys[1], (L, h, hq, d), h),
+            "wk": norm01(keys[2], (L, h, hkv, d), h),
+            "wv": norm01(keys[3], (L, h, hkv, d), h),
+            "wo": norm01(keys[4], (L, hq, d, h), hq * d),
+            "wg": norm01(keys[5], (L, h, f), h),
+            "wu": norm01(keys[6], (L, h, f), h),
+            "wd": norm01(keys[7], (L, f, h), f),
+        },
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = norm01(keys[8], (h, cfg.vocab_size), h)
+    return params
+
+
+def _attention_block(
+    x: jnp.ndarray,
+    lp: Params,
+    cfg: ModelConfig,
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+    positions: jnp.ndarray,
+    k_cache: Optional[jnp.ndarray],
+    v_cache: Optional[jnp.ndarray],
+    kv_valid: Optional[jnp.ndarray],
+    cache_positions: Optional[jnp.ndarray],
+) -> Tuple[jnp.ndarray, Optional[jnp.ndarray], Optional[jnp.ndarray]]:
+    """One attention sublayer. x: [B, S, H]. Returns (out, k_cache', v_cache')."""
+    q = jnp.einsum("bsh,hnd->bsnd", x, lp["wq"])
+    k = jnp.einsum("bsh,hnd->bsnd", x, lp["wk"])
+    v = jnp.einsum("bsh,hnd->bsnd", x, lp["wv"])
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+
+    if k_cache is None:
+        out = causal_attention(
+            q, k, v, q_positions=positions, kv_positions=positions
+        )
+    else:
+        # Scatter new k/v rows into cache slots (slot == absolute position
+        # for the contiguous cache; the engine passes explicit slots for
+        # chunked prefill/decode).
+        slots = positions if cache_positions is None else cache_positions
+        b_idx = jnp.arange(x.shape[0])[:, None]
+        k_cache = k_cache.at[b_idx, slots].set(k.astype(k_cache.dtype))
+        v_cache = v_cache.at[b_idx, slots].set(v.astype(v_cache.dtype))
+        cap = k_cache.shape[1]
+        kv_pos = jnp.broadcast_to(jnp.arange(cap)[None, :], (x.shape[0], cap))
+        out = causal_attention(
+            q,
+            k_cache,
+            v_cache,
+            q_positions=positions,
+            kv_positions=kv_pos,
+            kv_valid=kv_valid,
+        )
+    out = jnp.einsum("bsnd,ndh->bsh", out, lp["wo"])
+    return out, k_cache, v_cache
+
+
+def _mlp_block(x: jnp.ndarray, lp: Params) -> jnp.ndarray:
+    """SwiGLU MLP: down( silu(gate(x)) * up(x) )."""
+    g = jnp.einsum("bsh,hf->bsf", x, lp["wg"])
+    u = jnp.einsum("bsh,hf->bsf", x, lp["wu"])
+    return jnp.einsum("bsf,fh->bsh", jax.nn.silu(g) * u, lp["wd"])
+
+
+def forward(
+    params: Params,
+    cfg: ModelConfig,
+    token_ids: jnp.ndarray,
+    positions: jnp.ndarray,
+    kv_cache: Optional[KVCache] = None,
+    kv_valid: Optional[jnp.ndarray] = None,
+    cache_positions: Optional[jnp.ndarray] = None,
+) -> Tuple[jnp.ndarray, Optional[KVCache]]:
+    """Run the decoder.
+
+    token_ids, positions: [B, S] int32.
+    kv_cache: optional KVCache of capacity C; new k/v are written at
+        `cache_positions` (default: `positions`) and attention runs over the
+        whole cache gated by `kv_valid` [B, C].
+    Returns (logits [B, S, vocab] float32, updated cache or None).
+    """
+    x = params["embed"][token_ids].astype(cfg.activation_dtype)
+    inv_freq = rope_frequencies(cfg)
+    cos, sin = rope_cos_sin(positions, inv_freq)
+
+    def layer_body(h, scanned):
+        lp, kc, vc = scanned
+        attn_in = rms_norm(h, lp["ln_attn"], cfg.rms_norm_eps)
+        attn_out, kc, vc = _attention_block(
+            attn_in, lp, cfg, cos, sin, positions, kc, vc, kv_valid, cache_positions
+        )
+        h = h + attn_out
+        mlp_in = rms_norm(h, lp["ln_mlp"], cfg.rms_norm_eps)
+        h = h + _mlp_block(mlp_in, lp)
+        return h, (kc, vc)
+
+    if kv_cache is None:
+        x, _ = jax.lax.scan(
+            lambda h, lp: (layer_body(h, (lp, None, None))[0], None),
+            x,
+            params["layers"],
+        )
+        new_cache = None
+    else:
+        x, (k_new, v_new) = jax.lax.scan(
+            lambda h, s: layer_body(h, s),
+            x,
+            (params["layers"], kv_cache.k, kv_cache.v),
+        )
+        new_cache = KVCache(k=k_new, v=v_new)
+
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
+    logits = jnp.einsum("bsh,hv->bsv", x.astype(jnp.float32), head.astype(jnp.float32))
+    return logits, new_cache

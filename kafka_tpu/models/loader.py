@@ -1,0 +1,143 @@
+"""Checkpoint loading: HuggingFace Llama weights -> layer-stacked JAX pytree.
+
+Sources supported:
+  * a directory of HF `*.safetensors` shards (+ config.json) — the serving
+    path; tensors are memory-mapped and never pass through torch;
+  * an in-memory torch/HF state dict — used by the numerics tests, which
+    build a tiny random `transformers.LlamaForCausalLM` and check our logits
+    against it.
+
+Layout conversion: HF stores projection weights as [out, in] matrices per
+layer; we transpose to [in, out] (einsum-natural, and the orientation that
+shards over a ("tp",) mesh axis without relayout) and stack all layers on a
+leading [L, ...] axis for `lax.scan` (see models/llama.py).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Any, Callable, Dict, Mapping, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from .config import ModelConfig, config_from_hf_json
+
+Params = Dict[str, Any]
+
+
+def _to_numpy(t: Any) -> np.ndarray:
+    """Accept torch tensors or numpy arrays."""
+    if isinstance(t, np.ndarray):
+        return t
+    # torch tensor (avoid importing torch unless needed)
+    if hasattr(t, "detach"):
+        t = t.detach()
+        if t.dtype is not None and "bfloat16" in str(t.dtype):
+            t = t.float()
+        return t.cpu().numpy()
+    return np.asarray(t)
+
+
+def convert_hf_state_dict(
+    state: Mapping[str, Any], cfg: ModelConfig, dtype: Optional[Any] = None
+) -> Params:
+    """Convert an HF Llama state dict to the layer-stacked pytree."""
+    dtype = dtype or cfg.activation_dtype
+    h, d = cfg.hidden_size, cfg.head_dim
+    hq, hkv, L = cfg.num_heads, cfg.num_kv_heads, cfg.num_layers
+
+    def get(name: str) -> np.ndarray:
+        key = name if name in state else f"model.{name}"
+        if key not in state:
+            raise KeyError(f"missing weight {name!r} (tried {key!r})")
+        return _to_numpy(state[key])
+
+    def stack(fmt: str, reshape: Callable[[np.ndarray], np.ndarray]) -> jnp.ndarray:
+        return jnp.asarray(
+            np.stack([reshape(get(fmt.format(i=i))) for i in range(L)]), dtype
+        )
+
+    layers = {
+        "ln_attn": stack("layers.{i}.input_layernorm.weight", lambda w: w),
+        "ln_mlp": stack("layers.{i}.post_attention_layernorm.weight", lambda w: w),
+        "wq": stack(
+            "layers.{i}.self_attn.q_proj.weight", lambda w: w.T.reshape(h, hq, d)
+        ),
+        "wk": stack(
+            "layers.{i}.self_attn.k_proj.weight", lambda w: w.T.reshape(h, hkv, d)
+        ),
+        "wv": stack(
+            "layers.{i}.self_attn.v_proj.weight", lambda w: w.T.reshape(h, hkv, d)
+        ),
+        "wo": stack(
+            "layers.{i}.self_attn.o_proj.weight", lambda w: w.T.reshape(hq, d, h)
+        ),
+        "wg": stack("layers.{i}.mlp.gate_proj.weight", lambda w: w.T),
+        "wu": stack("layers.{i}.mlp.up_proj.weight", lambda w: w.T),
+        "wd": stack("layers.{i}.mlp.down_proj.weight", lambda w: w.T),
+    }
+    params: Params = {
+        "embed": jnp.asarray(get("embed_tokens.weight"), dtype),
+        "final_norm": jnp.asarray(get("norm.weight"), dtype),
+        "layers": layers,
+    }
+    if not cfg.tie_word_embeddings:
+        head = state.get("lm_head.weight")
+        if head is None:
+            raise KeyError("config says untied embeddings but lm_head.weight missing")
+        params["lm_head"] = jnp.asarray(_to_numpy(head).T, dtype)
+    return params
+
+
+def load_safetensors_dir(path: str) -> Dict[str, np.ndarray]:
+    """Load all tensors from a directory of .safetensors shards (numpy)."""
+    from safetensors import safe_open
+
+    tensors: Dict[str, np.ndarray] = {}
+    files = sorted(glob.glob(os.path.join(path, "*.safetensors")))
+    if not files:
+        raise FileNotFoundError(f"no .safetensors files under {path}")
+    for f in files:
+        with safe_open(f, framework="np") as reader:
+            for name in reader.keys():
+                tensors[name] = reader.get_tensor(name)
+    return tensors
+
+
+def load_checkpoint(path: str, cfg: Optional[ModelConfig] = None) -> tuple:
+    """Load (cfg, params) from an HF checkpoint directory."""
+    if cfg is None:
+        cfg = config_from_hf_json(os.path.join(path, "config.json"))
+    state = load_safetensors_dir(path)
+    return cfg, convert_hf_state_dict(state, cfg)
+
+
+def resolve_checkpoint_dir(model_name: str) -> Optional[str]:
+    """Find a local checkpoint dir for a model name, if one exists.
+
+    Search order: $KAFKA_TPU_CKPT_DIR/<name>, ./checkpoints/<name>,
+    the HF cache. Returns None when the model must run random-init
+    (tests/benchmarks without downloaded weights — this environment has no
+    network egress)."""
+    candidates = []
+    env_dir = os.environ.get("KAFKA_TPU_CKPT_DIR")
+    if env_dir:
+        candidates.append(os.path.join(env_dir, model_name))
+    candidates.append(os.path.join("checkpoints", model_name))
+    hf_cache = os.path.expanduser(
+        os.environ.get("HF_HOME", "~/.cache/huggingface")
+    )
+    candidates.extend(
+        glob.glob(
+            os.path.join(
+                hf_cache, "hub", f"models--*{model_name}*", "snapshots", "*"
+            )
+        )
+    )
+    for c in candidates:
+        if os.path.isdir(c) and glob.glob(os.path.join(c, "*.safetensors")):
+            return c
+    return None

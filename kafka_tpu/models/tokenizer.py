@@ -1,0 +1,239 @@
+"""Tokenization + chat templating.
+
+Two backends behind one interface:
+  * `HFTokenizer` — wraps a HuggingFace `tokenizer.json` via the `tokenizers`
+    library (the serving path for real Llama checkpoints);
+  * `ByteTokenizer` — a self-contained byte-level tokenizer (256 byte ids +
+    special tokens). Used by tests, random-init models, and benchmarks in
+    this no-egress environment; also a worst-case stressor for the engine
+    since every char is a token.
+
+Chat templating implements the Llama-3 header format natively (the engine
+must render OpenAI `messages` itself — the reference delegated that to the
+remote provider). Tool calls are rendered as JSON in the conversation, and
+`parse_tool_call_text` recovers tool calls from generated text.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Iterable, List, Optional, Sequence
+
+
+class BaseTokenizer:
+    bos_id: int
+    eos_id: int
+    pad_id: int
+    # ids that terminate a turn (Llama-3: <|eot_id|> and <|end_of_text|>)
+    stop_ids: Sequence[int]
+    vocab_size: int
+
+    def encode(self, text: str) -> List[int]:
+        raise NotImplementedError
+
+    def decode(self, ids: Iterable[int]) -> str:
+        raise NotImplementedError
+
+    # -- chat templating (Llama-3 style) -------------------------------------
+
+    def render_message_header(self, role: str) -> str:
+        return f"<|start_header_id|>{role}<|end_header_id|>\n\n"
+
+    def apply_chat_template(
+        self,
+        messages: List[Dict[str, Any]],
+        add_generation_prompt: bool = True,
+        tools: Optional[List[Dict[str, Any]]] = None,
+    ) -> str:
+        """Render OpenAI-format messages to the model's chat text."""
+        parts = ["<|begin_of_text|>"]
+        msgs = list(messages)
+        if tools:
+            tool_desc = (
+                "You have access to the following tools. To call a tool, "
+                'respond with JSON of the form {"name": <tool name>, '
+                '"parameters": <arguments dict>}.\n\nTools:\n'
+                + json.dumps(tools, indent=2)
+            )
+            # merge into the first system message (or synthesize one)
+            if msgs and msgs[0].get("role") == "system":
+                sys_content = _text_of(msgs[0]) + "\n\n" + tool_desc
+                msgs = [{"role": "system", "content": sys_content}] + msgs[1:]
+            else:
+                msgs = [{"role": "system", "content": tool_desc}] + msgs
+        for m in msgs:
+            role = m.get("role", "user")
+            if role == "tool":
+                role = "ipython"  # Llama-3 convention for tool results
+            parts.append(self.render_message_header(role))
+            if m.get("tool_calls"):
+                calls = [
+                    {
+                        "name": tc["function"]["name"],
+                        "parameters": _maybe_json(tc["function"].get("arguments")),
+                        "id": tc.get("id"),
+                    }
+                    for tc in m["tool_calls"]
+                ]
+                body = _text_of(m)
+                if body:
+                    parts.append(body + "\n")
+                parts.append(json.dumps(calls if len(calls) > 1 else calls[0]))
+            else:
+                parts.append(_text_of(m))
+            parts.append("<|eot_id|>")
+        if add_generation_prompt:
+            parts.append(self.render_message_header("assistant"))
+        return "".join(parts)
+
+    def encode_chat(self, messages, add_generation_prompt=True, tools=None) -> List[int]:
+        return self.encode(
+            self.apply_chat_template(messages, add_generation_prompt, tools)
+        )
+
+
+def _text_of(m: Dict[str, Any]) -> str:
+    c = m.get("content")
+    if c is None:
+        return ""
+    if isinstance(c, str):
+        return c
+    return "".join(
+        p.get("text", "") for p in c if isinstance(p, dict) and p.get("type") == "text"
+    )
+
+
+def _maybe_json(s: Any) -> Any:
+    if not isinstance(s, str):
+        return s
+    try:
+        return json.loads(s)
+    except (json.JSONDecodeError, ValueError):
+        return s
+
+
+def parse_tool_call_text(text: str) -> Optional[List[Dict[str, Any]]]:
+    """Detect a tool-call JSON emitted as assistant text.
+
+    Returns OpenAI-wire tool_calls or None if the text isn't a tool call.
+    Accepts a single {"name":..., "parameters":...} object or a list.
+    """
+    stripped = text.strip()
+    if not stripped or stripped[0] not in "[{":
+        return None
+    try:
+        obj = json.loads(stripped)
+    except json.JSONDecodeError:
+        return None
+    items = obj if isinstance(obj, list) else [obj]
+    calls = []
+    for i, it in enumerate(items):
+        if not isinstance(it, dict) or "name" not in it:
+            return None
+        args = it.get("parameters", it.get("arguments", {}))
+        calls.append(
+            {
+                "id": it.get("id") or f"call_local_{i}",
+                "type": "function",
+                "function": {
+                    "name": it["name"],
+                    "arguments": json.dumps(args) if not isinstance(args, str) else args,
+                },
+            }
+        )
+    return calls or None
+
+
+class ByteTokenizer(BaseTokenizer):
+    """Byte-level tokenizer: ids 0-255 are raw bytes; specials above."""
+
+    SPECIALS = [
+        "<|begin_of_text|>",
+        "<|end_of_text|>",
+        "<|eot_id|>",
+        "<|start_header_id|>",
+        "<|end_header_id|>",
+        "<|pad|>",
+    ]
+
+    def __init__(self) -> None:
+        self._special_to_id = {s: 256 + i for i, s in enumerate(self.SPECIALS)}
+        self._id_to_special = {v: k for k, v in self._special_to_id.items()}
+        self.bos_id = self._special_to_id["<|begin_of_text|>"]
+        self.eos_id = self._special_to_id["<|end_of_text|>"]
+        self.eot_id = self._special_to_id["<|eot_id|>"]
+        self.pad_id = self._special_to_id["<|pad|>"]
+        self.stop_ids = (self.eos_id, self.eot_id)
+        self.vocab_size = 256 + len(self.SPECIALS)
+
+    def encode(self, text: str) -> List[int]:
+        ids: List[int] = []
+        i = 0
+        while i < len(text):
+            matched = False
+            if text[i] == "<":
+                for sp, sid in self._special_to_id.items():
+                    if text.startswith(sp, i):
+                        ids.append(sid)
+                        i += len(sp)
+                        matched = True
+                        break
+            if not matched:
+                ids.extend(text[i].encode("utf-8"))
+                i += 1
+        return ids
+
+    def decode(self, ids: Iterable[int]) -> str:
+        out: List[str] = []
+        buf = bytearray()
+        for t in ids:
+            t = int(t)
+            if t < 256:
+                buf.append(t)
+            else:
+                if buf:
+                    out.append(buf.decode("utf-8", errors="replace"))
+                    buf = bytearray()
+                # specials render as empty on decode (not user-visible)
+        if buf:
+            out.append(buf.decode("utf-8", errors="replace"))
+        return "".join(out)
+
+
+class HFTokenizer(BaseTokenizer):
+    """Wraps a `tokenizer.json` (HuggingFace `tokenizers` Rust backend)."""
+
+    def __init__(self, path: str) -> None:
+        from tokenizers import Tokenizer
+
+        tok_file = path
+        if os.path.isdir(path):
+            tok_file = os.path.join(path, "tokenizer.json")
+        self._tok = Tokenizer.from_file(tok_file)
+        self.vocab_size = self._tok.get_vocab_size()
+
+        def tid(name: str, default: int) -> int:
+            t = self._tok.token_to_id(name)
+            return t if t is not None else default
+
+        self.bos_id = tid("<|begin_of_text|>", 0)
+        self.eos_id = tid("<|end_of_text|>", 1)
+        self.eot_id = tid("<|eot_id|>", self.eos_id)
+        self.pad_id = tid("<|finetune_right_pad_id|>", self.eos_id)
+        self.stop_ids = tuple({self.eos_id, self.eot_id})
+
+    def encode(self, text: str) -> List[int]:
+        return self._tok.encode(text, add_special_tokens=False).ids
+
+    def decode(self, ids: Iterable[int]) -> str:
+        return self._tok.decode(list(ids), skip_special_tokens=True)
+
+
+def load_tokenizer(checkpoint_dir: Optional[str]) -> BaseTokenizer:
+    """HFTokenizer when the checkpoint ships one, else ByteTokenizer."""
+    if checkpoint_dir:
+        tok_file = os.path.join(checkpoint_dir, "tokenizer.json")
+        if os.path.exists(tok_file):
+            return HFTokenizer(tok_file)
+    return ByteTokenizer()

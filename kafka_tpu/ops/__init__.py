@@ -1,0 +1,14 @@
+"""Compute ops: norms, rope, attention (XLA reference + Pallas TPU kernels)."""
+
+from .attention import causal_attention, repeat_kv
+from .norms import rms_norm
+from .rope import apply_rope, rope_cos_sin, rope_frequencies
+
+__all__ = [
+    "causal_attention",
+    "repeat_kv",
+    "rms_norm",
+    "apply_rope",
+    "rope_cos_sin",
+    "rope_frequencies",
+]

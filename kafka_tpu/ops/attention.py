@@ -1,0 +1,72 @@
+"""Attention ops — XLA reference implementations.
+
+These einsum formulations are the portable baseline: they run on CPU (tests)
+and TPU, and XLA already fuses mask+softmax+matmul chains well on the MXU.
+The Pallas kernels in ops/pallas/ override them on TPU for the flash
+(prefill) and paged (decode) paths; this module is the numerics ground truth
+those kernels are tested against.
+
+Layout convention throughout the framework: activations are
+[batch, seq, heads, head_dim] ("BSHD") — the layout that shards naturally
+over a ("dp", "tp") mesh with heads on "tp".
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import jax
+
+
+NEG_INF = -1e30  # large-negative mask value; -inf breaks softmax when a row is fully masked
+
+
+def repeat_kv(x: jnp.ndarray, n_rep: int) -> jnp.ndarray:
+    """Expand KV heads for GQA: [B, S, Hkv, D] -> [B, S, Hkv*n_rep, D]."""
+    if n_rep == 1:
+        return x
+    b, s, h, d = x.shape
+    x = jnp.broadcast_to(x[:, :, :, None, :], (b, s, h, n_rep, d))
+    return x.reshape(b, s, h * n_rep, d)
+
+
+def causal_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    q_positions: jnp.ndarray,
+    kv_positions: jnp.ndarray,
+    kv_valid: Optional[jnp.ndarray] = None,
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Masked scaled-dot-product attention with GQA.
+
+    q: [B, Sq, Hq, D]   k/v: [B, Skv, Hkv, D]
+    q_positions: [B, Sq] absolute position of each query token
+    kv_positions: [B, Skv] absolute position of each kv slot
+    kv_valid: [B, Skv] bool — False for empty cache slots/padding
+    Causality: a query at position p attends kv slots with position <= p.
+    Works for prefill (Sq == Skv), chunked prefill, and decode (Sq == 1)
+    against a longer cache.
+    """
+    n_rep = q.shape[2] // k.shape[2]
+    k = repeat_kv(k, n_rep)
+    v = repeat_kv(v, n_rep)
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+
+    qf = q.astype(jnp.float32) * scale
+    kf = k.astype(jnp.float32)
+    # [B, H, Sq, Skv]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", qf, kf)
+
+    mask = q_positions[:, None, :, None] >= kv_positions[:, None, None, :]
+    if kv_valid is not None:
+        mask = mask & kv_valid[:, None, None, :]
+    logits = jnp.where(mask, logits, NEG_INF)
+
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)

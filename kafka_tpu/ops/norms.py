@@ -1,0 +1,20 @@
+"""Normalization ops.
+
+RMSNorm is computed in float32 regardless of activation dtype (bf16 inputs
+lose too much precision in the mean-square reduction on the MXU-adjacent
+vector units), then cast back — the standard TPU recipe.
+"""
+
+from __future__ import annotations
+
+import jax.lax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+    """Llama-style RMSNorm: x * rsqrt(mean(x^2) + eps) * weight."""
+    orig_dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    normed = x32 * jax.lax.rsqrt(var + eps)
+    return (normed * weight.astype(jnp.float32)).astype(orig_dtype)

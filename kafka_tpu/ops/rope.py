@@ -1,0 +1,70 @@
+"""Rotary position embeddings (RoPE), including Llama-3.x NTK-by-parts scaling.
+
+Frequencies are computed on the fly from integer position ids rather than
+from a precomputed [max_context, dim] table: paged decoding addresses
+positions per-sequence, and an on-the-fly gatherless formulation keeps the
+decode step free of HBM table lookups (the cos/sin math fuses into the
+surrounding elementwise ops under XLA).
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+from ..models.config import ModelConfig
+
+
+def rope_frequencies(cfg: ModelConfig) -> jnp.ndarray:
+    """Per-pair inverse frequencies [head_dim//2], with Llama-3 scaling."""
+    dim = cfg.head_dim
+    inv_freq = 1.0 / (
+        cfg.rope_theta ** (jnp.arange(0, dim, 2, dtype=jnp.float32) / dim)
+    )
+    if cfg.rope_scaling_factor is None:
+        return inv_freq
+
+    # Llama-3.x "NTK-by-parts": low-frequency components are slowed by
+    # `factor`, high-frequency kept, mid-band interpolated smoothly.
+    low_freq_wavelen = cfg.rope_original_max_position / cfg.rope_low_freq_factor
+    high_freq_wavelen = cfg.rope_original_max_position / cfg.rope_high_freq_factor
+    wavelen = 2.0 * math.pi / inv_freq
+    scaled = inv_freq / cfg.rope_scaling_factor
+    smooth = (cfg.rope_original_max_position / wavelen - cfg.rope_low_freq_factor) / (
+        cfg.rope_high_freq_factor - cfg.rope_low_freq_factor
+    )
+    mid = (1.0 - smooth) * scaled + smooth * inv_freq
+    out = jnp.where(wavelen > low_freq_wavelen, scaled, inv_freq)
+    out = jnp.where(
+        (wavelen <= low_freq_wavelen) & (wavelen >= high_freq_wavelen), mid, out
+    )
+    return out
+
+
+def rope_cos_sin(
+    positions: jnp.ndarray, inv_freq: jnp.ndarray
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """cos/sin tables for integer positions [...]: returns [..., head_dim//2]."""
+    angles = positions[..., None].astype(jnp.float32) * inv_freq
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(
+    x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray
+) -> jnp.ndarray:
+    """Rotate q or k. x: [..., heads, head_dim]; cos/sin broadcast on heads.
+
+    Uses the HF-style "rotate_half" pairing (first half / second half), so
+    converted HuggingFace checkpoints produce identical outputs.
+    """
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    cos = cos[..., None, :].astype(jnp.float32)
+    sin = sin[..., None, :].astype(jnp.float32)
+    x1f, x2f = x1.astype(jnp.float32), x2.astype(jnp.float32)
+    r1 = x1f * cos - x2f * sin
+    r2 = x2f * cos + x1f * sin
+    return jnp.concatenate([r1, r2], axis=-1).astype(x.dtype)

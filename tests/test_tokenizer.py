@@ -1,0 +1,96 @@
+"""Tests for tokenization, chat templating, and tool-call text parsing."""
+
+import json
+
+from kafka_tpu.models import ByteTokenizer, get_config, parse_tool_call_text
+from kafka_tpu.models.config import CONFIGS
+
+
+class TestByteTokenizer:
+    def test_roundtrip(self):
+        tok = ByteTokenizer()
+        for s in ["hello world", "héllo → ünïcode", ""]:
+            assert tok.decode(tok.encode(s)) == s
+
+    def test_specials_single_ids(self):
+        tok = ByteTokenizer()
+        ids = tok.encode("<|begin_of_text|>hi<|eot_id|>")
+        assert ids[0] == tok.bos_id and ids[-1] == tok.eot_id
+        assert len(ids) == 4  # bos + 'h' + 'i' + eot
+
+    def test_specials_stripped_on_decode(self):
+        tok = ByteTokenizer()
+        assert tok.decode(tok.encode("<|eot_id|>ok")) == "ok"
+
+    def test_chat_template(self):
+        tok = ByteTokenizer()
+        text = tok.apply_chat_template(
+            [
+                {"role": "system", "content": "be brief"},
+                {"role": "user", "content": "hi"},
+            ]
+        )
+        assert text.startswith("<|begin_of_text|><|start_header_id|>system")
+        assert text.endswith("<|start_header_id|>assistant<|end_header_id|>\n\n")
+        assert "be brief<|eot_id|>" in text
+
+    def test_chat_template_tools_merged_into_system(self):
+        tok = ByteTokenizer()
+        tools = [{"type": "function", "function": {"name": "f", "parameters": {}}}]
+        text = tok.apply_chat_template(
+            [{"role": "user", "content": "x"}], tools=tools
+        )
+        assert text.count("<|start_header_id|>system") == 1
+        assert '"name": "f"' in text
+
+    def test_tool_role_rendered_as_ipython(self):
+        tok = ByteTokenizer()
+        text = tok.apply_chat_template(
+            [{"role": "tool", "content": "42", "tool_call_id": "c1"}],
+            add_generation_prompt=False,
+        )
+        assert "<|start_header_id|>ipython" in text
+
+
+class TestParseToolCallText:
+    def test_single_call(self):
+        calls = parse_tool_call_text('{"name": "get_weather", "parameters": {"city": "Paris"}}')
+        assert calls and calls[0]["function"]["name"] == "get_weather"
+        assert json.loads(calls[0]["function"]["arguments"]) == {"city": "Paris"}
+
+    def test_list_of_calls(self):
+        calls = parse_tool_call_text('[{"name": "a", "parameters": {}}, {"name": "b", "parameters": {}}]')
+        assert [c["function"]["name"] for c in calls] == ["a", "b"]
+
+    def test_plain_text_is_none(self):
+        assert parse_tool_call_text("The weather is nice.") is None
+        assert parse_tool_call_text("") is None
+        assert parse_tool_call_text('{"not_a_call": 1}') is None
+        assert parse_tool_call_text("{broken json") is None
+
+
+class TestConfigs:
+    def test_known_sizes(self):
+        c8 = get_config("llama-3-8b")
+        assert c8.num_layers == 32 and c8.num_kv_heads == 8
+        c70 = get_config("Llama-3-70B-Instruct")
+        assert c70.num_layers == 80 and c70.hidden_size == 8192
+
+    def test_param_counts_roughly_right(self):
+        # embed + layers + head; sanity that configs aren't typo'd
+        def nparams(c):
+            per_layer = (
+                c.hidden_size * c.num_heads * c.head_dim * 2  # wq, wo
+                + c.hidden_size * c.num_kv_heads * c.head_dim * 2  # wk, wv
+                + 3 * c.hidden_size * c.intermediate_size
+            )
+            total = c.vocab_size * c.hidden_size * (1 if c.tie_word_embeddings else 2)
+            return total + c.num_layers * per_layer
+
+        assert 0.9e9 < nparams(get_config("llama-3.2-1b")) < 1.4e9
+        assert 7e9 < nparams(get_config("llama-3-8b")) < 9e9
+        assert 65e9 < nparams(get_config("llama-3-70b")) < 75e9
+
+    def test_all_configs_heads_divide(self):
+        for name, c in CONFIGS.items():
+            assert c.num_heads % c.num_kv_heads == 0, name
