@@ -50,6 +50,27 @@ class KVCache(NamedTuple):
         return self.k.shape[2]
 
 
+class PagedView(NamedTuple):
+    """Index plan for one step against a paged KV pool.
+
+    The pool stores k/v as [L, num_pages * page_size, Hkv, D] — a flat slot
+    axis shared by all sequences. The runtime's page tables translate each
+    sequence's logical positions to physical slots; the model only ever sees
+    these precomputed flat indices, so the same layer math serves contiguous
+    and paged caches (and the Pallas paged kernel swaps in transparently).
+
+    write_idx:    [B, S]  flat slot for each new token's k/v
+    read_idx:     [B, C]  flat slots forming each sequence's attention window
+    kv_positions: [B, C]  absolute position of each window slot
+    kv_valid:     [B, C]  False for unallocated/beyond-length slots
+    """
+
+    write_idx: jnp.ndarray
+    read_idx: jnp.ndarray
+    kv_positions: jnp.ndarray
+    kv_valid: jnp.ndarray
+
+
 def init_kv_cache(cfg: ModelConfig, batch: int, capacity: int, dtype=None) -> KVCache:
     dtype = dtype or cfg.activation_dtype
     shape = (cfg.num_layers, batch, capacity, cfg.num_kv_heads, cfg.head_dim)
@@ -98,6 +119,7 @@ def _attention_block(
     v_cache: Optional[jnp.ndarray],
     kv_valid: Optional[jnp.ndarray],
     cache_positions: Optional[jnp.ndarray],
+    paged: Optional["PagedView"] = None,
 ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray], Optional[jnp.ndarray]]:
     """One attention sublayer. x: [B, S, H]. Returns (out, k_cache', v_cache')."""
     q = jnp.einsum("bsh,hnd->bsnd", x, lp["wq"])
@@ -106,7 +128,21 @@ def _attention_block(
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
 
-    if k_cache is None:
+    if paged is not None:
+        # Paged pool: k_cache/v_cache are [TOTAL_SLOTS, Hkv, D] this layer.
+        k_cache = k_cache.at[paged.write_idx].set(k.astype(k_cache.dtype))
+        v_cache = v_cache.at[paged.write_idx].set(v.astype(v_cache.dtype))
+        k_win = k_cache[paged.read_idx]  # [B, C, Hkv, D]
+        v_win = v_cache[paged.read_idx]
+        out = causal_attention(
+            q,
+            k_win,
+            v_win,
+            q_positions=positions,
+            kv_positions=paged.kv_positions,
+            kv_valid=paged.kv_valid,
+        )
+    elif k_cache is None:
         out = causal_attention(
             q, k, v, q_positions=positions, kv_positions=positions
         )
@@ -147,13 +183,16 @@ def forward(
     kv_cache: Optional[KVCache] = None,
     kv_valid: Optional[jnp.ndarray] = None,
     cache_positions: Optional[jnp.ndarray] = None,
+    paged: Optional[PagedView] = None,
 ) -> Tuple[jnp.ndarray, Optional[KVCache]]:
     """Run the decoder.
 
     token_ids, positions: [B, S] int32.
-    kv_cache: optional KVCache of capacity C; new k/v are written at
-        `cache_positions` (default: `positions`) and attention runs over the
-        whole cache gated by `kv_valid` [B, C].
+    kv_cache: optional KVCache. Contiguous form: k/v [L, B, C, Hkv, D],
+        new k/v written at `cache_positions` (default `positions`), attention
+        over the whole cache gated by `kv_valid` [B, C]. Paged form (when
+        `paged` is given): k/v [L, TOTAL_SLOTS, Hkv, D], reads/writes follow
+        the PagedView index plan.
     Returns (logits [B, S, vocab] float32, updated cache or None).
     """
     x = params["embed"][token_ids].astype(cfg.activation_dtype)
@@ -164,7 +203,8 @@ def forward(
         lp, kc, vc = scanned
         attn_in = rms_norm(h, lp["ln_attn"], cfg.rms_norm_eps)
         attn_out, kc, vc = _attention_block(
-            attn_in, lp, cfg, cos, sin, positions, kc, vc, kv_valid, cache_positions
+            attn_in, lp, cfg, cos, sin, positions, kc, vc, kv_valid,
+            cache_positions, paged,
         )
         h = h + attn_out
         mlp_in = rms_norm(h, lp["ln_mlp"], cfg.rms_norm_eps)

@@ -10,15 +10,12 @@ surrounding elementwise ops under XLA).
 from __future__ import annotations
 
 import math
-from functools import partial
-from typing import Optional, Tuple
+from typing import Tuple
 
 import jax.numpy as jnp
 
-from ..models.config import ModelConfig
 
-
-def rope_frequencies(cfg: ModelConfig) -> jnp.ndarray:
+def rope_frequencies(cfg) -> jnp.ndarray:
     """Per-pair inverse frequencies [head_dim//2], with Llama-3 scaling."""
     dim = cfg.head_dim
     inv_freq = 1.0 / (

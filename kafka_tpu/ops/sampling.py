@@ -1,0 +1,127 @@
+"""Batched token sampling, fully vectorized for the shared decode step.
+
+Every sequence in the continuous-batching step can carry different sampling
+parameters (temperature / top-k / top-p / seed) and an optional per-sequence
+token mask (constrained decoding for tool-call JSON).  Everything is
+branch-free so one jitted kernel serves the whole batch: greedy is the
+temperature<=0 limit handled by `jnp.where`, not Python control flow.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+class SamplingParams(NamedTuple):
+    """Per-sequence sampling state, batched [B]."""
+
+    temperature: jnp.ndarray  # [B] float32; <=0 means greedy
+    top_k: jnp.ndarray  # [B] int32; 0 disables
+    top_p: jnp.ndarray  # [B] float32; 1.0 disables
+
+    @classmethod
+    def make(cls, batch: int, temperature=0.0, top_k=0, top_p=1.0):
+        return cls(
+            temperature=jnp.full((batch,), temperature, jnp.float32),
+            top_k=jnp.full((batch,), top_k, jnp.int32),
+            top_p=jnp.full((batch,), top_p, jnp.float32),
+        )
+
+    def at(self, i: int, temperature=None, top_k=None, top_p=None) -> "SamplingParams":
+        """Functional single-slot update (host-side convenience)."""
+        t, k, p = self.temperature, self.top_k, self.top_p
+        if temperature is not None:
+            t = t.at[i].set(temperature)
+        if top_k is not None:
+            k = k.at[i].set(top_k)
+        if top_p is not None:
+            p = p.at[i].set(top_p)
+        return SamplingParams(t, k, p)
+
+
+def apply_top_k(logits: jnp.ndarray, top_k: jnp.ndarray) -> jnp.ndarray:
+    """Mask logits below the per-row k-th largest. top_k==0 disables."""
+    vocab = logits.shape[-1]
+    sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
+    k = jnp.clip(top_k, 1, vocab)
+    thresh = jnp.take_along_axis(sorted_desc, (k - 1)[:, None], axis=-1)
+    keep = (logits >= thresh) | (top_k[:, None] == 0)
+    return jnp.where(keep, logits, NEG_INF)
+
+
+def apply_top_p(logits: jnp.ndarray, top_p: jnp.ndarray) -> jnp.ndarray:
+    """Nucleus filtering: keep the smallest prefix of the sorted distribution
+    with cumulative probability >= top_p. top_p>=1 disables."""
+    order = jnp.argsort(logits, axis=-1)[..., ::-1]
+    sorted_logits = jnp.take_along_axis(logits, order, axis=-1)
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # keep tokens where the cumulative mass *before* them is < top_p;
+    # the top token is always kept so top_p=0 degrades to argmax, not to
+    # uniform noise over a fully-masked row
+    keep_sorted = ((cum - probs) < top_p[:, None]).at[..., 0].set(True)
+    keep = jnp.zeros_like(keep_sorted).at[
+        jnp.arange(logits.shape[0])[:, None], order
+    ].set(keep_sorted)
+    keep = keep | (top_p[:, None] >= 1.0)
+    return jnp.where(keep, logits, NEG_INF)
+
+
+def _filtered_logits(
+    logits: jnp.ndarray,
+    params: SamplingParams,
+    allowed_mask: Optional[jnp.ndarray],
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Shared masking/temperature/filter pipeline -> (greedy, scaled)."""
+    if allowed_mask is not None:
+        usable = jnp.any(allowed_mask, axis=-1, keepdims=True)
+        mask = jnp.where(usable, allowed_mask, True)
+        logits = jnp.where(mask, logits, NEG_INF)
+
+    greedy_choice = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    temp = jnp.maximum(params.temperature, 1e-6)[:, None]
+    scaled = logits / temp
+    scaled = apply_top_k(scaled, params.top_k)
+    scaled = apply_top_p(scaled, params.top_p)
+    return greedy_choice, scaled
+
+
+def sample_tokens(
+    logits: jnp.ndarray,
+    params: SamplingParams,
+    key: jax.Array,
+    allowed_mask: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Sample one token per row with a shared key. [B, V] f32 -> [B] i32.
+
+    allowed_mask: optional [B, V] bool — False tokens are excluded before
+    temperature/filtering (constrained decoding). A fully-False row falls
+    back to unconstrained (never emit garbage from an over-tight mask).
+    """
+    greedy_choice, scaled = _filtered_logits(logits, params, allowed_mask)
+    sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+    return jnp.where(params.temperature <= 0.0, greedy_choice, sampled)
+
+
+def sample_tokens_per_slot(
+    logits: jnp.ndarray,
+    params: SamplingParams,
+    keys: jax.Array,
+    allowed_mask: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Like sample_tokens but with one PRNG key per row ([B] key array).
+
+    Per-slot keys make each request's sampling deterministic in
+    (seed, position) regardless of what else shares the continuous-batching
+    step — requests are reproducible under preemption and re-batching.
+    """
+    greedy_choice, scaled = _filtered_logits(logits, params, allowed_mask)
+    sampled = jax.vmap(
+        lambda k, row: jax.random.categorical(k, row).astype(jnp.int32)
+    )(keys, scaled)
+    return jnp.where(params.temperature <= 0.0, greedy_choice, sampled)

@@ -1,0 +1,20 @@
+"""Serving runtime: paged KV pool, continuous-batching engine."""
+
+from .engine import (
+    EngineConfig,
+    GenRequest,
+    InferenceEngine,
+    TokenEvent,
+)
+from .kv_cache import OutOfPagesError, PagePool, SequencePages, TRASH_PAGE
+
+__all__ = [
+    "EngineConfig",
+    "GenRequest",
+    "InferenceEngine",
+    "TokenEvent",
+    "OutOfPagesError",
+    "PagePool",
+    "SequencePages",
+    "TRASH_PAGE",
+]

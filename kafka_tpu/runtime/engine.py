@@ -1,0 +1,524 @@
+"""The TPU inference engine: continuous batching over a paged KV pool.
+
+This is the component that replaces the reference's remote LLM hop
+(src/llm/portkey.py — an HTTPS proxy to provider GPUs) with local TPU
+compute.  Architecture:
+
+* **Two jitted device programs.** `prefill` (per chunk-length bucket,
+  one sequence) writes prompt KV into the sequence's pages and samples the
+  first token; `decode` advances *every* active batch slot one token.  Both
+  donate the KV pool arrays, so the pool is updated in place — no per-step
+  copies of cache memory.
+* **Static shapes everywhere.** Prompt chunks are bucketed; the decode batch
+  is a fixed max_batch wide with inactive slots masked (they write to the
+  trash page and their samples are discarded).  Nothing recompiles as
+  requests come and go — the continuous-batching invariant that keeps XLA
+  happy.
+* **Index plans on device.** The decode step derives its paged read/write
+  indices from (page_table, seq_lens) inside jit; per step the host uploads
+  only small int arrays and downloads one [B] token vector.
+* **Host-side scheduler** (`step()`): admit waiting requests when a batch
+  slot + pages are free (prefill), then run one decode for everyone, then
+  retire finished sequences.  Preemption: if page allocation fails
+  mid-decode, the youngest request is rolled back to the waiting queue and
+  its pages freed (it will re-prefill later — the conversation itself is
+  durable in the thread store, which is the recovery model the reference
+  uses for sandboxes, SURVEY §5.4).
+
+Determinism note: with f32 compute ("highest" matmul precision) resumed
+requests reproduce their solo trajectories exactly (tested).  At serving
+precision (bf16 on the MXU), rounding is matmul-shape-dependent, so a
+re-prefill after preemption can flip greedy choices on near-tied logits —
+the same property bf16 GPU serving stacks have; per-request seeds still make
+*sampling* reproducible given identical logits.
+
+The engine is synchronous; the async serving layer (llm/tpu_provider.py)
+runs it on a dispatch thread and streams tokens out per-request.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import logging
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.config import ModelConfig
+from ..models.llama import KVCache, PagedView, forward
+from ..ops.sampling import SamplingParams, sample_tokens_per_slot
+from .kv_cache import (
+    OutOfPagesError,
+    PagePool,
+    SequencePages,
+    TRASH_PAGE,
+    make_kv_pool_arrays,
+    page_table_array,
+)
+
+logger = logging.getLogger("kafka_tpu.engine")
+
+WAITING, ACTIVE, FINISHED = "waiting", "active", "finished"
+
+# Compiled step functions are cached per (model cfg, engine shape) so that
+# multiple engine instances (tests, restarts) reuse compilations.
+_FN_CACHE: Dict[Tuple, Callable] = {}
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    max_batch: int = 8
+    page_size: int = 16
+    num_pages: int = 256
+    max_pages_per_seq: int = 16  # attention window = this * page_size
+    prefill_buckets: Tuple[int, ...] = (16, 32, 64, 128, 256, 512)
+    max_new_tokens_default: int = 512
+
+    @property
+    def max_window(self) -> int:
+        return self.max_pages_per_seq * self.page_size
+
+
+@dataclasses.dataclass
+class GenRequest:
+    """One generation request moving through the scheduler."""
+
+    request_id: str
+    prompt_ids: List[int]
+    max_new_tokens: int = 512
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    seed: int = 0
+    stop_token_ids: Tuple[int, ...] = ()
+    # engine bookkeeping
+    state: str = WAITING
+    slot: int = -1
+    seq: Optional[SequencePages] = None
+    output_ids: List[int] = dataclasses.field(default_factory=list)
+    finish_reason: Optional[str] = None
+    submit_time: float = 0.0
+    first_token_time: Optional[float] = None
+    # True while re-entering after preemption: the prefill's sampled token
+    # was already emitted before preemption and must not be re-emitted.
+    resumed: bool = False
+    # Token ids the next prefill must materialize. Equals prompt_ids at
+    # submit; recomputed from (prompt_ids, output_ids) on preemption —
+    # always derived from the immutable prompt, so repeated preemptions
+    # cannot duplicate context.
+    prefill_ids: List[int] = dataclasses.field(default_factory=list)
+    # constrained decoding: fn(output_ids) -> allowed token id list or None
+    logits_mask_fn: Optional[Callable[[List[int]], Optional[List[int]]]] = None
+
+    @property
+    def cached_len(self) -> int:
+        return self.seq.length if self.seq else 0
+
+
+@dataclasses.dataclass
+class TokenEvent:
+    """One emitted token (or terminal event) for a request."""
+
+    request_id: str
+    token_id: Optional[int]
+    finished: bool = False
+    finish_reason: Optional[str] = None
+
+
+class InferenceEngine:
+    def __init__(
+        self,
+        cfg: ModelConfig,
+        params: Any,
+        engine_cfg: Optional[EngineConfig] = None,
+        kv_dtype=None,
+    ):
+        self.cfg = cfg
+        self.params = params
+        self.ecfg = engine_cfg or EngineConfig()
+        ps = self.ecfg.page_size
+        self.pool = PagePool(self.ecfg.num_pages, ps)
+        self.k_pool, self.v_pool = make_kv_pool_arrays(
+            cfg, self.ecfg.num_pages, ps, kv_dtype
+        )
+        if self.ecfg.num_pages - 1 < self.ecfg.max_pages_per_seq:
+            raise ValueError(
+                "num_pages must exceed max_pages_per_seq: a lone sequence "
+                "must always be able to reach the full attention window"
+            )
+        B = self.ecfg.max_batch
+        self.slots: List[Optional[GenRequest]] = [None] * B
+        self.waiting: List[GenRequest] = []
+        self._requests: Dict[str, GenRequest] = {}
+        self._step_count = 0
+        self._prefill_fns: Dict[int, Callable] = {}
+        self._decode_fn = self._build_decode_fn()
+        self._counter = itertools.count()
+
+    # ------------------------------------------------------------------
+    # jitted device programs
+    # ------------------------------------------------------------------
+
+    def _build_decode_fn(self):
+        cfg, ecfg = self.cfg, self.ecfg
+        ps, C, B = ecfg.page_size, ecfg.max_window, ecfg.max_batch
+        cache_key = ("decode", cfg, ps, C, B)
+        if cache_key in _FN_CACHE:
+            return _FN_CACHE[cache_key]
+
+        def fn(params, k_pool, v_pool, page_table, last_tokens, seq_lens,
+               active, temps, top_ks, top_ps, seeds, allowed_mask):
+            positions = seq_lens[:, None]
+            write_page = page_table[jnp.arange(B), seq_lens // ps]
+            write_idx = (write_page * ps + seq_lens % ps)[:, None]
+            # inactive slots scribble on the trash page
+            write_idx = jnp.where(active[:, None], write_idx, (seq_lens % ps)[:, None])
+            read_idx = (
+                page_table[:, :, None] * ps + jnp.arange(ps)[None, None, :]
+            ).reshape(B, C)
+            kv_positions = jnp.broadcast_to(jnp.arange(C)[None, :], (B, C))
+            kv_valid = (kv_positions <= seq_lens[:, None]) & active[:, None]
+            paged = PagedView(write_idx, read_idx, kv_positions, kv_valid)
+
+            logits, cache = forward(
+                params, cfg, last_tokens[:, None], positions,
+                kv_cache=KVCache(k_pool, v_pool), paged=paged,
+            )
+            logits = logits[:, 0]
+            keys = jax.vmap(
+                lambda s, p: jax.random.fold_in(jax.random.key(s), p)
+            )(seeds, seq_lens)
+            toks = sample_tokens_per_slot(
+                logits, SamplingParams(temps, top_ks, top_ps), keys, allowed_mask
+            )
+            return cache.k, cache.v, toks
+
+        jitted = jax.jit(fn, donate_argnums=(1, 2))
+        _FN_CACHE[cache_key] = jitted
+        return jitted
+
+    def _get_prefill_fn(self, bucket: int):
+        if bucket in self._prefill_fns:
+            return self._prefill_fns[bucket]
+        cfg, ecfg = self.cfg, self.ecfg
+        ps, C, P = ecfg.page_size, ecfg.max_window, ecfg.max_pages_per_seq
+        cache_key = ("prefill", cfg, bucket, ps, C, P)
+        if cache_key in _FN_CACHE:
+            self._prefill_fns[bucket] = _FN_CACHE[cache_key]
+            return _FN_CACHE[cache_key]
+
+        def fn(params, k_pool, v_pool, page_row, chunk, start, chunk_len,
+               temp, top_k, top_p, seed, allowed_mask):
+            # [1, S] shapes throughout; `start` supports chunked prefill and
+            # prefix-cache hits (resume mid-prompt).
+            S = bucket
+            local = jnp.arange(S)
+            positions = (start + local)[None, :]
+            in_chunk = local < chunk_len
+            write_page = page_row[(start + local) // ps]
+            write_idx = jnp.where(
+                in_chunk, write_page * ps + (start + local) % ps, local % ps
+            )[None, :]
+            read_idx = (page_row[:, None] * ps + jnp.arange(ps)[None, :]).reshape(1, C)
+            kv_positions = jnp.arange(C)[None, :]
+            kv_valid = kv_positions < (start + chunk_len)
+            paged = PagedView(write_idx, read_idx, kv_positions, kv_valid)
+
+            logits, cache = forward(
+                params, cfg, chunk[None, :], positions,
+                kv_cache=KVCache(k_pool, v_pool), paged=paged,
+            )
+            last = jnp.clip(chunk_len - 1, 0, S - 1)
+            final_logits = logits[0, last][None, :]  # [1, V]
+            sp = SamplingParams(
+                temperature=temp[None], top_k=top_k[None], top_p=top_p[None]
+            )
+            key = jax.random.fold_in(jax.random.key(seed[0]), start + chunk_len - 1)
+            tok = sample_tokens_per_slot(final_logits, sp, key[None], allowed_mask)
+            return cache.k, cache.v, tok[0]
+
+        jitted = jax.jit(fn, donate_argnums=(1, 2))
+        _FN_CACHE[cache_key] = jitted
+        self._prefill_fns[bucket] = jitted
+        return jitted
+
+    # ------------------------------------------------------------------
+    # public API
+    # ------------------------------------------------------------------
+
+    def submit(self, req: GenRequest) -> None:
+        if len(req.prompt_ids) == 0:
+            raise ValueError("empty prompt")
+        limit = self.ecfg.max_window
+        if len(req.prompt_ids) + 1 > limit:
+            raise ValueError(
+                f"prompt of {len(req.prompt_ids)} tokens exceeds the "
+                f"attention window ({limit}); compact the conversation first"
+            )
+        if len(req.prompt_ids) + req.max_new_tokens > limit:
+            req.max_new_tokens = max(1, limit - len(req.prompt_ids))
+        req.prefill_ids = list(req.prompt_ids)
+        req.submit_time = time.monotonic()
+        req.state = WAITING
+        self.waiting.append(req)
+        self._requests[req.request_id] = req
+
+    @property
+    def num_active(self) -> int:
+        return sum(1 for s in self.slots if s is not None)
+
+    @property
+    def has_work(self) -> bool:
+        return self.num_active > 0 or bool(self.waiting)
+
+    def step(self) -> List[TokenEvent]:
+        """One scheduler iteration: admit, decode, retire."""
+        events: List[TokenEvent] = []
+        events.extend(self._admit())
+        if self.num_active:
+            events.extend(self._decode_once())
+        return events
+
+    def run_to_completion(self) -> Dict[str, GenRequest]:
+        """Drain all requests (testing/bench convenience)."""
+        registry = {r.request_id: r for r in self._all_requests()}
+        done: Dict[str, GenRequest] = {}
+        while self.has_work:
+            for ev in self.step():
+                if ev.finished:
+                    done[ev.request_id] = registry[ev.request_id]
+        return done
+
+    def generate(self, prompt_ids: List[int], **kw) -> GenRequest:
+        """Single-request synchronous generation (BASELINE config 1)."""
+        req = GenRequest(
+            request_id=f"gen-{next(self._counter)}", prompt_ids=list(prompt_ids), **kw
+        )
+        self.submit(req)
+        while req.state != FINISHED:
+            self.step()
+        return req
+
+    # ------------------------------------------------------------------
+    # scheduler internals
+    # ------------------------------------------------------------------
+
+    def _all_requests(self):
+        return [s for s in self.slots if s is not None] + self.waiting
+
+    def _free_slot(self) -> Optional[int]:
+        for i, s in enumerate(self.slots):
+            if s is None:
+                return i
+        return None
+
+    def _pages_needed(self, req: GenRequest) -> int:
+        total = len(req.prefill_ids) + 1  # +1 so decode always has a slot
+        return -(-total // self.ecfg.page_size)
+
+    def _admit(self) -> List[TokenEvent]:
+        events: List[TokenEvent] = []
+        while self.waiting:
+            slot = self._free_slot()
+            if slot is None:
+                break
+            req = self.waiting[0]
+            if self._pages_needed(req) > self.pool.free_pages:
+                break  # wait for pages to free up
+            self.waiting.pop(0)
+            try:
+                events.extend(self._prefill_request(req, slot))
+            except OutOfPagesError:
+                # couldn't grow mid-prefill; roll back and retry later
+                if req.seq:
+                    self.pool.free_sequence(req.seq)
+                req.state = WAITING
+                req.seq = None
+                self.waiting.insert(0, req)
+                break
+        return events
+
+    def _prefill_request(self, req: GenRequest, slot: int) -> List[TokenEvent]:
+        ecfg = self.ecfg
+        req.seq = req.seq or SequencePages(seq_id=req.request_id)
+        start = req.seq.length  # >0 when resuming from a prefix-cache hit
+        prompt = np.asarray(req.prefill_ids, np.int32)
+        total = len(prompt)
+        self.pool.ensure_capacity(req.seq, total + 1)
+
+        tok = None
+        while start < total:
+            remaining = total - start
+            bucket = next(
+                (b for b in ecfg.prefill_buckets if b >= remaining),
+                ecfg.prefill_buckets[-1],
+            )
+            chunk_len = min(remaining, bucket)
+            chunk = np.zeros(bucket, np.int32)
+            chunk[:chunk_len] = prompt[start : start + chunk_len]
+            page_row = np.full(ecfg.max_pages_per_seq, TRASH_PAGE, np.int32)
+            page_row[: len(req.seq.pages)] = req.seq.pages
+            fn = self._get_prefill_fn(bucket)
+            allowed = None
+            if req.logits_mask_fn is not None:
+                allowed_ids = req.logits_mask_fn(req.output_ids)
+                if allowed_ids is not None:
+                    row = np.zeros((1, self.cfg.vocab_size), bool)
+                    row[0, np.asarray(allowed_ids, np.int64)] = True
+                    allowed = jnp.asarray(row)
+            self.k_pool, self.v_pool, tok = fn(
+                self.params, self.k_pool, self.v_pool,
+                jnp.asarray(page_row), jnp.asarray(chunk),
+                jnp.int32(start), jnp.int32(chunk_len),
+                jnp.float32(req.temperature), jnp.int32(req.top_k),
+                jnp.float32(req.top_p), jnp.asarray([req.seed], jnp.uint32),
+                allowed,
+            )
+            start += chunk_len
+            req.seq.length = start
+
+        req.state = ACTIVE
+        req.slot = slot
+        self.slots[slot] = req
+        if req.resumed:
+            # Re-entry after preemption: the pending last token is already in
+            # output_ids; the freshly sampled one is its deterministic
+            # duplicate (same seed, same position) — drop it.
+            req.resumed = False
+            return []
+        req.first_token_time = time.monotonic()
+        return self._emit(req, int(tok))
+
+    def _decode_once(self) -> List[TokenEvent]:
+        ecfg = self.ecfg
+        B, ps = ecfg.max_batch, ecfg.page_size
+
+        # grow pages for sequences about to write past their capacity
+        for req in list(s for s in self.slots if s is not None):
+            if req.state != ACTIVE or req.seq is None:
+                continue  # already preempted by an earlier iteration
+            try:
+                self.pool.ensure_capacity(req.seq, req.seq.length + 1)
+            except OutOfPagesError:
+                self._preempt_youngest()
+                if req.state != ACTIVE:
+                    continue  # req itself was the preemption victim
+                try:
+                    self.pool.ensure_capacity(req.seq, req.seq.length + 1)
+                except OutOfPagesError:
+                    # still no room: roll this one back too rather than let
+                    # it write into the trash page and corrupt its state
+                    self._preempt(req)
+                    continue
+
+        active = np.array([s is not None for s in self.slots])
+        if not active.any():
+            return []
+        seq_lens = np.array(
+            [s.seq.length if s else 0 for s in self.slots], np.int32
+        )
+        last_tokens = np.array(
+            [
+                (s.output_ids[-1] if s and s.output_ids else 0)
+                for s in self.slots
+            ],
+            np.int32,
+        )
+        temps = np.array([s.temperature if s else 0.0 for s in self.slots], np.float32)
+        top_ks = np.array([s.top_k if s else 0 for s in self.slots], np.int32)
+        top_ps = np.array([s.top_p if s else 1.0 for s in self.slots], np.float32)
+        seeds = np.array([s.seed if s else 0 for s in self.slots], np.uint32)
+        table = page_table_array(
+            [s.seq if s else None for s in self.slots], ecfg.max_pages_per_seq
+        )
+        allowed = self._build_allowed_mask()
+
+        self.k_pool, self.v_pool, toks = self._decode_fn(
+            self.params, self.k_pool, self.v_pool,
+            jnp.asarray(table), jnp.asarray(last_tokens), jnp.asarray(seq_lens),
+            jnp.asarray(active), jnp.asarray(temps), jnp.asarray(top_ks),
+            jnp.asarray(top_ps), jnp.asarray(seeds), allowed,
+        )
+        toks = np.asarray(toks)
+        self._step_count += 1
+
+        events: List[TokenEvent] = []
+        for i, req in enumerate(self.slots):
+            if req is None:
+                continue
+            req.seq.length += 1  # the last_token's kv was just written
+            events.extend(self._emit(req, int(toks[i])))
+        return events
+
+    def _build_allowed_mask(self) -> Optional[jnp.ndarray]:
+        """Batched constrained-decoding mask, if any slot constrains."""
+        rows = []
+        any_mask = False
+        V = self.cfg.vocab_size
+        for s in self.slots:
+            if s is not None and s.logits_mask_fn is not None:
+                allowed = s.logits_mask_fn(s.output_ids)
+                if allowed is not None:
+                    row = np.zeros(V, bool)
+                    row[np.asarray(allowed, np.int64)] = True
+                    rows.append(row)
+                    any_mask = True
+                    continue
+            rows.append(np.ones(V, bool))
+        if not any_mask:
+            return None
+        return jnp.asarray(np.stack(rows))
+
+    def _emit(self, req: GenRequest, token: int) -> List[TokenEvent]:
+        """Record a sampled token; retire the request if it's done."""
+        req.output_ids.append(token)
+        stop = token in req.stop_token_ids
+        length = len(req.output_ids) >= req.max_new_tokens
+        window = req.seq.length + 1 >= self.ecfg.max_window
+        if stop or length or window:
+            req.state = FINISHED
+            req.finish_reason = "stop" if stop else "length"
+            self._release(req)
+            return [
+                TokenEvent(req.request_id, token, finished=True,
+                           finish_reason=req.finish_reason)
+            ]
+        return [TokenEvent(req.request_id, token)]
+
+    def _release(self, req: GenRequest) -> None:
+        if req.slot >= 0:
+            self.slots[req.slot] = None
+            req.slot = -1
+        if req.seq is not None:
+            self.pool.free_sequence(req.seq)
+            req.seq = None
+        # The caller owns the GenRequest; dropping the registry entry on
+        # retirement keeps a long-lived engine's memory flat.
+        self._requests.pop(req.request_id, None)
+
+    def _preempt_youngest(self) -> None:
+        """Roll the most recent request back to the waiting queue."""
+        cands = [s for s in self.slots if s is not None]
+        if len(cands) <= 1:
+            return
+        self._preempt(max(cands, key=lambda r: r.submit_time))
+
+    def _preempt(self, victim: GenRequest) -> None:
+        logger.warning("preempting %s (out of KV pages)", victim.request_id)
+        self.slots[victim.slot] = None
+        victim.slot = -1
+        self.pool.free_sequence(victim.seq)
+        victim.seq = None
+        # Re-prefill later over prompt + generated-so-far, derived from the
+        # immutable prompt (idempotent across repeated preemptions). The
+        # final output token stays out: its KV was never written (it is the
+        # pending decode input) — the resume prefill's sampled token is
+        # discarded and decode continues from output_ids[-1] (see `resumed`).
+        victim.prefill_ids = victim.prompt_ids + victim.output_ids[:-1]
+        victim.state = WAITING
+        victim.resumed = True
+        self.waiting.insert(0, victim)

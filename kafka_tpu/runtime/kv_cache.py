@@ -1,0 +1,133 @@
+"""Paged KV cache: device-side page pool + host-side allocator.
+
+TPU-first replacement for what the reference outsourced entirely (its KV
+state lived inside remote providers).  Here the KV pool is two device arrays
+[L, num_pages * page_size, Hkv, D]; sequences own ordered lists of physical
+pages.  The host-side allocator is refcounted so pages can be shared between
+sequences — the mechanism behind thread-keyed cache reuse and prefix sharing
+(BASELINE configs 2 and 5).
+
+Page tables, not the pool, are what the jitted step functions consume: a
+[B, max_pages] int32 array per step, from which read/write flat indices are
+derived *on device* (models/llama.py PagedView).  Physical page 0 is
+reserved as the trash page — inactive batch slots point their writes at it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.config import ModelConfig
+
+TRASH_PAGE = 0
+
+
+class OutOfPagesError(RuntimeError):
+    """Raised when the pool cannot satisfy an allocation; the scheduler
+    reacts by preempting or queueing (never a user-facing crash)."""
+
+
+@dataclasses.dataclass
+class SequencePages:
+    """Host-side record of the pages backing one sequence."""
+
+    seq_id: str
+    pages: List[int] = dataclasses.field(default_factory=list)
+    length: int = 0  # tokens currently materialized in the cache
+
+    def capacity(self, page_size: int) -> int:
+        return len(self.pages) * page_size
+
+
+class PagePool:
+    """Refcounted allocator over the physical page axis.
+
+    Device arrays are owned by the engine (they thread through jit); this
+    class only tracks ownership/refcounts on host.
+    """
+
+    def __init__(self, num_pages: int, page_size: int):
+        if num_pages < 2:
+            raise ValueError("need at least 2 pages (page 0 is the trash page)")
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self.refcount = np.zeros(num_pages, dtype=np.int32)
+        self.refcount[TRASH_PAGE] = 1  # never allocated
+        self._free: List[int] = list(range(num_pages - 1, 0, -1))  # stack
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int = 1) -> List[int]:
+        if n > len(self._free):
+            raise OutOfPagesError(f"need {n} pages, have {len(self._free)} free")
+        out = [self._free.pop() for _ in range(n)]
+        for p in out:
+            self.refcount[p] = 1
+        return out
+
+    def retain(self, pages: Sequence[int]) -> None:
+        for p in pages:
+            if p == TRASH_PAGE:
+                continue
+            assert self.refcount[p] > 0, f"retain of unowned page {p}"
+            self.refcount[p] += 1
+
+    def release(self, pages: Sequence[int]) -> None:
+        for p in pages:
+            if p == TRASH_PAGE:
+                continue
+            assert self.refcount[p] > 0, f"double free of page {p}"
+            self.refcount[p] -= 1
+            if self.refcount[p] == 0:
+                self._free.append(p)
+
+    # -- sequence-level helpers ------------------------------------------
+
+    def ensure_capacity(self, seq: SequencePages, new_length: int) -> List[int]:
+        """Grow seq's page list to cover new_length tokens; returns pages added."""
+        needed = -(-new_length // self.page_size)  # ceil
+        added: List[int] = []
+        if needed > len(seq.pages):
+            added = self.alloc(needed - len(seq.pages))
+            seq.pages.extend(added)
+        return added
+
+    def free_sequence(self, seq: SequencePages) -> None:
+        self.release(seq.pages)
+        seq.pages.clear()
+        seq.length = 0
+
+
+def make_kv_pool_arrays(
+    cfg: ModelConfig, num_pages: int, page_size: int, dtype=None
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Allocate the device-side K and V pools."""
+    dtype = dtype or cfg.activation_dtype
+    shape = (cfg.num_layers, num_pages * page_size, cfg.num_kv_heads, cfg.head_dim)
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
+def page_table_array(
+    seqs: Sequence[Optional[SequencePages]], max_pages: int
+) -> np.ndarray:
+    """Stack per-slot page lists into a dense [B, max_pages] int32 table.
+
+    Empty slots (None) and unallocated tail entries point at TRASH_PAGE.
+    """
+    table = np.full((len(seqs), max_pages), TRASH_PAGE, dtype=np.int32)
+    for i, s in enumerate(seqs):
+        if s is None:
+            continue
+        if len(s.pages) > max_pages:
+            raise ValueError(
+                f"sequence {s.seq_id} has {len(s.pages)} pages > table width {max_pages}"
+            )
+        table[i, : len(s.pages)] = s.pages
+    return table

@@ -14,3 +14,10 @@ if "xla_force_host_platform_device_count" not in flags:
     ).strip()
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+# DEFAULT matmul precision runs f32 einsums through a reduced-precision fast
+# path (bf16 passes on TPU MXU, oneDNN on CPU) whose rounding is
+# shape-dependent — decode-vs-full-forward token comparisons then flip on
+# near-tied logits. Tests pin full f32 precision; production keeps DEFAULT.
+import jax  # noqa: E402  (must come after the env setup above)
+
+jax.config.update("jax_default_matmul_precision", "highest")
