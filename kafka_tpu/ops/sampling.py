@@ -14,7 +14,7 @@ from typing import NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-NEG_INF = -1e30
+from .attention import NEG_INF
 
 
 class SamplingParams(NamedTuple):

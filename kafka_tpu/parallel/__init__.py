@@ -1,0 +1,32 @@
+"""Parallelism tier: meshes, sharding rules, context parallelism."""
+
+from .mesh import AXIS_ORDER, MeshConfig, make_mesh, single_device_mesh
+from .ring_attention import (
+    ring_attention,
+    ring_attention_sharded,
+    ulysses_attention,
+    ulysses_attention_sharded,
+)
+from .sharding import (
+    kv_pool_spec,
+    param_specs,
+    replicate,
+    shard_kv_pool,
+    shard_params,
+)
+
+__all__ = [
+    "AXIS_ORDER",
+    "MeshConfig",
+    "make_mesh",
+    "single_device_mesh",
+    "ring_attention",
+    "ring_attention_sharded",
+    "ulysses_attention",
+    "ulysses_attention_sharded",
+    "kv_pool_spec",
+    "param_specs",
+    "replicate",
+    "shard_kv_pool",
+    "shard_params",
+]

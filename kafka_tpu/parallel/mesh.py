@@ -1,0 +1,78 @@
+"""Device mesh construction and axis conventions.
+
+The framework uses one fixed axis vocabulary everywhere (SURVEY §2.2):
+
+  dp  — data parallel: batch-dim sharding of the decode step
+  tp  — tensor parallel: attention heads / MLP hidden, Megatron-style;
+        collectives ride ICI within a slice
+  sp  — sequence/context parallel: activation seq dim (long-context
+        prefill, ring attention)
+  pp  — pipeline parallel: layer stages across DCN-connected slices
+  ep  — expert parallel (MoE): reserved now so meshes are forward-
+        compatible; unused axes are size 1
+
+A mesh is just `jax.sharding.Mesh` over these names; every sharding rule in
+parallel/sharding.py speaks PartitionSpecs over them.  The reference has no
+analog — its "distributed backend" was HTTPS fan-out (SURVEY §5.8); here the
+tensor fabric is XLA collectives over ICI/DCN inserted by GSPMD/shard_map.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXIS_ORDER = ("dp", "pp", "sp", "tp", "ep")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    dp: int = 1
+    pp: int = 1
+    sp: int = 1
+    tp: int = 1
+    ep: int = 1
+
+    @property
+    def total_devices(self) -> int:
+        return self.dp * self.pp * self.sp * self.tp * self.ep
+
+    def axis_sizes(self) -> Tuple[int, ...]:
+        return (self.dp, self.pp, self.sp, self.tp, self.ep)
+
+
+def make_mesh(
+    cfg: MeshConfig, devices: Optional[Sequence[jax.Device]] = None
+) -> Mesh:
+    """Build the named mesh.
+
+    Axis order puts tp innermost (fastest-varying): on real TPU topologies
+    consecutive device ids are ICI neighbors, so tp collectives — the
+    latency-critical ones in the decode step — ride the shortest links,
+    while dp/pp (outermost) tolerate DCN hops across slices.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = cfg.total_devices
+    if n > len(devices):
+        raise ValueError(
+            f"mesh needs {n} devices ({cfg}), only {len(devices)} available"
+        )
+    grid = np.array(devices[:n]).reshape(cfg.axis_sizes())
+    return Mesh(grid, AXIS_ORDER)
+
+
+def single_device_mesh() -> Mesh:
+    """Trivial 1-device mesh so the engine code path is mesh-agnostic."""
+    return make_mesh(MeshConfig())
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, P(*spec))

@@ -1,0 +1,177 @@
+"""Context parallelism for long sequences: ring attention and Ulysses.
+
+Two standard strategies for attention over a sequence sharded across an
+"sp" mesh axis (SURVEY §2.2; required for 32k-context prefill where one
+chip's HBM can't hold the KV):
+
+* **Ring attention** (`ring_attention`): every device keeps its local Q
+  shard and processes the K/V shards of all devices as they rotate around
+  the ring via `lax.ppermute` (ICI neighbor exchange — bandwidth-optimal,
+  compute/comm overlapped by XLA). Softmax is accumulated online
+  (flash-style running max / sum), so no device ever materializes the full
+  [Sq, Skv] score matrix.
+
+* **Ulysses** (`ulysses_attention`): `all_to_all` re-shards activations
+  from sequence-sharded to head-sharded, runs ordinary full-sequence
+  attention locally on each device's head subset, and re-shards back.
+  Cheaper compute bookkeeping than the ring, but needs heads % sp == 0 and
+  all-to-all bandwidth.
+
+Both are written as plain per-shard functions meant to run inside
+`shard_map` over the "sp" axis; `*_sharded` wrappers apply the shard_map
+over a mesh. Numerics are validated against ops.causal_attention on a
+virtual 8-device mesh (tests/test_parallel.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops.attention import NEG_INF, repeat_kv
+
+
+def _block_scores(q, k, q_pos, kv_pos, scale, mask_value=NEG_INF):
+    """Masked attention scores for one block pair. q:[B,Sq,H,D] k:[B,Sk,H,D]."""
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q.astype(jnp.float32) * scale, k.astype(jnp.float32)
+    )
+    mask = q_pos[:, None, :, None] >= kv_pos[:, None, None, :]
+    return jnp.where(mask, s, mask_value)
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    q_positions: jnp.ndarray,
+    kv_positions: jnp.ndarray,
+    axis_name: str = "sp",
+) -> jnp.ndarray:
+    """Causal attention with K/V ring-rotated across `axis_name`.
+
+    Call inside shard_map. Shapes per shard: q/k/v [B, S_local, H(kv), D],
+    positions [B, S_local] (absolute). GQA handled via repeat. Returns
+    attention output [B, S_local, H, D] in q.dtype.
+    """
+    n_rep = q.shape[2] // k.shape[2]
+    k = repeat_kv(k, n_rep)
+    v = repeat_kv(v, n_rep)
+    scale = q.shape[-1] ** -0.5
+    n = lax.psum(1, axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    B, Sq, H, D = q.shape
+    acc = jnp.zeros((B, H, Sq, D), jnp.float32)
+    m = jnp.full((B, H, Sq, 1), -jnp.inf, jnp.float32)
+    l = jnp.zeros((B, H, Sq, 1), jnp.float32)
+    # mark the accumulators as varying over the ring axis so the scan carry
+    # type matches its output (JAX >= 0.9 shard_map vma tracking)
+    acc, m, l = (lax.pcast(x, (axis_name,), to="varying") for x in (acc, m, l))
+
+    def body(carry, _):
+        k_blk, v_blk, kv_pos, acc, m, l = carry
+        # -inf masking + where-guarded exponentials: a block whose every
+        # entry is masked for some query row (common in the causal ring —
+        # early queries vs late kv blocks) must contribute exactly zero,
+        # and the running max must stay -inf until a real score arrives.
+        s = _block_scores(q, k_blk, q_positions, kv_pos, scale, -jnp.inf)
+        blk_max = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, blk_max)
+        safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.where(jnp.isfinite(s), jnp.exp(s - safe_m), 0.0)
+        correction = jnp.where(jnp.isfinite(m), jnp.exp(m - safe_m), 0.0)
+        l = l * correction + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jnp.einsum("bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32))
+        acc = acc * correction + pv
+        m = m_new
+        # rotate kv block (and its positions) to the next ring neighbor
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        kv_pos = lax.ppermute(kv_pos, axis_name, perm)
+        return (k_blk, v_blk, kv_pos, acc, m, l), None
+
+    (k, v, kv_positions, acc, m, l), _ = lax.scan(
+        body, (k, v, kv_positions, acc, m, l), None, length=n
+    )
+    out = acc / jnp.maximum(l, 1e-30)
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)  # [B,Sq,H,D]
+
+
+def ring_attention_sharded(
+    mesh: Mesh,
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    q_positions: jnp.ndarray,
+    kv_positions: jnp.ndarray,
+    axis_name: str = "sp",
+) -> jnp.ndarray:
+    """shard_map wrapper: global [B, S, H, D] inputs sharded on S over sp."""
+    spec_a = P(None, axis_name, None, None)
+    spec_p = P(None, axis_name)
+    fn = jax.shard_map(
+        functools.partial(ring_attention, axis_name=axis_name),
+        mesh=mesh,
+        in_specs=(spec_a, spec_a, spec_a, spec_p, spec_p),
+        out_specs=spec_a,
+    )
+    return fn(q, k, v, q_positions, kv_positions)
+
+
+def ulysses_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    q_positions: jnp.ndarray,
+    axis_name: str = "sp",
+) -> jnp.ndarray:
+    """All-to-all head-scatter attention (per-shard; call inside shard_map).
+
+    Incoming: seq-sharded [B, S_local, H, D] with H full. all_to_all swaps
+    to head-sharded [B, S_global, H_local, D], runs ordinary causal
+    attention over the full sequence, swaps back. Requires H % sp == 0 and
+    equal S shards. GQA: kv heads are repeated up to H before the swap (the
+    simple, always-valid layout; kv-head-aware variants can halve traffic).
+    """
+    n_rep = q.shape[2] // k.shape[2]
+    k = repeat_kv(k, n_rep)
+    v = repeat_kv(v, n_rep)
+
+    def scatter(x):  # [B, S_loc, H, D] -> [B, S_glob, H_loc, D]
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    def gather(x):  # inverse
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+    qh, kh, vh = scatter(q), scatter(k), scatter(v)
+    pos_full = lax.all_gather(q_positions, axis_name, axis=1, tiled=True)
+    scale = qh.shape[-1] ** -0.5
+    s = _block_scores(qh, kh, pos_full, pos_full, scale)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, vh.astype(jnp.float32)).astype(q.dtype)
+    return gather(out)
+
+
+def ulysses_attention_sharded(
+    mesh: Mesh,
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    q_positions: jnp.ndarray,
+    axis_name: str = "sp",
+) -> jnp.ndarray:
+    spec_a = P(None, axis_name, None, None)
+    spec_p = P(None, axis_name)
+    fn = jax.shard_map(
+        functools.partial(ulysses_attention, axis_name=axis_name),
+        mesh=mesh,
+        in_specs=(spec_a, spec_a, spec_a, spec_p),
+        out_specs=spec_a,
+    )
+    return fn(q, k, v, q_positions)

@@ -1,0 +1,92 @@
+"""Sharding rules: map every parameter / engine array to mesh axes.
+
+Megatron-style tensor parallelism expressed as GSPMD PartitionSpecs over the
+stacked-layer param tree (models/llama.py):
+
+  wq  [L, H, Hq, D]   -> heads on tp          (column-parallel)
+  wk  [L, H, Hkv, D]  -> kv heads on tp
+  wv  [L, H, Hkv, D]  -> kv heads on tp
+  wo  [L, Hq, D, H]   -> heads on tp          (row-parallel; XLA inserts the
+                                               all-reduce after the einsum)
+  wg  [L, H, F]       -> F on tp              (column-parallel)
+  wu  [L, H, F]       -> F on tp
+  wd  [L, F, H]       -> F on tp              (row-parallel + all-reduce)
+  embed [V, H]        -> replicated (lookup stays local)
+  lm_head [H, V]      -> V on tp              (logits gathered at the end)
+  norms               -> replicated
+  KV pool [L, S, Hkv, D] -> kv heads on tp    (each chip caches its heads)
+
+The leading L axis carries "pp" when a pipeline axis is used (stage split =
+contiguous layer ranges); kept None here — PP slicing happens above these
+rules, not inside them.
+
+GQA note: tp must divide num_kv_heads for the clean head split. For
+tp > num_kv_heads (e.g. 70B with 8 kv heads on 16-way tp) the standard trick
+is KV-head replication: groups of tp/num_kv_heads chips hold the same kv
+head. Expressed here by capping the kv shard axis when it doesn't divide.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.config import ModelConfig
+
+Params = Dict[str, Any]
+
+
+def _kv_axis(cfg: ModelConfig, mesh: Mesh) -> Optional[str]:
+    """kv-head shard axis, or None (replicate) when tp doesn't divide."""
+    tp = mesh.shape.get("tp", 1)
+    if tp > 1 and cfg.num_kv_heads % tp == 0:
+        return "tp"
+    return None
+
+
+def param_specs(cfg: ModelConfig, mesh: Mesh) -> Params:
+    """PartitionSpec pytree congruent with init_params' tree."""
+    kv = _kv_axis(cfg, mesh)
+    specs: Params = {
+        "embed": P(),
+        "final_norm": P(),
+        "layers": {
+            "ln_attn": P(),
+            "ln_mlp": P(),
+            "wq": P(None, None, "tp", None),
+            "wk": P(None, None, kv, None),
+            "wv": P(None, None, kv, None),
+            "wo": P(None, "tp", None, None),
+            "wg": P(None, None, "tp"),
+            "wu": P(None, None, "tp"),
+            "wd": P(None, "tp", None),
+        },
+    }
+    if not cfg.tie_word_embeddings:
+        specs["lm_head"] = P(None, "tp")
+    return specs
+
+
+def kv_pool_spec(cfg: ModelConfig, mesh: Mesh) -> P:
+    """[L, SLOTS, Hkv, D] pool: cache each chip's kv heads locally."""
+    return P(None, None, _kv_axis(cfg, mesh), None)
+
+
+def shard_params(params: Params, cfg: ModelConfig, mesh: Mesh) -> Params:
+    """Place a param pytree onto the mesh per the TP rules."""
+    specs = param_specs(cfg, mesh)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs
+    )
+
+
+def shard_kv_pool(k_pool, v_pool, cfg: ModelConfig, mesh: Mesh):
+    sh = NamedSharding(mesh, kv_pool_spec(cfg, mesh))
+    return jax.device_put(k_pool, sh), jax.device_put(v_pool, sh)
+
+
+def replicate(tree, mesh: Mesh):
+    sh = NamedSharding(mesh, P())
+    return jax.tree.map(lambda x: jax.device_put(x, sh), tree)

@@ -89,7 +89,8 @@ class GenRequest:
 
     request_id: str
     prompt_ids: List[int]
-    max_new_tokens: int = 512
+    # None -> EngineConfig.max_new_tokens_default is applied at submit()
+    max_new_tokens: Optional[int] = None
     temperature: float = 0.0
     top_k: int = 0
     top_p: float = 1.0
@@ -136,15 +137,30 @@ class InferenceEngine:
         params: Any,
         engine_cfg: Optional[EngineConfig] = None,
         kv_dtype=None,
+        mesh=None,
     ):
+        """mesh: optional jax.sharding.Mesh (parallel/mesh.py). When given,
+        params are placed per the TP rules, the KV pool is head-sharded, and
+        the jitted step programs run SPMD with XLA inserting the collectives
+        (all-reduce after row-parallel einsums, logit gather)."""
         self.cfg = cfg
-        self.params = params
         self.ecfg = engine_cfg or EngineConfig()
+        self.mesh = mesh
         ps = self.ecfg.page_size
         self.pool = PagePool(self.ecfg.num_pages, ps)
-        self.k_pool, self.v_pool = make_kv_pool_arrays(
-            cfg, self.ecfg.num_pages, ps, kv_dtype
-        )
+        k_pool, v_pool = make_kv_pool_arrays(cfg, self.ecfg.num_pages, ps, kv_dtype)
+        if mesh is not None and mesh.size > 1:
+            from ..parallel.sharding import shard_kv_pool, shard_params
+
+            self.params = shard_params(params, cfg, mesh)
+            self.k_pool, self.v_pool = shard_kv_pool(k_pool, v_pool, cfg, mesh)
+            self._replicated = jax.sharding.NamedSharding(
+                mesh, jax.sharding.PartitionSpec()
+            )
+        else:
+            self.params = params
+            self.k_pool, self.v_pool = k_pool, v_pool
+            self._replicated = None
         if self.ecfg.num_pages - 1 < self.ecfg.max_pages_per_seq:
             raise ValueError(
                 "num_pages must exceed max_pages_per_seq: a lone sequence "
@@ -158,6 +174,13 @@ class InferenceEngine:
         self._prefill_fns: Dict[int, Callable] = {}
         self._decode_fn = self._build_decode_fn()
         self._counter = itertools.count()
+
+    def _dev(self, x) -> jnp.ndarray:
+        """Host -> device, replicated across the mesh when one is active."""
+        arr = jnp.asarray(x)
+        if self._replicated is not None:
+            arr = jax.device_put(arr, self._replicated)
+        return arr
 
     # ------------------------------------------------------------------
     # jitted device programs
@@ -259,6 +282,8 @@ class InferenceEngine:
                 f"prompt of {len(req.prompt_ids)} tokens exceeds the "
                 f"attention window ({limit}); compact the conversation first"
             )
+        if req.max_new_tokens is None:
+            req.max_new_tokens = self.ecfg.max_new_tokens_default
         if len(req.prompt_ids) + req.max_new_tokens > limit:
             req.max_new_tokens = max(1, limit - len(req.prompt_ids))
         req.prefill_ids = list(req.prompt_ids)
@@ -350,6 +375,16 @@ class InferenceEngine:
         total = len(prompt)
         self.pool.ensure_capacity(req.seq, total + 1)
 
+        # constrained decoding: the mask depends only on output_ids, which
+        # is constant across prefill chunks — build it once
+        allowed = None
+        if req.logits_mask_fn is not None:
+            allowed_ids = req.logits_mask_fn(req.output_ids)
+            if allowed_ids is not None:
+                row = np.zeros((1, self.cfg.vocab_size), bool)
+                row[0, np.asarray(allowed_ids, np.int64)] = True
+                allowed = self._dev(row)
+
         tok = None
         while start < total:
             remaining = total - start
@@ -363,19 +398,14 @@ class InferenceEngine:
             page_row = np.full(ecfg.max_pages_per_seq, TRASH_PAGE, np.int32)
             page_row[: len(req.seq.pages)] = req.seq.pages
             fn = self._get_prefill_fn(bucket)
-            allowed = None
-            if req.logits_mask_fn is not None:
-                allowed_ids = req.logits_mask_fn(req.output_ids)
-                if allowed_ids is not None:
-                    row = np.zeros((1, self.cfg.vocab_size), bool)
-                    row[0, np.asarray(allowed_ids, np.int64)] = True
-                    allowed = jnp.asarray(row)
             self.k_pool, self.v_pool, tok = fn(
                 self.params, self.k_pool, self.v_pool,
-                jnp.asarray(page_row), jnp.asarray(chunk),
-                jnp.int32(start), jnp.int32(chunk_len),
-                jnp.float32(req.temperature), jnp.int32(req.top_k),
-                jnp.float32(req.top_p), jnp.asarray([req.seed], jnp.uint32),
+                self._dev(page_row), self._dev(chunk),
+                self._dev(np.int32(start)), self._dev(np.int32(chunk_len)),
+                self._dev(np.float32(req.temperature)),
+                self._dev(np.int32(req.top_k)),
+                self._dev(np.float32(req.top_p)),
+                self._dev(np.asarray([req.seed], np.uint32)),
                 allowed,
             )
             start += chunk_len
@@ -439,9 +469,10 @@ class InferenceEngine:
 
         self.k_pool, self.v_pool, toks = self._decode_fn(
             self.params, self.k_pool, self.v_pool,
-            jnp.asarray(table), jnp.asarray(last_tokens), jnp.asarray(seq_lens),
-            jnp.asarray(active), jnp.asarray(temps), jnp.asarray(top_ks),
-            jnp.asarray(top_ps), jnp.asarray(seeds), allowed,
+            self._dev(table), self._dev(last_tokens), self._dev(seq_lens),
+            self._dev(active), self._dev(temps), self._dev(top_ks),
+            self._dev(top_ps), self._dev(seeds),
+            None if allowed is None else self._dev(allowed),
         )
         toks = np.asarray(toks)
         self._step_count += 1
@@ -454,11 +485,17 @@ class InferenceEngine:
             events.extend(self._emit(req, int(toks[i])))
         return events
 
-    def _build_allowed_mask(self) -> Optional[jnp.ndarray]:
-        """Batched constrained-decoding mask, if any slot constrains."""
+    def _build_allowed_mask(self) -> Optional[np.ndarray]:
+        """Batched constrained-decoding mask, if any slot constrains.
+
+        Fast path first: in the common unconstrained case nothing is
+        allocated on the per-token hot path.
+        """
+        if not any(s is not None and s.logits_mask_fn is not None for s in self.slots):
+            return None
+        V = self.cfg.vocab_size
         rows = []
         any_mask = False
-        V = self.cfg.vocab_size
         for s in self.slots:
             if s is not None and s.logits_mask_fn is not None:
                 allowed = s.logits_mask_fn(s.output_ids)
@@ -471,7 +508,7 @@ class InferenceEngine:
             rows.append(np.ones(V, bool))
         if not any_mask:
             return None
-        return jnp.asarray(np.stack(rows))
+        return np.stack(rows)
 
     def _emit(self, req: GenRequest, token: int) -> List[TokenEvent]:
         """Record a sampled token; retire the request if it's done."""

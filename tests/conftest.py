@@ -2,7 +2,12 @@
 
 Forces JAX onto CPU with 8 virtual devices so sharding/mesh tests exercise
 real 8-way SPMD partitioning without TPU hardware (the standard JAX recipe:
---xla_force_host_platform_device_count).  Must run before jax imports.
+--xla_force_host_platform_device_count).
+
+Environment quirk: this machine's sitecustomize registers the "axon" TPU
+PJRT plugin and imports jax before any test code runs, so JAX_PLATFORMS in
+os.environ is read too late — the platform must be overridden through
+jax.config after import (safe while no backend has been initialized yet).
 """
 
 import os
@@ -12,12 +17,13 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 # DEFAULT matmul precision runs f32 einsums through a reduced-precision fast
 # path (bf16 passes on TPU MXU, oneDNN on CPU) whose rounding is
 # shape-dependent — decode-vs-full-forward token comparisons then flip on
 # near-tied logits. Tests pin full f32 precision; production keeps DEFAULT.
-import jax  # noqa: E402  (must come after the env setup above)
-
 jax.config.update("jax_default_matmul_precision", "highest")
