@@ -1,0 +1,124 @@
+"""Benchmark harness: one JSON line for the driver.
+
+Measures, on whatever accelerator jax exposes (one real TPU chip under the
+driver; CPU works for smoke runs):
+
+  * prefill p50 TTFT (128-token prompt -> first sampled token) on the
+    flagship single-chip model (Llama-3.2-1B architecture, bf16, randomly
+    initialised — throughput is weight-value independent),
+  * steady-state continuous-batching decode throughput (batch 8).
+
+The reference publishes no numbers (BASELINE.md: its LLM compute lived
+behind the Portkey HTTPS proxy), so `vs_baseline` is computed against the
+only numeric target on record — BASELINE.json's north star of 200 ms p50
+TTFT — as `200 / measured_ttft_ms` (>1.0 = beating the target).  Decode
+throughput and related stats ride along in "extras".
+
+Usage: python bench.py [--model llama-3.2-1b] [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import sys
+import time
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="llama-3.2-1b")
+    ap.add_argument("--quick", action="store_true",
+                    help="tiny model + short runs (CI smoke)")
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--prompt-len", type=int, default=128)
+    ap.add_argument("--gen-len", type=int, default=256)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from kafka_tpu.models import get_config, init_params
+    from kafka_tpu.runtime import EngineConfig, GenRequest, InferenceEngine
+
+    if args.quick:
+        cfg = get_config("tiny-gqa")
+        args.prompt_len, args.gen_len = 32, 32
+    else:
+        cfg = get_config(args.model)
+    platform = jax.devices()[0].platform
+    print(f"# bench: {cfg.name} on {platform} "
+          f"({len(jax.devices())} device(s))", file=sys.stderr)
+
+    t0 = time.monotonic()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    jax.block_until_ready(params)
+    print(f"# params init: {time.monotonic() - t0:.1f}s", file=sys.stderr)
+
+    ecfg = EngineConfig(
+        max_batch=args.batch,
+        page_size=16,
+        max_pages_per_seq=max(
+            2, -(-(args.prompt_len + args.gen_len + 16) // 16)
+        ),
+    )
+    ecfg.num_pages = args.batch * ecfg.max_pages_per_seq + 1
+    engine = InferenceEngine(cfg, params, ecfg)
+
+    rng = __import__("random").Random(0)
+    def prompt():
+        return [rng.randrange(4, cfg.vocab_size - 4)
+                for _ in range(args.prompt_len)]
+
+    # ---- warmup: compile prefill bucket + decode step --------------------
+    t0 = time.monotonic()
+    engine.generate(prompt(), max_new_tokens=4)
+    print(f"# warmup/compile: {time.monotonic() - t0:.1f}s", file=sys.stderr)
+
+    # ---- TTFT: prompt submit -> first token, solo requests ---------------
+    ttfts = []
+    for _ in range(5 if args.quick else 10):
+        req = engine.generate(prompt(), max_new_tokens=1)
+        ttfts.append((req.first_token_time - req.submit_time) * 1e3)
+    ttft_p50 = statistics.median(ttfts)
+
+    # ---- decode throughput: full batch, steady state ---------------------
+    reqs = []
+    for i in range(args.batch):
+        r = GenRequest(request_id=f"bench-{i}", prompt_ids=prompt(),
+                       max_new_tokens=args.gen_len)
+        engine.submit(r)
+        reqs.append(r)
+    while engine.num_active < args.batch:  # admit everyone (prefill)
+        engine.step()
+    t0 = time.monotonic()
+    tokens = 0
+    while engine.has_work:
+        for ev in engine.step():
+            if ev.token_id is not None:
+                tokens += 1
+    wall = time.monotonic() - t0
+    decode_tps = tokens / wall
+
+    result = {
+        "metric": f"p50_ttft_ms_{cfg.name}_prefill{args.prompt_len}_1chip",
+        "value": round(ttft_p50, 2),
+        "unit": "ms",
+        "vs_baseline": round(200.0 / ttft_p50, 3),
+        "extras": {
+            "decode_tokens_per_sec_per_chip": round(decode_tps, 1),
+            "decode_batch": args.batch,
+            "gen_len": args.gen_len,
+            "ttft_all_ms": [round(t, 2) for t in ttfts],
+            "platform": platform,
+            "model": cfg.name,
+            "note": ("vs_baseline = 200ms north-star TTFT / measured p50 "
+                     "(reference publishes no numbers, BASELINE.md)"),
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

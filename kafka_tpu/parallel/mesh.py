@@ -24,7 +24,7 @@ from typing import Optional, Sequence, Tuple
 
 import jax
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 AXIS_ORDER = ("dp", "pp", "sp", "tp", "ep")
 
@@ -70,9 +70,3 @@ def single_device_mesh() -> Mesh:
     return make_mesh(MeshConfig())
 
 
-def replicated(mesh: Mesh) -> NamedSharding:
-    return NamedSharding(mesh, P())
-
-
-def shard(mesh: Mesh, *spec) -> NamedSharding:
-    return NamedSharding(mesh, P(*spec))

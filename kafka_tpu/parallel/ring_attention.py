@@ -59,9 +59,10 @@ def ring_attention(
     positions [B, S_local] (absolute). GQA handled via repeat. Returns
     attention output [B, S_local, H, D] in q.dtype.
     """
+    # GQA expansion happens per-block inside the loop: the ring rotates the
+    # compact Hkv tensors and each device re-expands locally, so ppermute
+    # (ICI) traffic is 1/n_rep of rotating the expanded heads.
     n_rep = q.shape[2] // k.shape[2]
-    k = repeat_kv(k, n_rep)
-    v = repeat_kv(v, n_rep)
     scale = q.shape[-1] ** -0.5
     n = lax.psum(1, axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
@@ -80,14 +81,16 @@ def ring_attention(
         # entry is masked for some query row (common in the causal ring —
         # early queries vs late kv blocks) must contribute exactly zero,
         # and the running max must stay -inf until a real score arrives.
-        s = _block_scores(q, k_blk, q_positions, kv_pos, scale, -jnp.inf)
+        k_rep = repeat_kv(k_blk, n_rep)
+        v_rep = repeat_kv(v_blk, n_rep)
+        s = _block_scores(q, k_rep, q_positions, kv_pos, scale, -jnp.inf)
         blk_max = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m, blk_max)
         safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
         p = jnp.where(jnp.isfinite(s), jnp.exp(s - safe_m), 0.0)
         correction = jnp.where(jnp.isfinite(m), jnp.exp(m - safe_m), 0.0)
         l = l * correction + jnp.sum(p, axis=-1, keepdims=True)
-        pv = jnp.einsum("bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32))
+        pv = jnp.einsum("bhqk,bkhd->bhqd", p, v_rep.astype(jnp.float32))
         acc = acc * correction + pv
         m = m_new
         # rotate kv block (and its positions) to the next ring neighbor

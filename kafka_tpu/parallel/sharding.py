@@ -20,10 +20,12 @@ The leading L axis carries "pp" when a pipeline axis is used (stage split =
 contiguous layer ranges); kept None here — PP slicing happens above these
 rules, not inside them.
 
-GQA note: tp must divide num_kv_heads for the clean head split. For
-tp > num_kv_heads (e.g. 70B with 8 kv heads on 16-way tp) the standard trick
-is KV-head replication: groups of tp/num_kv_heads chips hold the same kv
-head. Expressed here by capping the kv shard axis when it doesn't divide.
+GQA note: tp must divide num_kv_heads for the clean head split. When it
+does not (e.g. 70B with 8 kv heads on 16-way tp), the fallback here is FULL
+replication of kv params and the KV pool on every chip (`_kv_axis` -> None)
+— simple and correct, but per-chip KV memory is num_kv_heads/ceil(kv/tp)
+times the grouped-replication layout (groups of tp/num_kv_heads chips
+sharing one head), which is the upgrade path if 70B HBM budgets demand it.
 """
 
 from __future__ import annotations
