@@ -1,0 +1,22 @@
+"""LLM provider tier: ABC + the TPU-engine-backed provider.
+
+The reference's provider tier proxied a remote gateway
+(src/llm/portkey.py); here the provider IS the engine — requests flow into
+the continuous-batching scheduler on a dispatch thread and stream back as
+per-token chunks.
+"""
+
+from .base import LLMProvider, to_message_dicts
+from .tpu_provider import IncrementalDetokenizer, TPULLMProvider
+from .utils import infer_provider_from_model, prune_images
+from .worker import EngineWorker
+
+__all__ = [
+    "EngineWorker",
+    "IncrementalDetokenizer",
+    "LLMProvider",
+    "TPULLMProvider",
+    "infer_provider_from_model",
+    "prune_images",
+    "to_message_dicts",
+]

@@ -1,0 +1,165 @@
+"""LLM provider abstraction: streaming-first, tool-aware.
+
+Capability parity with the reference provider ABC
+(reference: src/llm/base.py:67-312 — `stream_completion`, `completion`,
+`validate_messages`, `get_model_info`), async-first like the reference.
+The central difference: implementations here are expected to be *local*
+(the TPU engine), so errors like context overflow are typed and raised
+pre-flight instead of string-matched out of a remote gateway's response.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, AsyncIterator, Dict, List, Optional, Sequence, Union
+
+from ..core.types import (
+    CompletionResponse,
+    LLMProviderError,
+    Message,
+    StreamChunk,
+)
+
+MessageLike = Union[Message, Dict[str, Any]]
+
+VALID_ROLES = {"system", "user", "assistant", "tool", "developer"}
+
+
+def to_message_dicts(messages: Sequence[MessageLike]) -> List[Dict[str, Any]]:
+    """Normalize a mixed Message/dict list to OpenAI-wire dicts."""
+    out: List[Dict[str, Any]] = []
+    for m in messages:
+        out.append(m.to_dict() if isinstance(m, Message) else dict(m))
+    return out
+
+
+class LLMProvider(abc.ABC):
+    """Abstract LLM provider.
+
+    Implementations must provide `stream_completion`; `completion` has a
+    default implementation that drains the stream (mirroring how the
+    reference agent always streams internally, src/agents/base.py:222).
+    """
+
+    #: provider family name, used in error messages and routing
+    provider_name: str = "base"
+
+    @abc.abstractmethod
+    def stream_completion(
+        self,
+        messages: Sequence[MessageLike],
+        model: Optional[str] = None,
+        temperature: float = 0.7,
+        max_tokens: Optional[int] = None,
+        tools: Optional[List[Dict[str, Any]]] = None,
+        **kwargs: Any,
+    ) -> AsyncIterator[StreamChunk]:
+        """Stream a chat completion as incremental `StreamChunk`s.
+
+        Must yield a first chunk carrying `role="assistant"`, then content /
+        tool-call deltas, then exactly one final chunk with `finish_reason`
+        set (and `usage` populated, which the reference could not do on
+        streaming paths — src/kafka/types.py:93-97 returned zeros).
+        """
+        raise NotImplementedError
+
+    async def completion(
+        self,
+        messages: Sequence[MessageLike],
+        model: Optional[str] = None,
+        temperature: float = 0.7,
+        max_tokens: Optional[int] = None,
+        tools: Optional[List[Dict[str, Any]]] = None,
+        **kwargs: Any,
+    ) -> CompletionResponse:
+        """Non-streaming completion; default drains `stream_completion`."""
+        from ..core.toolcalls import ToolCallAccumulator
+
+        content_parts: List[str] = []
+        acc = ToolCallAccumulator()
+        finish_reason: Optional[str] = None
+        usage: Optional[Dict[str, int]] = None
+        resp_model: Optional[str] = model
+        resp_id: Optional[str] = None
+        async for chunk in self.stream_completion(
+            messages,
+            model=model,
+            temperature=temperature,
+            max_tokens=max_tokens,
+            tools=tools,
+            **kwargs,
+        ):
+            if chunk.content:
+                content_parts.append(chunk.content)
+            acc.add_deltas(chunk.tool_calls)
+            if chunk.finish_reason is not None:
+                finish_reason = chunk.finish_reason
+            if chunk.usage is not None:
+                usage = chunk.usage
+            if chunk.model:
+                resp_model = chunk.model
+            if chunk.id:
+                resp_id = chunk.id
+        tool_calls = acc.result() if acc.has_calls else None
+        return CompletionResponse(
+            content="".join(content_parts) if content_parts else None,
+            role="assistant",
+            finish_reason=finish_reason or "stop",
+            model=resp_model,
+            id=resp_id,
+            usage=usage,
+            tool_calls=tool_calls,
+        )
+
+    # ------------------------------------------------------------------
+
+    def validate_messages(self, messages: Sequence[MessageLike]) -> None:
+        """Structural validation before hitting the engine.
+
+        Parity: reference src/llm/base.py:221-312 (role checks, tool linkage).
+        Raises LLMProviderError on the first violation.
+        """
+        if not messages:
+            raise LLMProviderError(
+                "messages must not be empty", provider=self.provider_name
+            )
+        dicts = to_message_dicts(messages)
+        open_ids: set = set()
+        for i, m in enumerate(dicts):
+            role = m.get("role")
+            if role not in VALID_ROLES:
+                raise LLMProviderError(
+                    f"message {i}: invalid role {role!r}",
+                    provider=self.provider_name,
+                )
+            if role == "tool":
+                tcid = m.get("tool_call_id")
+                if not tcid:
+                    raise LLMProviderError(
+                        f"message {i}: tool message missing tool_call_id",
+                        provider=self.provider_name,
+                    )
+                if tcid not in open_ids:
+                    raise LLMProviderError(
+                        f"message {i}: tool message answers unknown "
+                        f"tool_call_id {tcid!r} (sanitize history first)",
+                        provider=self.provider_name,
+                    )
+                open_ids.discard(tcid)
+            elif role == "assistant" and m.get("tool_calls"):
+                open_ids = {
+                    tc.get("id") for tc in m["tool_calls"] if tc.get("id")
+                }
+            else:
+                open_ids = set()
+
+    def get_model_info(self, model: Optional[str] = None) -> Dict[str, Any]:
+        """Metadata about a served model (id, context window, provider)."""
+        return {"id": model, "provider": self.provider_name}
+
+    def get_available_models(self) -> List[Dict[str, Any]]:
+        """List models this provider can serve (for GET /v1/models)."""
+        return []
+
+    async def aclose(self) -> None:
+        """Release resources (dispatch threads, device memory refs)."""

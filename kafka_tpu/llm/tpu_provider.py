@@ -1,0 +1,298 @@
+"""`TPULLMProvider` — the LLMProvider served by the local TPU engine.
+
+This is the component that replaces the reference's remote gateway provider
+(reference: src/llm/portkey.py:62-701, an HTTPS proxy to provider GPUs).
+Requests go straight into the continuous-batching engine via the dispatch
+thread (llm/worker.py) and tokens stream back per-request with no network
+in the loop.
+
+Differences from the reference, by design:
+
+* **Pre-flight context checking.** The engine tokenizes locally, so context
+  overflow raises a typed `ContextLengthError` *before* any compute — the
+  reference could only string-match a remote 400 after the fact
+  (src/llm/context_compaction/base.py:10-65).
+* **True per-token streaming.** Chunks are yielded as the decode loop emits
+  tokens (the reference buffered whole completions, src/agents/base.py:231).
+* **Real usage accounting** on every path, including streaming.
+* **Native tool-call decoding.** Generated text that opens a JSON object or
+  array is buffered and parsed into OpenAI tool_calls; plain text streams
+  through immediately.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import json
+import logging
+import time
+from typing import Any, AsyncIterator, Dict, List, Optional, Sequence
+
+from ..core.types import (
+    CompletionResponse,
+    ContextLengthError,
+    LLMProviderError,
+    StreamChunk,
+    Usage,
+    new_completion_id,
+)
+from ..models.config import ModelConfig
+from ..models.tokenizer import BaseTokenizer, parse_tool_call_text
+from ..runtime.engine import GenRequest, InferenceEngine, TokenEvent
+from .base import LLMProvider, MessageLike, to_message_dicts
+from .utils import prune_images
+from .worker import EngineWorker
+
+logger = logging.getLogger("kafka_tpu.llm.tpu")
+
+
+class IncrementalDetokenizer:
+    """Streams token ids to text without re-decoding the whole output.
+
+    Standard two-offset scheme: hold back the tail while it decodes to an
+    incomplete UTF-8 sequence (replacement char), emit once it stabilizes.
+    """
+
+    def __init__(self, tokenizer: BaseTokenizer):
+        self._tok = tokenizer
+        self._ids: List[int] = []
+        # decode window: [prefix, read) is already-emitted context kept so
+        # tokenizers whose decode depends on neighbors (sentencepiece space
+        # handling) produce stable text; [read, end) is pending.
+        self._prefix = 0
+        self._read = 0
+
+    def push(self, token_id: int) -> str:
+        self._ids.append(token_id)
+        emitted = self._tok.decode(self._ids[self._prefix : self._read])
+        full = self._tok.decode(self._ids[self._prefix :])
+        if len(full) > len(emitted) and not full.endswith("�"):
+            delta = full[len(emitted) :]
+            self._prefix = self._read
+            self._read = len(self._ids)
+            return delta
+        return ""
+
+    def flush(self) -> str:
+        """Emit whatever remains (end of stream), replacement chars and all."""
+        emitted = self._tok.decode(self._ids[self._prefix : self._read])
+        full = self._tok.decode(self._ids[self._prefix :])
+        self._read = self._prefix = len(self._ids)
+        return full[len(emitted) :] if len(full) > len(emitted) else ""
+
+    @property
+    def ids(self) -> List[int]:
+        return self._ids
+
+
+class TPULLMProvider(LLMProvider):
+    """Serves chat completions from the in-process TPU engine."""
+
+    provider_name = "tpu"
+
+    def __init__(
+        self,
+        engine: InferenceEngine,
+        tokenizer: BaseTokenizer,
+        model_name: str = "llama",
+        worker: Optional[EngineWorker] = None,
+        max_images: int = 19,
+    ):
+        self.engine = engine
+        self.tokenizer = tokenizer
+        self.model_name = model_name
+        self.worker = worker or EngineWorker(engine)
+        self.worker.start()
+        self.max_images = max_images
+        self._counter = itertools.count()
+
+    # ------------------------------------------------------------------
+
+    @property
+    def model_cfg(self) -> ModelConfig:
+        return self.engine.cfg
+
+    def count_prompt_tokens(
+        self,
+        messages: Sequence[MessageLike],
+        tools: Optional[List[Dict[str, Any]]] = None,
+    ) -> int:
+        """Token count of the rendered prompt (compaction pre-flight)."""
+        dicts = to_message_dicts(messages)
+        return len(self.tokenizer.encode_chat(dicts, tools=tools))
+
+    @property
+    def max_prompt_tokens(self) -> int:
+        """Largest admissible prompt (engine window, minus 1 for decode)."""
+        return min(self.engine.ecfg.max_window, self.model_cfg.max_context) - 1
+
+    def get_model_info(self, model: Optional[str] = None) -> Dict[str, Any]:
+        return {
+            "id": model or self.model_name,
+            "provider": self.provider_name,
+            "max_context": self.model_cfg.max_context,
+            "max_window": self.engine.ecfg.max_window,
+            "vocab_size": self.model_cfg.vocab_size,
+            "supports_tools": True,
+            "supports_streaming": True,
+        }
+
+    def get_available_models(self) -> List[Dict[str, Any]]:
+        return [
+            {
+                "id": self.model_name,
+                "object": "model",
+                "owned_by": "kafka-tpu",
+                "created": 0,
+            }
+        ]
+
+    # ------------------------------------------------------------------
+
+    async def stream_completion(
+        self,
+        messages: Sequence[MessageLike],
+        model: Optional[str] = None,
+        temperature: float = 0.7,
+        max_tokens: Optional[int] = None,
+        tools: Optional[List[Dict[str, Any]]] = None,
+        top_p: float = 1.0,
+        top_k: int = 0,
+        seed: Optional[int] = None,
+        logits_mask_fn=None,
+        **kwargs: Any,
+    ) -> AsyncIterator[StreamChunk]:
+        self.validate_messages(messages)
+        dicts = prune_images(to_message_dicts(messages), self.max_images)
+        prompt_ids = self.tokenizer.encode_chat(dicts, tools=tools)
+        if len(prompt_ids) > self.max_prompt_tokens:
+            raise ContextLengthError(
+                len(prompt_ids), self.max_prompt_tokens, self.provider_name
+            )
+
+        completion_id = new_completion_id()
+        model_id = model or self.model_name
+        req = GenRequest(
+            request_id=f"{completion_id}-{next(self._counter)}",
+            prompt_ids=prompt_ids,
+            max_new_tokens=max_tokens,
+            temperature=temperature,
+            top_k=top_k,
+            top_p=top_p,
+            seed=seed if seed is not None else 0,
+            stop_token_ids=tuple(self.tokenizer.stop_ids),
+            logits_mask_fn=logits_mask_fn,
+        )
+        loop = asyncio.get_running_loop()
+        events = self.worker.submit(req, loop)
+
+        # role header first (OpenAI convention)
+        yield StreamChunk(role="assistant", id=completion_id, model=model_id)
+
+        detok = IncrementalDetokenizer(self.tokenizer)
+        # tool-call detection: undecided until the first non-space char;
+        # "{" / "[" switches to buffering mode, anything else streams.
+        mode = "undecided"
+        buffered: List[str] = []
+        n_tokens = 0
+        try:
+            while True:
+                ev: TokenEvent = await events.get()
+                if ev.finish_reason and ev.finish_reason.startswith("error:"):
+                    raise LLMProviderError(
+                        ev.finish_reason[len("error:") :],
+                        provider=self.provider_name,
+                    )
+                if ev.finish_reason == "cancelled":
+                    raise asyncio.CancelledError("generation cancelled")
+                text = ""
+                if ev.token_id is not None:
+                    n_tokens += 1
+                    text = detok.push(ev.token_id)
+                if ev.finished:
+                    text += detok.flush()
+                if text:
+                    if mode == "undecided":
+                        probe = ("".join(buffered) + text).lstrip()
+                        if not probe:
+                            buffered.append(text)
+                        elif probe[0] in "[{":
+                            mode = "tool"
+                            buffered.append(text)
+                        else:
+                            mode = "text"
+                            pending = "".join(buffered) + text
+                            buffered = []
+                            yield StreamChunk(
+                                content=pending, id=completion_id, model=model_id
+                            )
+                    elif mode == "tool":
+                        buffered.append(text)
+                    else:
+                        yield StreamChunk(
+                            content=text, id=completion_id, model=model_id
+                        )
+                if ev.finished:
+                    final = self._finalize(
+                        mode, buffered, ev, completion_id, model_id,
+                        len(prompt_ids), n_tokens,
+                    )
+                    for chunk in final:
+                        yield chunk
+                    return
+        finally:
+            if req.state != "finished":
+                self.worker.cancel(req.request_id)
+
+    def _finalize(
+        self,
+        mode: str,
+        buffered: List[str],
+        ev: TokenEvent,
+        completion_id: str,
+        model_id: str,
+        prompt_tokens: int,
+        completion_tokens: int,
+    ) -> List[StreamChunk]:
+        """Terminal chunks: flush buffers, resolve tool calls, report usage."""
+        chunks: List[StreamChunk] = []
+        finish = ev.finish_reason or "stop"
+        text = "".join(buffered)
+        tool_calls = parse_tool_call_text(text) if mode == "tool" else None
+        if tool_calls:
+            deltas = [
+                {
+                    "index": i,
+                    "id": tc["id"],
+                    "type": "function",
+                    "function": tc["function"],
+                }
+                for i, tc in enumerate(tool_calls)
+            ]
+            chunks.append(
+                StreamChunk(tool_calls=deltas, id=completion_id, model=model_id)
+            )
+            finish = "tool_calls"
+        elif text:
+            # buffered text that didn't parse as a tool call: emit verbatim
+            chunks.append(
+                StreamChunk(content=text, id=completion_id, model=model_id)
+            )
+        usage = Usage(
+            prompt_tokens=prompt_tokens,
+            completion_tokens=completion_tokens,
+            total_tokens=prompt_tokens + completion_tokens,
+        )
+        chunks.append(
+            StreamChunk(
+                finish_reason=finish,
+                id=completion_id,
+                model=model_id,
+                usage=usage.to_dict(),
+            )
+        )
+        return chunks
+
+    async def aclose(self) -> None:
+        self.worker.stop()

@@ -1,0 +1,178 @@
+"""Engine dispatch thread: bridges the synchronous TPU step loop to asyncio.
+
+The InferenceEngine (runtime/engine.py) is synchronous by design — one
+thread owns the device and runs admit/decode/retire steps.  The serving
+layer is asyncio (like the reference's uvicorn event loop).  This module is
+the seam: a single daemon thread drives the engine continuously while
+requests and token events cross thread boundaries through queues.
+
+Design (SURVEY §2.2 "host-side dispatch thread feeding the device loop"):
+
+* `submit()` (any asyncio loop) → thread-safe inbox queue → picked up at the
+  top of each engine step.
+* Engine `TokenEvent`s → `loop.call_soon_threadsafe(asyncio.Queue.put_nowait)`
+  into the per-request event queue, so each request's consumer wakes on its
+  own loop with no polling.
+* When idle, the thread blocks on the inbox (zero busy-wait); when active it
+  drains the inbox without blocking between decode steps.
+
+The single-writer design means engine state needs no locks — the dispatch
+thread is the only mutator (SURVEY §5.2: the reference's hand-rolled
+concurrency gaps are removed by construction).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Tuple
+
+from ..runtime.engine import GenRequest, InferenceEngine, TokenEvent
+
+logger = logging.getLogger("kafka_tpu.llm.worker")
+
+
+@dataclass
+class _Route:
+    loop: asyncio.AbstractEventLoop
+    events: "asyncio.Queue[TokenEvent]"
+    # backpressure: tokens queued but not yet consumed (approximate)
+    dropped: bool = field(default=False)
+
+
+class EngineWorker:
+    """Owns the engine thread; routes token events to per-request queues."""
+
+    def __init__(self, engine: InferenceEngine):
+        self.engine = engine
+        self._inbox: "queue.Queue[Tuple[str, object]]" = queue.Queue()
+        self._routes: Dict[str, _Route] = {}
+        self._thread: Optional[threading.Thread] = None
+        self._stopped = threading.Event()
+        self._lock = threading.Lock()  # guards _routes (submit vs dispatch)
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> "EngineWorker":
+        if self._thread is not None:
+            return self
+        self._thread = threading.Thread(
+            target=self._run, name="kafka-tpu-engine", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 5.0) -> None:
+        if self._thread is None:
+            return
+        self._stopped.set()
+        self._inbox.put(("__wake__", None))
+        self._thread.join(timeout=timeout)
+        self._thread = None
+
+    @property
+    def alive(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    # -- request API (called from asyncio) -----------------------------
+
+    def submit(
+        self, req: GenRequest, loop: asyncio.AbstractEventLoop
+    ) -> "asyncio.Queue[TokenEvent]":
+        """Enqueue a request; returns the asyncio queue its events land on."""
+        if self._stopped.is_set():
+            raise RuntimeError("engine worker is stopped")
+        events: "asyncio.Queue[TokenEvent]" = asyncio.Queue()
+        with self._lock:
+            self._routes[req.request_id] = _Route(loop=loop, events=events)
+        self._inbox.put(("submit", req))
+        return events
+
+    def cancel(self, request_id: str) -> None:
+        """Abort a request from the serving side (client disconnect)."""
+        self._inbox.put(("cancel", request_id))
+
+    # -- engine thread -------------------------------------------------
+
+    def _run(self) -> None:
+        logger.info("engine worker started")
+        while not self._stopped.is_set():
+            # Block when idle; drain without blocking when active.
+            block = not self.engine.has_work
+            try:
+                kind, payload = self._inbox.get(block=block, timeout=1.0 if block else None)
+                self._handle(kind, payload)
+                # drain any further queued commands
+                while True:
+                    try:
+                        kind, payload = self._inbox.get_nowait()
+                    except queue.Empty:
+                        break
+                    self._handle(kind, payload)
+            except queue.Empty:
+                pass
+            if self._stopped.is_set():
+                break
+            if not self.engine.has_work:
+                continue
+            try:
+                events = self.engine.step()
+            except Exception:
+                logger.exception("engine step failed; failing active requests")
+                events = self._fail_all()
+            for ev in events:
+                self._dispatch(ev)
+        logger.info("engine worker stopped")
+
+    def _handle(self, kind: str, payload: object) -> None:
+        if kind == "submit":
+            try:
+                self.engine.submit(payload)  # type: ignore[arg-type]
+            except Exception as e:  # surfaced to the consumer as an error event
+                req: GenRequest = payload  # type: ignore[assignment]
+                logger.warning("submit rejected for %s: %s", req.request_id, e)
+                self._dispatch(
+                    TokenEvent(
+                        req.request_id, None, finished=True,
+                        finish_reason=f"error:{e}",
+                    )
+                )
+        elif kind == "cancel":
+            rid: str = payload  # type: ignore[assignment]
+            if self.engine.cancel(rid):
+                self._dispatch(
+                    TokenEvent(rid, None, finished=True, finish_reason="cancelled")
+                )
+            else:
+                # request unknown/already done: just drop the route
+                with self._lock:
+                    self._routes.pop(rid, None)
+
+    def _fail_all(self):
+        """Device-step failure: every in-flight request gets a terminal event."""
+        events = []
+        for rid in list(self.engine._requests):
+            self.engine.cancel(rid)
+            events.append(
+                TokenEvent(rid, None, finished=True, finish_reason="error:engine")
+            )
+        return events
+
+    def _dispatch(self, ev: TokenEvent) -> None:
+        with self._lock:
+            route = self._routes.get(ev.request_id)
+            if ev.finished:
+                self._routes.pop(ev.request_id, None)
+        if route is None:
+            return
+        try:
+            route.loop.call_soon_threadsafe(route.events.put_nowait, ev)
+        except RuntimeError:
+            # consumer loop is gone (shutdown): cancel the request so the
+            # engine doesn't decode into the void
+            if not ev.finished and not route.dropped:
+                route.dropped = True
+                self._inbox.put(("cancel", ev.request_id))

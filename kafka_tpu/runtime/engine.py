@@ -292,6 +292,26 @@ class InferenceEngine:
         self.waiting.append(req)
         self._requests[req.request_id] = req
 
+    def cancel(self, request_id: str) -> bool:
+        """Abort a request (client disconnect); frees its slot and pages.
+
+        Must run on the thread that drives `step()` (the engine is
+        single-writer; EngineWorker routes cancels through its inbox for
+        this reason). Returns False for unknown/already-finished ids.
+        """
+        req = self._requests.get(request_id)
+        if req is None or req.state == FINISHED:
+            return False
+        if req.state == WAITING:
+            try:
+                self.waiting.remove(req)
+            except ValueError:
+                pass
+        req.state = FINISHED
+        req.finish_reason = "cancelled"
+        self._release(req)
+        return True
+
     @property
     def num_active(self) -> int:
         return sum(1 for s in self.slots if s is not None)

@@ -1,0 +1,211 @@
+"""Compaction tests: classifier, tool-pair-safe splitting, structural
+validation, truncation and summarization strategies (with a fake LLM)."""
+
+import asyncio
+
+import pytest
+
+from kafka_tpu.core.types import (
+    CompletionResponse,
+    ContextLengthError,
+    LLMProviderError,
+)
+from kafka_tpu.llm.base import LLMProvider
+from kafka_tpu.llm.compaction import (
+    SummarizationCompactionProvider,
+    TruncationCompactionProvider,
+    find_safe_split_point,
+    is_context_length_error,
+    validate_message_structure,
+)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class FakeLLM(LLMProvider):
+    """Scripted provider for compaction tests (SURVEY §4 FakeLLMProvider)."""
+
+    provider_name = "fake"
+
+    def __init__(self, summary="SUMMARY", fail=False):
+        self.summary = summary
+        self.fail = fail
+        self.calls = []
+
+    async def stream_completion(self, messages, **kw):  # pragma: no cover
+        raise NotImplementedError
+        yield
+
+    async def completion(self, messages, **kw):
+        self.calls.append(messages)
+        if self.fail:
+            raise LLMProviderError("boom", provider="fake")
+        return CompletionResponse(content=self.summary, finish_reason="stop")
+
+
+def tool_call_msg(ids):
+    return {
+        "role": "assistant",
+        "tool_calls": [
+            {"id": i, "type": "function",
+             "function": {"name": "t", "arguments": "{}"}}
+            for i in ids
+        ],
+    }
+
+
+def tool_result(i):
+    return {"role": "tool", "tool_call_id": i, "content": "r"}
+
+
+class TestClassifier:
+    def test_typed_error(self):
+        assert is_context_length_error(ContextLengthError(100, 50))
+
+    @pytest.mark.parametrize("text", [
+        "Error code: 400 - context_length_exceeded",
+        "prompt is too long: 20000 tokens > 16384 maximum",
+        "input is too long for requested model",
+        "This model's maximum context length is 8192 tokens",
+    ])
+    def test_string_patterns(self, text):
+        assert is_context_length_error(RuntimeError(text))
+
+    def test_negative(self):
+        assert not is_context_length_error(RuntimeError("rate limited"))
+
+
+class TestSafeSplit:
+    def test_plain_messages_split_at_target(self):
+        msgs = [{"role": "user", "content": str(i)} for i in range(10)]
+        assert find_safe_split_point(msgs, 5) == 5
+
+    def test_never_orphans_tool_results(self):
+        msgs = [
+            {"role": "user", "content": "q"},
+            tool_call_msg(["a"]),
+            tool_result("a"),
+            {"role": "assistant", "content": "done"},
+        ]
+        # target=2 would keep the result but summarize its call
+        s = find_safe_split_point(msgs, 2)
+        assert s <= 1
+        # target=1 would split between assistant-with-calls... also unsafe
+        assert find_safe_split_point(msgs, 2) in (0, 1)
+        # splitting after the full pair is fine
+        assert find_safe_split_point(msgs, 3) == 3
+
+    def test_multi_result_pair(self):
+        msgs = [
+            tool_call_msg(["a", "b"]),
+            tool_result("a"),
+            tool_result("b"),
+            {"role": "user", "content": "next"},
+        ]
+        assert find_safe_split_point(msgs, 1) == 0
+        assert find_safe_split_point(msgs, 2) == 0
+        assert find_safe_split_point(msgs, 3) == 3
+
+    def test_bounds(self):
+        assert find_safe_split_point([], 5) == 0
+        msgs = [{"role": "user", "content": "x"}]
+        assert find_safe_split_point(msgs, 99) == 1
+
+
+class TestValidate:
+    def test_drops_orphan_tool_results(self):
+        msgs = [
+            tool_result("ghost"),
+            {"role": "user", "content": "hi"},
+        ]
+        out = validate_message_structure(msgs)
+        assert [m["role"] for m in out] == ["user"]
+
+    def test_drops_empty_assistant(self):
+        msgs = [
+            {"role": "user", "content": "hi"},
+            {"role": "assistant", "content": None},
+            {"role": "assistant", "content": "ok"},
+        ]
+        out = validate_message_structure(msgs)
+        assert len(out) == 2
+
+    def test_keeps_valid_pairs(self):
+        msgs = [
+            {"role": "user", "content": "q"},
+            tool_call_msg(["a"]),
+            tool_result("a"),
+        ]
+        assert validate_message_structure(msgs) == msgs
+
+
+class TestTruncation:
+    def test_keeps_system_and_tail(self):
+        msgs = [{"role": "system", "content": "sys"}] + [
+            {"role": "user", "content": str(i)} for i in range(100)
+        ]
+        out = run(TruncationCompactionProvider(keep_last=10).compact(msgs))
+        assert out[0]["role"] == "system"
+        assert len(out) == 11
+        assert out[-1]["content"] == "99"
+
+    def test_noop_when_short(self):
+        msgs = [{"role": "user", "content": "hi"}]
+        assert run(TruncationCompactionProvider().compact(msgs)) == msgs
+
+
+class TestSummarization:
+    def make_convo(self, n=20):
+        return [{"role": "system", "content": "sys"}] + [
+            {"role": "user" if i % 2 == 0 else "assistant", "content": f"m{i}"}
+            for i in range(n)
+        ]
+
+    def test_summarizes_oldest_75pct(self):
+        llm = FakeLLM(summary="the story so far")
+        prov = SummarizationCompactionProvider(llm)
+        msgs = self.make_convo(20)
+        out = run(prov.compact(msgs))
+        # structure: original system, summary system, kept tail
+        assert out[0]["content"] == "sys"
+        assert "the story so far" in out[1]["content"][0]["text"]
+        assert out[1]["content"][0]["cache_control"] == {"type": "ephemeral"}
+        # kept 25% of 20 = 5 messages
+        assert len(out) == 2 + 5
+        assert out[-1]["content"] == "m19"
+        assert len(llm.calls) == 1
+
+    def test_fallback_on_llm_failure(self):
+        prov = SummarizationCompactionProvider(FakeLLM(fail=True))
+        msgs = self.make_convo(20)
+        out = run(prov.compact(msgs))
+        # truncation fallback keeps system + tail, no summary message
+        assert out[0]["content"] == "sys"
+        assert all(not isinstance(m.get("content"), list) for m in out)
+
+    def test_short_conversation_falls_back(self):
+        llm = FakeLLM()
+        prov = SummarizationCompactionProvider(llm, min_messages=10)
+        msgs = self.make_convo(4)
+        out = run(prov.compact(msgs))
+        assert llm.calls == []  # no summarization attempted
+        assert len(out) == len(msgs)
+
+    def test_tool_pairs_survive(self):
+        llm = FakeLLM()
+        prov = SummarizationCompactionProvider(llm)
+        msgs = [{"role": "system", "content": "sys"}]
+        for i in range(8):
+            msgs.append({"role": "user", "content": f"q{i}"})
+            msgs.append(tool_call_msg([f"c{i}"]))
+            msgs.append(tool_result(f"c{i}"))
+        out = run(prov.compact(msgs))
+        # no orphan tool message anywhere in the output
+        open_ids = set()
+        for m in out:
+            if m.get("role") == "assistant" and m.get("tool_calls"):
+                open_ids = {tc["id"] for tc in m["tool_calls"]}
+            elif m.get("role") == "tool":
+                assert m["tool_call_id"] in open_ids
