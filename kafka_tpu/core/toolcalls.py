@@ -62,19 +62,27 @@ class ToolCallAccumulator:
         self._by_index.clear()
 
 
-def parse_tool_arguments(tool_call: Dict[str, Any]) -> Dict[str, Any]:
-    """Parse a completed tool call's JSON arguments.
+def parse_tool_arguments(call_or_arguments: Any) -> Dict[str, Any]:
+    """Parse tool-call JSON arguments into a kwargs dict.
 
-    Empty/whitespace arguments -> {}.  Malformed JSON -> {"_raw": raw} so the
-    unparseable text is preserved for error reporting rather than dropped.
-    Non-dict JSON (e.g. a bare list) -> {"_value": parsed}.
+    Accepts either a completed OpenAI tool-call dict (detected by its
+    `function` key; uses `function.arguments`) or raw arguments (a JSON
+    string, an already-parsed dict, or None).  Empty/whitespace -> {}.
+    Malformed JSON -> {"_raw": raw} so the unparseable text is preserved for
+    error reporting rather than dropped.  Non-dict JSON (e.g. a bare list)
+    -> {"_value": parsed}.
     """
-    raw = (tool_call.get("function") or {}).get("arguments") or ""
-    if not raw.strip():
+    raw = call_or_arguments
+    if isinstance(raw, dict):
+        if "function" in raw:
+            raw = (raw.get("function") or {}).get("arguments") or ""
+        else:
+            return raw  # already a parsed arguments dict
+    if raw is None or not str(raw).strip():
         return {}
     try:
         parsed = json.loads(raw)
-    except json.JSONDecodeError:
+    except (json.JSONDecodeError, TypeError):
         return {"_raw": raw}
     return parsed if isinstance(parsed, dict) else {"_value": parsed}
 

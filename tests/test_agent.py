@@ -1,0 +1,337 @@
+"""Agent-loop tests with a scripted FakeLLMProvider (SURVEY §4): tool-call
+streaming, idle/text/max-iteration termination, compaction retry, tool
+errors, and parallel tool fan-out. No model, no network, no JAX."""
+
+import asyncio
+import json
+
+import pytest
+
+from kafka_tpu.agents import Agent, IDLE_TOOL_NAME
+from kafka_tpu.core.types import ContextLengthError, StreamChunk
+from kafka_tpu.llm.base import LLMProvider
+from kafka_tpu.llm.compaction import ContextCompactionProvider
+from kafka_tpu.tools import AgentToolProvider, Tool, ToolEvent
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def text_turn(*parts, cid="chatcmpl-fake1"):
+    """A scripted assistant text turn as a chunk list."""
+    chunks = [StreamChunk(role="assistant", id=cid)]
+    chunks += [StreamChunk(content=p, id=cid) for p in parts]
+    chunks.append(StreamChunk(finish_reason="stop", id=cid))
+    return chunks
+
+
+def tool_turn(name, args: dict, call_id="call_1", cid="chatcmpl-fake2"):
+    """A scripted tool-call turn, split into deltas like real providers."""
+    args_json = json.dumps(args)
+    mid = len(args_json) // 2
+    return [
+        StreamChunk(role="assistant", id=cid),
+        StreamChunk(
+            tool_calls=[{
+                "index": 0, "id": call_id, "type": "function",
+                "function": {"name": name, "arguments": args_json[:mid]},
+            }],
+            id=cid,
+        ),
+        StreamChunk(
+            tool_calls=[{
+                "index": 0, "function": {"arguments": args_json[mid:]},
+            }],
+            id=cid,
+        ),
+        StreamChunk(finish_reason="tool_calls", id=cid),
+    ]
+
+
+class FakeLLM(LLMProvider):
+    """Plays back scripted turns; can raise a context error first."""
+
+    provider_name = "fake"
+
+    def __init__(self, turns, context_errors=0):
+        self.turns = list(turns)
+        self.context_errors = context_errors
+        self.seen_messages = []
+
+    async def stream_completion(self, messages, **kw):
+        self.seen_messages.append(list(messages))
+        if self.context_errors > 0:
+            self.context_errors -= 1
+            raise ContextLengthError(9999, 100, "fake")
+        if not self.turns:
+            raise AssertionError("FakeLLM ran out of scripted turns")
+        for chunk in self.turns.pop(0):
+            yield chunk
+
+
+class FakeCompaction(ContextCompactionProvider):
+    def __init__(self):
+        self.calls = 0
+
+    async def compact(self, messages, model=None):
+        self.calls += 1
+        return messages[-2:]  # crude but structurally fine for these tests
+
+
+def make_tools():
+    def add(a: int, b: int):
+        return a + b
+
+    async def fail(**kw):
+        raise ValueError("deliberate failure")
+
+    async def counter(n: int = 3):
+        for i in range(n):
+            yield f"tick {i}\n"
+
+    return AgentToolProvider(tools=[
+        Tool(name="add", description="add two numbers",
+             parameters={"type": "object", "properties": {
+                 "a": {"type": "integer"}, "b": {"type": "integer"}}},
+             handler=add),
+        Tool(name="fail", description="always fails", handler=fail),
+        Tool(name="counter", description="streams ticks", handler=counter),
+    ])
+
+
+async def collect(agen):
+    return [e async for e in agen]
+
+
+USER = [{"role": "user", "content": "hi"}]
+
+
+class TestTermination:
+    def test_text_response_terminates(self):
+        llm = FakeLLM([text_turn("hello", " world")])
+        agent = Agent(llm, make_tools(), system_prompt="sys")
+        events = run(collect(agent.run(USER)))
+        done = events[-1]
+        assert done["type"] == "agent_done"
+        assert done["reason"] == "text_response"
+        assert done["final_content"] == "hello world"
+        # OpenAI chunks were forwarded
+        assert any(e.get("object") == "chat.completion.chunk" for e in events)
+
+    def test_idle_tool_terminates(self):
+        llm = FakeLLM([
+            tool_turn(IDLE_TOOL_NAME, {"summary": "all done"}),
+        ])
+        agent = Agent(llm, make_tools())
+        events = run(collect(agent.run(USER)))
+        done = events[-1]
+        assert done["reason"] == "idle"
+        assert done["final_content"] == "all done"
+        # idle produced a tool_result event too
+        assert any(
+            e.get("type") == "tool_result" and e["name"] == IDLE_TOOL_NAME
+            for e in events
+        )
+
+    def test_max_iterations(self):
+        turns = [
+            tool_turn("add", {"a": 1, "b": 2}, call_id=f"c{i}",
+                      cid=f"chatcmpl-i{i}")
+            for i in range(5)
+        ]
+        llm = FakeLLM(turns)
+        agent = Agent(llm, make_tools(), max_iterations=3)
+        events = run(collect(agent.run(USER)))
+        assert events[-1]["reason"] == "max_iterations"
+        assert len(llm.seen_messages) == 3
+
+    def test_system_prompt_injected_once(self):
+        llm = FakeLLM([text_turn("ok")])
+        agent = Agent(llm, system_prompt="be brief")
+        run(collect(agent.run(USER)))
+        sent = llm.seen_messages[0]
+        assert sent[0]["role"] == "system" and sent[0]["content"] == "be brief"
+
+    def test_existing_system_prompt_not_overridden(self):
+        llm = FakeLLM([text_turn("ok")])
+        agent = Agent(llm, system_prompt="ignored")
+        msgs = [{"role": "system", "content": "original"}] + USER
+        run(collect(agent.run(msgs)))
+        sent = llm.seen_messages[0]
+        assert sent[0]["content"] == "original"
+        assert sum(1 for m in sent if m["role"] == "system") == 1
+
+
+class TestToolExecution:
+    def test_tool_called_and_result_fed_back(self):
+        llm = FakeLLM([
+            tool_turn("add", {"a": 2, "b": 40}),
+            text_turn("the answer is 42"),
+        ])
+        agent = Agent(llm, make_tools())
+        events = run(collect(agent.run(USER)))
+        results = [e for e in events if e.get("type") == "tool_result"]
+        assert results and results[-1]["kind"] == "result"
+        assert results[-1]["data"] == 42
+        # second LLM call saw the tool message
+        second = llm.seen_messages[1]
+        assert second[-1]["role"] == "tool"
+        assert second[-1]["content"] == "42"
+        assert second[-2]["role"] == "assistant"
+        assert second[-2]["tool_calls"][0]["function"]["name"] == "add"
+
+    def test_streaming_tool_events_forwarded(self):
+        llm = FakeLLM([
+            tool_turn("counter", {"n": 3}),
+            text_turn("done"),
+        ])
+        agent = Agent(llm, make_tools())
+        events = run(collect(agent.run(USER)))
+        deltas = [
+            e for e in events
+            if e.get("type") == "tool_result" and e["kind"] == "delta"
+        ]
+        assert len(deltas) == 3
+        assert deltas[0]["data"] == "tick 0\n"
+        # the fed-back tool message carries the FULL streamed output
+        second = llm.seen_messages[1]
+        assert second[-1]["content"] == "tick 0\ntick 1\ntick 2\n"
+
+    def test_parallel_pump_crash_surfaces_real_error(self):
+        class CrashingProvider(AgentToolProvider):
+            async def run_tool_stream(self, name, arguments, tool_call_id=None):
+                if name == "boom":
+                    raise RuntimeError("provider exploded")
+                async for ev in super().run_tool_stream(
+                    name, arguments, tool_call_id
+                ):
+                    yield ev
+
+        tp = CrashingProvider(tools=[
+            Tool(name="add", description="", handler=lambda a, b: a + b),
+        ])
+        calls = [
+            {"index": 0, "id": "c1", "type": "function",
+             "function": {"name": "boom", "arguments": "{}"}},
+            {"index": 1, "id": "c2", "type": "function",
+             "function": {"name": "add", "arguments": '{"a":1,"b":2}'}},
+        ]
+        turn = [
+            StreamChunk(role="assistant", id="chatcmpl-x"),
+            StreamChunk(tool_calls=calls, id="chatcmpl-x"),
+            StreamChunk(finish_reason="tool_calls", id="chatcmpl-x"),
+        ]
+        llm = FakeLLM([turn, text_turn("ok")])
+        agent = Agent(llm, tp, parallel_tools=True)
+        events = run(collect(agent.run(USER)))
+        errs = [e for e in events
+                if e.get("type") == "tool_result" and e["kind"] == "error"]
+        assert errs and "provider exploded" in errs[0]["data"]
+        second = llm.seen_messages[1]
+        tool_msgs = {m["tool_call_id"]: m["content"]
+                     for m in second if m["role"] == "tool"}
+        assert "provider exploded" in tool_msgs["c1"]
+        assert tool_msgs["c2"] == "3"
+
+    def test_tool_error_surfaces_to_model(self):
+        llm = FakeLLM([
+            tool_turn("fail", {}),
+            text_turn("I saw the error"),
+        ])
+        agent = Agent(llm, make_tools())
+        events = run(collect(agent.run(USER)))
+        errs = [
+            e for e in events
+            if e.get("type") == "tool_result" and e["kind"] == "error"
+        ]
+        assert errs and "deliberate failure" in errs[0]["data"]
+        # error became the tool message content
+        assert "Error:" in llm.seen_messages[1][-1]["content"]
+        assert events[-1]["reason"] == "text_response"
+
+    def test_unknown_tool_survives(self):
+        llm = FakeLLM([
+            tool_turn("no_such_tool", {}),
+            text_turn("recovered"),
+        ])
+        agent = Agent(llm, make_tools())
+        events = run(collect(agent.run(USER)))
+        assert events[-1]["reason"] == "text_response"
+        assert "unknown tool" in llm.seen_messages[1][-1]["content"]
+
+    def test_parallel_tools_preserve_message_order(self):
+        calls = [
+            {"index": 0, "id": "cA", "type": "function",
+             "function": {"name": "counter", "arguments": '{"n": 2}'}},
+            {"index": 1, "id": "cB", "type": "function",
+             "function": {"name": "add", "arguments": '{"a":1,"b":1}'}},
+        ]
+        turn = [
+            StreamChunk(role="assistant", id="chatcmpl-p"),
+            StreamChunk(tool_calls=calls, id="chatcmpl-p"),
+            StreamChunk(finish_reason="tool_calls", id="chatcmpl-p"),
+        ]
+        llm = FakeLLM([turn, text_turn("done")])
+        agent = Agent(llm, make_tools(), parallel_tools=True)
+        events = run(collect(agent.run(USER)))
+        assert events[-1]["reason"] == "text_response"
+        # tool messages fed back in call order regardless of finish order
+        second = llm.seen_messages[1]
+        tool_msgs = [m for m in second if m["role"] == "tool"]
+        assert [m["tool_call_id"] for m in tool_msgs] == ["cA", "cB"]
+
+
+class TestCompactionRetry:
+    def test_context_error_triggers_compaction_once(self):
+        llm = FakeLLM([text_turn("after compaction")], context_errors=1)
+        comp = FakeCompaction()
+        agent = Agent(llm, make_tools(), context_compaction_provider=comp)
+        msgs = [{"role": "user", "content": f"m{i}"} for i in range(6)]
+        events = run(collect(agent.run(msgs)))
+        assert comp.calls == 1
+        assert events[-1]["reason"] == "text_response"
+
+    def test_second_context_error_raises(self):
+        llm = FakeLLM([], context_errors=2)
+        comp = FakeCompaction()
+        agent = Agent(llm, make_tools(), context_compaction_provider=comp)
+        with pytest.raises(ContextLengthError):
+            run(collect(agent.run(USER)))
+        assert comp.calls == 1
+
+    def test_no_compaction_provider_raises_immediately(self):
+        llm = FakeLLM([], context_errors=1)
+        agent = Agent(llm, make_tools())
+        with pytest.raises(ContextLengthError):
+            run(collect(agent.run(USER)))
+
+
+class TestToolProvider:
+    def test_get_tools_openai_format(self):
+        tp = make_tools()
+        defs = tp.get_tools()
+        assert all(d["type"] == "function" for d in defs)
+        names = {d["function"]["name"] for d in defs}
+        assert names == {"add", "fail", "counter"}
+
+    def test_idle_injected_into_defs(self):
+        llm = FakeLLM([text_turn("x")])
+        agent = Agent(llm, make_tools())
+        run(collect(agent.run(USER)))
+        # FakeLLM doesn't see tools (kw only) — check the def builder
+        names = {d["function"]["name"] for d in agent._tool_defs()}
+        assert IDLE_TOOL_NAME in names
+
+    def test_run_tool_nonstreaming(self):
+        tp = make_tools()
+        assert run(tp.run_tool("add", '{"a": 3, "b": 4}')) == 7
+
+    def test_malformed_arguments_reach_tool_as_raw(self):
+        def echo(**kw):
+            return kw
+
+        tp = AgentToolProvider(tools=[Tool(name="echo", description="",
+                                           handler=echo)])
+        out = run(tp.run_tool("echo", "not json {"))
+        assert out == {"_raw": "not json {"}
