@@ -112,6 +112,22 @@ class Agent:
             defs.append(IDLE_TOOL)
         return defs
 
+    def _compaction_fit(self, tool_defs: List[Dict[str, Any]]):
+        """Token budget predicate that includes tool-definition overhead.
+
+        The compaction provider can't know the tool schemas rendered into
+        the prompt; without this, a compacted conversation can pass the
+        provider's internal fit and still overflow once tools are added.
+        Requires a counting provider (the TPU engine); None otherwise.
+        """
+        count = getattr(self.llm, "count_prompt_tokens", None)
+        limit = getattr(self.llm, "max_prompt_tokens", None)
+        if count is None or limit is None:
+            return None
+        budget = max(1, limit - min(256, limit // 2))
+        tools = tool_defs or None
+        return lambda msgs: count(msgs, tools=tools) <= budget
+
     # ------------------------------------------------------------------
 
     async def run(
@@ -165,7 +181,9 @@ class Agent:
                     compaction_attempted = True
                     logger.info("context overflow on iteration %d; compacting",
                                 iteration)
-                    working = await self.compaction.compact(working, model)
+                    working = await self.compaction.compact(
+                        working, model, fit=self._compaction_fit(tool_defs)
+                    )
                     iteration -= 1  # retry doesn't consume an iteration
                     continue
                 raise

@@ -1,0 +1,7 @@
+"""Persistence tier: thread/message store (SQLite; Supabase-compatible
+duck type per db/base.py)."""
+
+from .base import DBClient
+from .local import LocalDBClient
+
+__all__ = ["DBClient", "LocalDBClient"]

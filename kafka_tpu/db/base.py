@@ -1,0 +1,100 @@
+"""Thread-store contract.
+
+The reference uses a duck-typed DB client with two implementations —
+Supabase (src/db/supabase.py:41) and SQLite (src/db/local.py:20).  This ABC
+writes that duck type down explicitly (SURVEY §1-L2 lists the full method
+surface).  Thread persistence is ALSO the serving tier's recovery log: the
+KV cache is an optimization over the stored thread, so any cache can be
+evicted and rebuilt from `get_thread_messages` alone (SURVEY §5.4).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Dict, List, Optional
+
+
+class DBClient(abc.ABC):
+    """Async thread/message store."""
+
+    async def initialize(self) -> None:
+        """Create schema / open connections. Idempotent."""
+
+    async def close(self) -> None:
+        """Release connections. Idempotent."""
+
+    # -- threads -------------------------------------------------------
+
+    @abc.abstractmethod
+    async def create_thread(
+        self,
+        thread_id: Optional[str] = None,
+        metadata: Optional[Dict[str, Any]] = None,
+    ) -> str:
+        """Create a thread (id minted when not given); returns the id."""
+
+    @abc.abstractmethod
+    async def thread_exists(self, thread_id: str) -> bool: ...
+
+    @abc.abstractmethod
+    async def get_thread_metadata(
+        self, thread_id: str
+    ) -> Optional[Dict[str, Any]]: ...
+
+    @abc.abstractmethod
+    async def list_threads(self) -> List[Dict[str, Any]]:
+        """All threads' metadata rows, newest first."""
+
+    @abc.abstractmethod
+    async def delete_thread(self, thread_id: str) -> None:
+        """Delete a thread and its messages."""
+
+    # -- messages ------------------------------------------------------
+
+    @abc.abstractmethod
+    async def get_thread_messages(self, thread_id: str) -> List[Dict[str, Any]]:
+        """Messages in insertion order, as OpenAI-wire dicts."""
+
+    @abc.abstractmethod
+    async def add_message(self, thread_id: str, message: Dict[str, Any]) -> None: ...
+
+    async def add_messages(
+        self, thread_id: str, messages: List[Dict[str, Any]]
+    ) -> None:
+        for m in messages:
+            await self.add_message(thread_id, m)
+
+    @abc.abstractmethod
+    async def delete_thread_messages(self, thread_id: str) -> None: ...
+
+    # -- sandbox affinity ---------------------------------------------
+
+    @abc.abstractmethod
+    async def get_thread_sandbox_id(self, thread_id: str) -> Optional[str]: ...
+
+    @abc.abstractmethod
+    async def update_thread_sandbox_id(
+        self, thread_id: str, sandbox_id: Optional[str]
+    ) -> None: ...
+
+    # -- per-thread config (multi-tenant tier, SURVEY §5.6) ------------
+
+    @abc.abstractmethod
+    async def get_thread_config(
+        self, thread_id: str
+    ) -> Optional[Dict[str, Any]]:
+        """Per-thread serving config: model override, `global_prompt`,
+        playbooks, memory DSN… None when the thread has no profile
+        (reference local.py:332-347 returns None as the dev fallback)."""
+
+    @abc.abstractmethod
+    async def set_thread_config(
+        self, thread_id: str, config: Optional[Dict[str, Any]]
+    ) -> None:
+        """Replace the per-thread config (None clears it).  An extension
+        over the reference (its config lived in Supabase tables edited
+        out-of-band); the HTTP config endpoint depends on it."""
+
+    @abc.abstractmethod
+    async def get_or_create_vm_api_key(self, thread_id: str) -> str:
+        """Stable per-thread API key injected into sandbox claims."""

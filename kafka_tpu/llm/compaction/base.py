@@ -118,6 +118,11 @@ class ContextCompactionProvider(abc.ABC):
     Parity: reference src/llm/context_compaction/base.py (ABC) — `compact`
     returns a new message list expected to fit; implementations must never
     produce orphan tool messages.
+
+    `fit`, when given, is the caller's token-aware budget predicate
+    (True = the message list fits).  The caller knows request overhead the
+    provider cannot — tool definitions added at render time — so a passed
+    fit overrides any provider-internal default.
     """
 
     @abc.abstractmethod
@@ -125,5 +130,6 @@ class ContextCompactionProvider(abc.ABC):
         self,
         messages: List[Dict[str, Any]],
         model: str | None = None,
+        fit: Any | None = None,
     ) -> List[Dict[str, Any]]:
         raise NotImplementedError

@@ -131,7 +131,9 @@ class TruncationCompactionProvider(ContextCompactionProvider):
         self,
         messages: List[Dict[str, Any]],
         model: Optional[str] = None,
+        fit: Optional[FitFn] = None,
     ) -> List[Dict[str, Any]]:
+        eff_fit = fit or self.fit
         system_msgs, convo = _split_system(messages)
         keep = self.keep_last
         out = validate_message_structure(messages)
@@ -139,13 +141,13 @@ class TruncationCompactionProvider(ContextCompactionProvider):
             if len(convo) > keep:
                 split = find_safe_split_point(convo, len(convo) - keep)
                 out = validate_message_structure(system_msgs + convo[split:])
-            if self.fit is None or self.fit(out) or keep <= 1:
+            if eff_fit is None or eff_fit(out) or keep <= 1:
                 break
             keep //= 2  # still over budget: tighten and retry
-        if self.fit is not None and not self.fit(out):
+        if eff_fit is not None and not eff_fit(out):
             # last resort: individual messages larger than the window —
             # trim their text content (newest chars kept) until it fits
-            out = _trim_contents(out, self.fit)
+            out = _trim_contents(out, eff_fit)
         if len(messages) != len(out):
             logger.info(
                 "truncation compaction: %d -> %d messages",
@@ -186,21 +188,23 @@ class SummarizationCompactionProvider(ContextCompactionProvider):
         self,
         messages: List[Dict[str, Any]],
         model: Optional[str] = None,
+        fit: Optional[FitFn] = None,
     ) -> List[Dict[str, Any]]:
+        eff_fit = fit or self.fit
         system_msgs, convo = _split_system(messages)
         if len(convo) < self.min_messages:
             # too short to summarize meaningfully — safe truncation
-            return await self.fallback.compact(messages, model)
+            return await self.fallback.compact(messages, model, fit=eff_fit)
         target = int(len(convo) * self.summarize_ratio)
         split = find_safe_split_point(convo, target)
         if split <= 0:
-            return await self.fallback.compact(messages, model)
+            return await self.fallback.compact(messages, model, fit=eff_fit)
         to_summarize, kept = convo[:split], convo[split:]
         try:
             summary = await self._summarize(to_summarize, model or self.model)
         except Exception as e:
             logger.warning("summarization failed (%s); falling back", e)
-            return await self.fallback.compact(messages, model)
+            return await self.fallback.compact(messages, model, fit=eff_fit)
         summary_msg: Dict[str, Any] = {
             "role": "system",
             "content": [
@@ -215,11 +219,11 @@ class SummarizationCompactionProvider(ContextCompactionProvider):
         }
         rebuilt = system_msgs + [summary_msg] + kept
         out = validate_message_structure(rebuilt)
-        if self.fit is not None and not self.fit(out):
+        if eff_fit is not None and not eff_fit(out):
             # summary + kept tail still over budget (huge tail messages):
             # hand the rebuilt list to token-aware truncation, preserving
             # the summary (it sits in the system prefix now)
-            out = await self.fallback.compact(out, model)
+            out = await self.fallback.compact(out, model, fit=eff_fit)
         logger.info(
             "summarization compaction: %d messages -> %d (summarized %d)",
             len(messages), len(out), split,

@@ -1,0 +1,6 @@
+"""API server tier: aiohttp app, SSE protocol, serving config."""
+
+from .app import build_tpu_provider, create_app, run_server
+from .config import ServingConfig
+
+__all__ = ["ServingConfig", "build_tpu_provider", "create_app", "run_server"]

@@ -1,0 +1,47 @@
+"""`python -m kafka_tpu.server` — start the serving stack.
+
+Flags mirror ServingConfig; env vars (KAFKA_TPU_*) fill anything not given.
+"""
+
+import argparse
+
+from .app import run_server
+from .config import ServingConfig
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(prog="kafka_tpu.server")
+    p.add_argument("--host", default=None)
+    p.add_argument("--port", type=int, default=None)
+    p.add_argument("--model", default=None)
+    p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument("--db-path", default=None)
+    p.add_argument("--tp-size", type=int, default=None)
+    p.add_argument("--max-batch", type=int, default=None)
+    p.add_argument("--tiny-model", action="store_true",
+                   help="serve a tiny random-weight model (dev/demo)")
+    args = p.parse_args()
+
+    overrides = {}
+    if args.host is not None:
+        overrides["host"] = args.host
+    if args.port is not None:
+        overrides["port"] = args.port
+    if args.model is not None:
+        overrides["model_name"] = args.model
+    if args.checkpoint_dir is not None:
+        overrides["checkpoint_dir"] = args.checkpoint_dir
+    if args.db_path is not None:
+        overrides["db_path"] = args.db_path
+    if args.tp_size is not None:
+        overrides["tp_size"] = args.tp_size
+    if args.max_batch is not None:
+        overrides["max_batch"] = args.max_batch
+    if args.tiny_model:
+        overrides["tiny_model"] = True
+
+    run_server(ServingConfig.from_env(**overrides))
+
+
+if __name__ == "__main__":
+    main()

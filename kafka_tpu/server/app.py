@@ -1,0 +1,459 @@
+"""The HTTP API server: OpenAI-compatible endpoints + the 4-event SSE
+protocol, served by aiohttp.
+
+Endpoint parity with the reference (server.py:384-620):
+  POST /v1/chat/completions                  stateless chat (agent loop)
+  POST /v1/threads/{id}/chat/completions     thread chat w/ history
+  POST /v1/agent/run                         stateless agent run (SSE)
+  POST /v1/threads/{id}/agent/run            thread agent run (SSE)
+  POST /v1/threads                           create thread
+  GET  /v1/threads                           list threads
+  GET  /v1/threads/{id}                      thread metadata
+  GET  /v1/threads/{id}/messages             thread history
+  DELETE /v1/threads/{id}                    delete thread
+  DELETE /v1/threads/{id}/messages           clear history
+  PUT  /v1/threads/{id}/config               set per-thread config (ext.)
+  GET  /v1/models                            served models
+  GET  /health                               liveness + engine stats
+
+One deliberate improvement over the reference: the chat path streams REAL
+tokens as they decode.  The reference ran the whole agent loop first and
+then re-streamed the final text in 20-char pseudo-chunks
+(server.py:347-356) — its TTFT was a full agent run.  Clients still get the
+same event vocabulary (OpenAI chunks / tool_result / tool_messages /
+agent_done, SURVEY §5.8), so the reference playground works unmodified.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, AsyncIterator, Dict, List, Optional
+
+from aiohttp import web
+from pydantic import ValidationError
+
+from ..core.types import (
+    ContextLengthError,
+    LLMProviderError,
+    Usage,
+    new_completion_id,
+)
+from ..core.wire import AgentRunRequest, ChatCompletionRequest
+from ..db import DBClient, LocalDBClient
+from ..kafka import KafkaV1Provider, MessageAccumulator
+from ..llm.base import LLMProvider
+from ..tools import MCPServerConfig, Tool
+from .config import ServingConfig
+from .sse import sse_response
+
+logger = logging.getLogger("kafka_tpu.server")
+
+STATE_KEY = web.AppKey("kafka_tpu_state", dict)
+
+
+# ---------------------------------------------------------------------------
+# wiring
+# ---------------------------------------------------------------------------
+
+
+def build_tpu_provider(cfg: ServingConfig) -> LLMProvider:
+    """Construct tokenizer + engine + provider per the serving config."""
+    import jax
+
+    from ..llm.tpu_provider import TPULLMProvider
+    from ..models import get_config, init_params, load_checkpoint
+    from ..models.tokenizer import ByteTokenizer, load_tokenizer
+    from ..runtime import EngineConfig, InferenceEngine
+
+    if cfg.checkpoint_dir:
+        tokenizer = load_tokenizer(cfg.checkpoint_dir)
+        model_cfg, params = load_checkpoint(cfg.checkpoint_dir)
+    elif cfg.tiny_model:
+        tokenizer = ByteTokenizer()
+        model_cfg = get_config("tiny").replace(
+            vocab_size=tokenizer.vocab_size, dtype="float32"
+        )
+        params = init_params(model_cfg, jax.random.PRNGKey(0))
+    else:
+        tokenizer = ByteTokenizer()
+        model_cfg = get_config(cfg.model_name).replace(
+            vocab_size=max(tokenizer.vocab_size, 262), dtype=cfg.dtype
+        )
+        params = init_params(model_cfg, jax.random.PRNGKey(0))
+
+    mesh = None
+    if cfg.tp_size > 1:
+        from ..parallel import MeshConfig, make_mesh
+
+        mesh = make_mesh(MeshConfig(tp=cfg.tp_size))
+    engine = InferenceEngine(
+        model_cfg,
+        params,
+        EngineConfig(
+            max_batch=cfg.max_batch,
+            page_size=cfg.page_size,
+            num_pages=cfg.num_pages,
+            max_pages_per_seq=cfg.max_pages_per_seq,
+            prefill_buckets=cfg.prefill_buckets,
+            max_new_tokens_default=cfg.max_new_tokens_default,
+        ),
+        mesh=mesh,
+    )
+    return TPULLMProvider(engine, tokenizer, model_name=cfg.model_name)
+
+
+def default_builtin_tools(cfg: ServingConfig) -> List[Tool]:
+    from ..server_tools import builtin_tools
+
+    return builtin_tools(sandbox_url=cfg.local_sandbox_url)
+
+
+async def create_app(
+    cfg: Optional[ServingConfig] = None,
+    llm_provider: Optional[LLMProvider] = None,
+    db: Optional[DBClient] = None,
+    tools: Optional[List[Tool]] = None,
+    mcp_servers: Optional[List[MCPServerConfig]] = None,
+) -> web.Application:
+    """Build the application; DI parameters override config-driven wiring
+    (the testing seams the reference got from its ABC layering)."""
+    cfg = cfg or ServingConfig.from_env()
+    if llm_provider is None:
+        llm_provider = build_tpu_provider(cfg)
+    if db is None:
+        db = LocalDBClient(cfg.db_path)
+    await db.initialize()
+    if tools is None:
+        try:
+            tools = default_builtin_tools(cfg)
+        except Exception as e:  # server_tools are optional at boot
+            logger.warning("builtin tools unavailable: %s", e)
+            tools = []
+
+    kafka = KafkaV1Provider(
+        llm_provider,
+        thread_db=db,
+        tools=tools,
+        mcp_servers=mcp_servers,
+        default_model=cfg.model_name,
+    )
+    await kafka.initialize()
+
+    app = web.Application(middlewares=[cors_middleware(cfg.cors_origins)])
+    app[STATE_KEY] = {
+        "cfg": cfg,
+        "db": db,
+        "llm": llm_provider,
+        "tools": tools,
+        "mcp_servers": list(mcp_servers or []),
+        "kafka": kafka,
+    }
+    _add_routes(app)
+    app.on_cleanup.append(_cleanup)
+    return app
+
+
+async def _cleanup(app: web.Application) -> None:
+    state = app[STATE_KEY]
+    await state["kafka"].cleanup()
+    await state["db"].close()
+    await state["llm"].aclose()
+
+
+def cors_middleware(origins: str):
+    @web.middleware
+    async def mw(request: web.Request, handler):
+        if request.method == "OPTIONS":
+            resp: web.StreamResponse = web.Response(status=204)
+        else:
+            try:
+                resp = await handler(request)
+            except web.HTTPException as e:
+                # error responses need CORS headers too, or browsers hide
+                # the 400/404 body behind a CORS failure
+                resp = e
+        resp.headers["Access-Control-Allow-Origin"] = origins
+        resp.headers["Access-Control-Allow-Methods"] = "GET,POST,PUT,DELETE,OPTIONS"
+        resp.headers["Access-Control-Allow-Headers"] = "Content-Type,Authorization"
+        if isinstance(resp, web.HTTPException):
+            raise resp
+        return resp
+
+    return mw
+
+
+def _add_routes(app: web.Application) -> None:
+    r = app.router
+    r.add_post("/v1/chat/completions", chat_completions)
+    r.add_post("/v1/threads/{thread_id}/chat/completions", thread_chat_completions)
+    r.add_post("/v1/agent/run", agent_run)
+    r.add_post("/v1/threads/{thread_id}/agent/run", thread_agent_run)
+    r.add_post("/v1/threads", create_thread)
+    r.add_get("/v1/threads", list_threads)
+    r.add_get("/v1/threads/{thread_id}", get_thread)
+    r.add_get("/v1/threads/{thread_id}/messages", get_thread_messages)
+    r.add_delete("/v1/threads/{thread_id}", delete_thread)
+    r.add_delete("/v1/threads/{thread_id}/messages", delete_thread_messages)
+    r.add_put("/v1/threads/{thread_id}/config", set_thread_config)
+    r.add_get("/v1/models", list_models)
+    r.add_get("/health", health)
+    # OPTIONS preflight is answered by cors_middleware before routing
+
+
+def _state(request: web.Request) -> dict:
+    return request.app[STATE_KEY]
+
+
+async def _parse(request: web.Request, model_cls):
+    try:
+        return model_cls.model_validate(await request.json())
+    except ValidationError as e:
+        raise web.HTTPBadRequest(
+            text=e.json(), content_type="application/json"
+        )
+    except Exception:
+        raise web.HTTPBadRequest(text='{"error": "invalid JSON body"}',
+                                 content_type="application/json")
+
+
+# ---------------------------------------------------------------------------
+# event-stream plumbing shared by the four serving endpoints
+# ---------------------------------------------------------------------------
+
+
+async def _agent_events(
+    request: web.Request,
+    req_body,
+    thread_id: Optional[str],
+) -> AsyncIterator[Dict[str, Any]]:
+    """Run the right kafka flavor; yield protocol events + tool_messages."""
+    state = _state(request)
+    sampling = dict(
+        temperature=req_body.temperature if req_body.temperature is not None else 0.7,
+        max_tokens=req_body.max_tokens,
+    )
+    messages = [m.model_dump(exclude_none=True) for m in req_body.messages]
+    model = req_body.model or state["cfg"].model_name
+    acc = MessageAccumulator()
+
+    if thread_id is None:
+        kafka = state["kafka"]
+        stream = kafka.run(messages, model=model, **sampling)
+    else:
+        # per-thread provider: thread config (global_prompt/playbooks/model)
+        # is fetched at initialize (reference server.py:237-245)
+        kafka = KafkaV1Provider(
+            state["llm"],
+            thread_db=state["db"],
+            tools=state["tools"],
+            mcp_servers=state["mcp_servers"],
+            thread_id=thread_id,
+            default_model=model,
+        )
+        await kafka.initialize()
+        stream = kafka.run_with_thread(thread_id, messages, **sampling)
+
+    try:
+        async for event in stream:
+            acc.add_event(event)
+            if event.get("type") == "agent_done":
+                # batch of produced messages for the frontend
+                # (reference server.py:330-335), then the terminal event
+                yield {
+                    "type": "tool_messages",
+                    "messages": [m.to_dict() for m in acc.messages],
+                }
+            yield event
+    finally:
+        if thread_id is not None:
+            await kafka.cleanup()
+
+
+async def _collect_completion(
+    events: AsyncIterator[Dict[str, Any]], model: str
+) -> Dict[str, Any]:
+    """Drain an event stream into a non-streaming chat completion."""
+    acc = MessageAccumulator()
+    usage = Usage()
+    async for event in events:
+        acc.add_event(event)
+        if event.get("object") == "chat.completion.chunk" and event.get("usage"):
+            u = event["usage"]
+            usage.prompt_tokens += u.get("prompt_tokens", 0)
+            usage.completion_tokens += u.get("completion_tokens", 0)
+            usage.total_tokens += u.get("total_tokens", 0)
+    final = acc.final_content
+    return {
+        "id": new_completion_id(),
+        "object": "chat.completion",
+        "created": 0,
+        "model": model,
+        "choices": [
+            {
+                "index": 0,
+                "message": {"role": "assistant", "content": final},
+                "finish_reason": "stop",
+            }
+        ],
+        "usage": usage.to_dict(),
+    }
+
+
+# ---------------------------------------------------------------------------
+# serving endpoints
+# ---------------------------------------------------------------------------
+
+
+async def _completion_response(events, model: str) -> web.Response:
+    """Non-streaming completion with OpenAI-style structured errors."""
+    try:
+        return web.json_response(await _collect_completion(events, model))
+    except LLMProviderError as e:
+        status = e.status_code or 500
+        return web.json_response(
+            {
+                "error": {
+                    "message": str(e),
+                    "type": "invalid_request_error"
+                    if status < 500 else "server_error",
+                    "code": "context_length_exceeded"
+                    if isinstance(e, ContextLengthError) else None,
+                }
+            },
+            status=status,
+        )
+
+
+async def chat_completions(request: web.Request) -> web.StreamResponse:
+    body = await _parse(request, ChatCompletionRequest)
+    events = _agent_events(request, body, thread_id=None)
+    if body.stream:
+        return await sse_response(request, events)
+    return await _completion_response(events, body.model)
+
+
+async def thread_chat_completions(request: web.Request) -> web.StreamResponse:
+    thread_id = request.match_info["thread_id"]
+    body = await _parse(request, ChatCompletionRequest)
+    events = _agent_events(request, body, thread_id=thread_id)
+    if body.stream:
+        return await sse_response(request, events)
+    return await _completion_response(events, body.model)
+
+
+async def agent_run(request: web.Request) -> web.StreamResponse:
+    body = await _parse(request, AgentRunRequest)
+    return await sse_response(
+        request, _agent_events(request, body, thread_id=None)
+    )
+
+
+async def thread_agent_run(request: web.Request) -> web.StreamResponse:
+    thread_id = request.match_info["thread_id"]
+    body = await _parse(request, AgentRunRequest)
+    return await sse_response(
+        request, _agent_events(request, body, thread_id=thread_id)
+    )
+
+
+# ---------------------------------------------------------------------------
+# thread CRUD
+# ---------------------------------------------------------------------------
+
+
+async def create_thread(request: web.Request) -> web.Response:
+    db = _state(request)["db"]
+    body = {}
+    if request.can_read_body:
+        try:
+            body = await request.json()
+        except Exception:
+            body = {}
+    tid = await db.create_thread(
+        thread_id=body.get("thread_id"), metadata=body.get("metadata")
+    )
+    meta = await db.get_thread_metadata(tid)
+    return web.json_response(meta, status=201)
+
+
+async def list_threads(request: web.Request) -> web.Response:
+    db = _state(request)["db"]
+    return web.json_response({"threads": await db.list_threads()})
+
+
+async def _require_thread(request: web.Request) -> str:
+    db = _state(request)["db"]
+    tid = request.match_info["thread_id"]
+    if not await db.thread_exists(tid):
+        raise web.HTTPNotFound(
+            text=f'{{"error": "thread {tid} not found"}}',
+            content_type="application/json",
+        )
+    return tid
+
+
+async def get_thread(request: web.Request) -> web.Response:
+    tid = await _require_thread(request)
+    return web.json_response(await _state(request)["db"].get_thread_metadata(tid))
+
+
+async def get_thread_messages(request: web.Request) -> web.Response:
+    tid = await _require_thread(request)
+    msgs = await _state(request)["db"].get_thread_messages(tid)
+    return web.json_response({"thread_id": tid, "messages": msgs})
+
+
+async def delete_thread(request: web.Request) -> web.Response:
+    tid = await _require_thread(request)
+    await _state(request)["db"].delete_thread(tid)
+    return web.json_response({"deleted": tid})
+
+
+async def delete_thread_messages(request: web.Request) -> web.Response:
+    tid = await _require_thread(request)
+    await _state(request)["db"].delete_thread_messages(tid)
+    return web.json_response({"cleared": tid})
+
+
+async def set_thread_config(request: web.Request) -> web.Response:
+    tid = await _require_thread(request)
+    db = _state(request)["db"]
+    cfg = await request.json()
+    await db.set_thread_config(tid, cfg)
+    return web.json_response({"thread_id": tid, "config": cfg})
+
+
+# ---------------------------------------------------------------------------
+# models / health
+# ---------------------------------------------------------------------------
+
+
+async def list_models(request: web.Request) -> web.Response:
+    llm = _state(request)["llm"]
+    return web.json_response(
+        {"object": "list", "data": llm.get_available_models()}
+    )
+
+
+async def health(request: web.Request) -> web.Response:
+    state = _state(request)
+    llm = state["llm"]
+    payload: Dict[str, Any] = {
+        "status": "ok",
+        "kafka_initialized": state["kafka"]._initialized,
+    }
+    engine = getattr(llm, "engine", None)
+    if engine is not None:
+        payload["engine"] = {
+            "active": engine.num_active,
+            "waiting": len(engine.waiting),
+            "free_pages": engine.pool.free_pages,
+            "total_pages": engine.pool.num_pages,
+        }
+    return web.json_response(payload)
+
+
+def run_server(cfg: Optional[ServingConfig] = None) -> None:
+    cfg = cfg or ServingConfig.from_env()
+    logging.basicConfig(level=logging.INFO)
+    web.run_app(create_app(cfg), host=cfg.host, port=cfg.port)

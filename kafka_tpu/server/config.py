@@ -1,0 +1,60 @@
+"""Typed serving configuration.
+
+The reference's config was env vars + code constants (SURVEY §5.6); here
+it's one dataclass with env-var overrides, covering the engine shape, model
+selection, and server knobs.  Per-thread config stays in the DB tier.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass
+class ServingConfig:
+    # model
+    model_name: str = "llama-3.2-1b"
+    checkpoint_dir: Optional[str] = None  # HF safetensors dir; None=random init
+    dtype: str = "bfloat16"
+    # engine shape
+    max_batch: int = 8
+    page_size: int = 16
+    num_pages: int = 2048
+    max_pages_per_seq: int = 512
+    prefill_buckets: Tuple[int, ...] = (64, 128, 256, 512, 1024, 2048)
+    max_new_tokens_default: int = 1024
+    # parallelism: devices used for tensor parallelism (1 = single chip)
+    tp_size: int = 1
+    # server
+    host: str = "0.0.0.0"
+    port: int = 8000
+    db_path: str = "data/threads.db"
+    local_sandbox_url: Optional[str] = None
+    cors_origins: str = "*"
+    # test/dev: tiny random model instead of a real checkpoint
+    tiny_model: bool = False
+
+    @classmethod
+    def from_env(cls, **overrides) -> "ServingConfig":
+        env = os.environ
+
+        def get(name: str, default, cast=str):
+            raw = env.get(f"KAFKA_TPU_{name}")
+            return cast(raw) if raw is not None else default
+
+        cfg = cls(
+            model_name=get("MODEL", cls.model_name),
+            checkpoint_dir=get("CHECKPOINT_DIR", None),
+            max_batch=get("MAX_BATCH", cls.max_batch, int),
+            num_pages=get("NUM_PAGES", cls.num_pages, int),
+            max_pages_per_seq=get("MAX_PAGES_PER_SEQ", cls.max_pages_per_seq, int),
+            tp_size=get("TP_SIZE", cls.tp_size, int),
+            host=get("HOST", cls.host),
+            port=get("PORT", cls.port, int),
+            db_path=get("DB_PATH", cls.db_path),
+            local_sandbox_url=get("SANDBOX_URL", None),
+            tiny_model=get("TINY_MODEL", "0") in ("1", "true", "True"),
+        )
+        return dataclasses.replace(cfg, **overrides)

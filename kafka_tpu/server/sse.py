@@ -1,0 +1,61 @@
+"""Server-sent-events framing for aiohttp.
+
+Wire protocol parity with the reference (SURVEY §5.8): `data:`-framed JSON
+events terminated by `data: [DONE]`; event kinds are OpenAI chunks,
+`tool_result`, `tool_messages`, `agent_done`, and `error`.  Errors inside a
+generator are serialized as an `error` event followed by [DONE] so clients
+always terminate cleanly (reference server.py:199-201, :375-377).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Any, AsyncIterator, Dict
+
+from aiohttp import web
+
+logger = logging.getLogger("kafka_tpu.server.sse")
+
+DONE_FRAME = b"data: [DONE]\n\n"
+
+
+def frame(payload: Any) -> bytes:
+    if isinstance(payload, str):
+        return f"data: {payload}\n\n".encode()
+    return b"data: " + json.dumps(payload, separators=(",", ":")).encode() + b"\n\n"
+
+
+async def sse_response(
+    request: web.Request,
+    events: AsyncIterator[Dict[str, Any]],
+) -> web.StreamResponse:
+    """Stream `events` (already-wire-shaped dicts) as SSE, then [DONE]."""
+    resp = web.StreamResponse(
+        status=200,
+        headers={
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+            "Connection": "keep-alive",
+            "X-Accel-Buffering": "no",
+        },
+    )
+    await resp.prepare(request)
+    try:
+        async for event in events:
+            await resp.write(frame(event))
+    except ConnectionResetError:
+        logger.info("client disconnected mid-stream")
+        return resp
+    except Exception as e:
+        logger.exception("error during SSE stream")
+        try:
+            await resp.write(frame({"type": "error", "error": str(e)}))
+        except ConnectionResetError:
+            return resp
+    try:
+        await resp.write(DONE_FRAME)
+        await resp.write_eof()
+    except ConnectionResetError:
+        pass
+    return resp

@@ -74,8 +74,9 @@ class FakeCompaction(ContextCompactionProvider):
     def __init__(self):
         self.calls = 0
 
-    async def compact(self, messages, model=None):
+    async def compact(self, messages, model=None, fit=None):
         self.calls += 1
+        self.last_fit = fit
         return messages[-2:]  # crude but structurally fine for these tests
 
 

@@ -1,0 +1,158 @@
+"""Thread-store tests: round-trips, ordering, config, sandbox affinity,
+vm-key idempotency, and concurrent writers. All against :memory: SQLite."""
+
+import asyncio
+
+import pytest
+
+from kafka_tpu.db import DBClient, LocalDBClient
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+@pytest.fixture()
+def db(tmp_path):
+    client = LocalDBClient(str(tmp_path / "threads.db"))
+    run(client.initialize())
+    yield client
+    run(client.close())
+
+
+class TestThreads:
+    def test_create_and_exists(self, db):
+        async def go():
+            tid = await db.create_thread()
+            assert tid.startswith("thread_")
+            assert await db.thread_exists(tid)
+            assert not await db.thread_exists("nope")
+            return tid
+
+        run(go())
+
+    def test_create_with_explicit_id_idempotent(self, db):
+        async def go():
+            t1 = await db.create_thread("t-1", metadata={"a": 1})
+            t2 = await db.create_thread("t-1", metadata={"b": 2})
+            assert t1 == t2 == "t-1"
+            meta = await db.get_thread_metadata("t-1")
+            assert meta["metadata"] == {"a": 1}  # first write wins
+
+        run(go())
+
+    def test_delete_thread_cascades(self, db):
+        async def go():
+            await db.create_thread("t-del")
+            await db.add_message("t-del", {"role": "user", "content": "x"})
+            await db.get_or_create_vm_api_key("t-del")
+            await db.delete_thread("t-del")
+            assert not await db.thread_exists("t-del")
+            assert await db.get_thread_messages("t-del") == []
+
+        run(go())
+
+    def test_list_threads_newest_first(self, db):
+        async def go():
+            await db.create_thread("t-a")
+            await db.create_thread("t-b")
+            await db.add_message("t-a", {"role": "user", "content": "bump"})
+            rows = await db.list_threads()
+            assert [r["thread_id"] for r in rows] == ["t-a", "t-b"]
+
+        run(go())
+
+
+class TestMessages:
+    def test_round_trip_preserves_structure(self, db):
+        msg = {
+            "role": "assistant",
+            "content": None,
+            "tool_calls": [{
+                "id": "c1", "type": "function",
+                "function": {"name": "f", "arguments": '{"x": 1}'},
+            }],
+        }
+
+        async def go():
+            await db.create_thread("t-m")
+            await db.add_message("t-m", msg)
+            out = await db.get_thread_messages("t-m")
+            assert out == [msg]
+
+        run(go())
+
+    def test_insertion_order(self, db):
+        async def go():
+            await db.create_thread("t-o")
+            msgs = [{"role": "user", "content": str(i)} for i in range(20)]
+            await db.add_messages("t-o", msgs)
+            out = await db.get_thread_messages("t-o")
+            assert [m["content"] for m in out] == [str(i) for i in range(20)]
+
+        run(go())
+
+    def test_concurrent_writers(self, db):
+        async def go():
+            await db.create_thread("t-c")
+            await asyncio.gather(*(
+                db.add_message("t-c", {"role": "user", "content": f"w{i}"})
+                for i in range(30)
+            ))
+            out = await db.get_thread_messages("t-c")
+            assert len(out) == 30
+
+        run(go())
+
+    def test_delete_messages_keeps_thread(self, db):
+        async def go():
+            await db.create_thread("t-dm")
+            await db.add_message("t-dm", {"role": "user", "content": "x"})
+            await db.delete_thread_messages("t-dm")
+            assert await db.thread_exists("t-dm")
+            assert await db.get_thread_messages("t-dm") == []
+
+        run(go())
+
+
+class TestConfigAndKeys:
+    def test_config_none_fallback(self, db):
+        async def go():
+            await db.create_thread("t-cfg")
+            assert await db.get_thread_config("t-cfg") is None
+            cfg = {"model": "llama-3.2-1b", "global_prompt": "be kind",
+                   "playbooks": [{"name": "p1", "content": "steps"}]}
+            await db.set_thread_config("t-cfg", cfg)
+            assert await db.get_thread_config("t-cfg") == cfg
+            await db.set_thread_config("t-cfg", None)
+            assert await db.get_thread_config("t-cfg") is None
+
+        run(go())
+
+    def test_sandbox_affinity(self, db):
+        async def go():
+            await db.create_thread("t-sb")
+            assert await db.get_thread_sandbox_id("t-sb") is None
+            await db.update_thread_sandbox_id("t-sb", "sbx-1")
+            assert await db.get_thread_sandbox_id("t-sb") == "sbx-1"
+            await db.update_thread_sandbox_id("t-sb", None)
+            assert await db.get_thread_sandbox_id("t-sb") is None
+
+        run(go())
+
+    def test_vm_key_stable(self, db):
+        async def go():
+            await db.create_thread("t-k")
+            k1 = await db.get_or_create_vm_api_key("t-k")
+            k2 = await db.get_or_create_vm_api_key("t-k")
+            assert k1 == k2 and k1.startswith("vmk_")
+            ks = await asyncio.gather(*(
+                db.get_or_create_vm_api_key("t-k") for _ in range(10)
+            ))
+            assert set(ks) == {k1}
+
+        run(go())
+
+
+def test_abc_conformance():
+    assert issubclass(LocalDBClient, DBClient)

@@ -1,0 +1,256 @@
+"""Kafka orchestrator tests: thread replay/persistence, event
+re-accumulation, per-thread config wiring (global_prompt, playbooks,
+model override), and the V1 provider lifecycle. Uses the FakeLLM pattern
+from test_agent (SURVEY §4) — no JAX, no network."""
+
+import asyncio
+import json
+
+import pytest
+
+from kafka_tpu.core.types import StreamChunk
+from kafka_tpu.db import LocalDBClient
+from kafka_tpu.kafka import (
+    KafkaAgent,
+    KafkaV1Provider,
+    MessageAccumulator,
+    playbooks_to_markdown,
+)
+from kafka_tpu.llm.base import LLMProvider
+from kafka_tpu.tools import Tool
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def text_turn(*parts, cid="chatcmpl-k1"):
+    chunks = [StreamChunk(role="assistant", id=cid)]
+    chunks += [StreamChunk(content=p, id=cid) for p in parts]
+    chunks.append(StreamChunk(finish_reason="stop", id=cid))
+    return chunks
+
+
+def tool_turn(name, args, call_id="call_1", cid="chatcmpl-k2"):
+    return [
+        StreamChunk(role="assistant", id=cid),
+        StreamChunk(
+            tool_calls=[{
+                "index": 0, "id": call_id, "type": "function",
+                "function": {"name": name, "arguments": json.dumps(args)},
+            }],
+            id=cid,
+        ),
+        StreamChunk(finish_reason="tool_calls", id=cid),
+    ]
+
+
+class FakeLLM(LLMProvider):
+    provider_name = "fake"
+
+    def __init__(self, turns):
+        self.turns = list(turns)
+        self.seen_messages = []
+        self.seen_models = []
+
+    async def stream_completion(self, messages, model=None, **kw):
+        self.seen_messages.append(list(messages))
+        self.seen_models.append(model)
+        for chunk in self.turns.pop(0):
+            yield chunk
+
+
+@pytest.fixture()
+def db(tmp_path):
+    client = LocalDBClient(str(tmp_path / "kafka.db"))
+    run(client.initialize())
+    yield client
+    run(client.close())
+
+
+async def collect(agen):
+    return [e async for e in agen]
+
+
+def make_kafka(llm, db=None, **kw):
+    kw.setdefault("system_prompt", "test prompt")
+    return KafkaV1Provider(llm, thread_db=db, **kw)
+
+
+class TestRunWithThread:
+    def test_history_replayed_and_persisted(self, db):
+        llm = FakeLLM([text_turn("first answer"),
+                       text_turn("second answer")])
+        kafka = make_kafka(llm, db)
+
+        async def go():
+            await kafka.initialize()
+            ev1 = await collect(kafka.run_with_thread(
+                "t-1", [{"role": "user", "content": "q1"}]))
+            ev2 = await collect(kafka.run_with_thread(
+                "t-1", [{"role": "user", "content": "q2"}]))
+            return ev1, ev2
+
+        ev1, ev2 = run(go())
+        assert ev1[-1]["type"] == "agent_done"
+        # second run saw q1 + first answer in history
+        second_input = llm.seen_messages[1]
+        roles = [(m["role"], m.get("content")) for m in second_input]
+        assert ("user", "q1") in roles
+        assert ("assistant", "first answer") in roles
+        assert ("user", "q2") in roles
+        # db now holds all four messages
+        stored = run(db.get_thread_messages("t-1"))
+        contents = [m.get("content") for m in stored]
+        assert contents == ["q1", "first answer", "q2", "second answer"]
+
+    def test_tool_turns_persisted_as_pairs(self, db):
+        def add(a: int, b: int):
+            return a + b
+
+        llm = FakeLLM([tool_turn("add", {"a": 1, "b": 2}),
+                       text_turn("it is 3", cid="chatcmpl-k9")])
+        kafka = make_kafka(llm, db, tools=[
+            Tool(name="add", description="", handler=add)])
+
+        async def go():
+            await kafka.initialize()
+            return await collect(kafka.run_with_thread(
+                "t-2", [{"role": "user", "content": "1+2?"}]))
+
+        run(go())
+        stored = run(db.get_thread_messages("t-2"))
+        roles = [m["role"] for m in stored]
+        assert roles == ["user", "assistant", "tool", "assistant"]
+        assert stored[1]["tool_calls"][0]["function"]["name"] == "add"
+        assert stored[2]["content"] == "3"
+        assert stored[2]["tool_call_id"] == "call_1"
+        # replay of this thread is sanitizer-clean
+        from kafka_tpu.core.sanitize import sanitize_messages_for_openai
+        from kafka_tpu.core.types import Message
+
+        msgs = [Message.from_dict(m) for m in stored]
+        assert len(sanitize_messages_for_openai(msgs)) == len(msgs)
+
+    def test_thread_autocreated(self, db):
+        llm = FakeLLM([text_turn("hi")])
+        kafka = make_kafka(llm, db)
+
+        async def go():
+            await kafka.initialize()
+            await collect(kafka.run_with_thread(
+                "t-new", [{"role": "user", "content": "x"}]))
+            return await db.thread_exists("t-new")
+
+        assert run(go())
+
+    def test_requires_db(self):
+        kafka = make_kafka(FakeLLM([]))
+
+        async def go():
+            await kafka.initialize()
+            await collect(kafka.run_with_thread(
+                "t", [{"role": "user", "content": "x"}]))
+
+        with pytest.raises(RuntimeError, match="thread store"):
+            run(go())
+
+
+class TestThreadConfig:
+    def test_model_override_and_prompt_sections(self, db):
+        llm = FakeLLM([text_turn("ok")])
+
+        async def go():
+            await db.create_thread("t-cfg")
+            await db.set_thread_config("t-cfg", {
+                "model": "custom-model",
+                "global_prompt": "SPEAK LIKE A PIRATE",
+                "playbooks": [
+                    {"name": "deploy", "trigger": "deploys",
+                     "content": "step1\nstep2"},
+                ],
+            })
+            kafka = KafkaV1Provider(
+                llm, thread_db=db, thread_id="t-cfg")
+            await kafka.initialize()
+            await collect(kafka.run_with_thread(
+                "t-cfg", [{"role": "user", "content": "hi"}]))
+            return kafka
+
+        kafka = run(go())
+        assert llm.seen_models == ["custom-model"]
+        sys_prompt = llm.seen_messages[0][0]
+        assert sys_prompt["role"] == "system"
+        assert "SPEAK LIKE A PIRATE" in sys_prompt["content"]
+        assert "| deploy | deploys |" in sys_prompt["content"]
+
+    def test_playbooks_markdown(self):
+        table = playbooks_to_markdown([
+            {"name": "a|b", "trigger": "t", "content": "l1\nl2"},
+        ])
+        assert "a\\|b" in table
+        assert "l1<br>l2" in table
+        assert playbooks_to_markdown([]) == ""
+
+
+class TestMessageAccumulator:
+    def test_multi_completion_segmentation(self):
+        acc = MessageAccumulator()
+        for c in text_turn("part1 ", "part2", cid="id-A"):
+            acc.add_event(c.to_openai_dict())
+        for c in tool_turn("f", {"x": 1}, cid="id-B"):
+            acc.add_event(c.to_openai_dict())
+        acc.add_event({
+            "type": "tool_result", "tool_call_id": "call_1", "name": "f",
+            "kind": "result", "data": 42, "done": True,
+        })
+        acc.add_event({"type": "agent_done", "reason": "text_response",
+                       "final_content": "part1 part2"})
+        msgs = acc.messages
+        assert [m.role for m in msgs] == ["assistant", "assistant", "tool"]
+        assert msgs[0].content == "part1 part2"
+        assert msgs[1].tool_calls[0]["function"]["name"] == "f"
+        assert msgs[2].content == "42"
+        assert acc.final_content == "part1 part2"
+        assert acc.done_reason == "text_response"
+
+    def test_error_tool_result(self):
+        acc = MessageAccumulator()
+        acc.add_event({
+            "type": "tool_result", "tool_call_id": "c", "name": "f",
+            "kind": "error", "data": "boom", "done": True,
+        })
+        assert acc.messages[0].content == "Error: boom"
+
+    def test_non_terminal_tool_events_skipped(self):
+        acc = MessageAccumulator()
+        acc.add_event({
+            "type": "tool_result", "tool_call_id": "c", "name": "f",
+            "kind": "delta", "data": "tick", "done": False,
+        })
+        assert acc.messages == []
+
+
+class TestLifecycle:
+    def test_context_manager(self, db):
+        llm = FakeLLM([text_turn("hi")])
+
+        async def go():
+            async with make_kafka(llm, db) as kafka:
+                assert kafka._initialized
+                assert isinstance(kafka, KafkaAgent)
+            return kafka
+
+        kafka = run(go())
+        assert not kafka._initialized
+
+    def test_get_tools(self):
+        kafka = make_kafka(FakeLLM([]), tools=[
+            Tool(name="t1", description="", handler=lambda: 1)])
+
+        async def go():
+            await kafka.initialize()
+            return kafka.get_tools()
+
+        tools = run(go())
+        assert tools[0]["function"]["name"] == "t1"

@@ -1,0 +1,104 @@
+"""Prompt tier tests: section composition, {{var}} enrichment, toggling,
+ordering, validation, and the V1 13-section provider."""
+
+import re
+
+from kafka_tpu.prompts import (
+    PromptProvider,
+    PromptProviderV1,
+    PromptSection,
+    SECTION_FILES,
+)
+
+
+class TestSections:
+    def test_render_substitutes_vars(self):
+        s = PromptSection("env", "Date: {{current_date}} in {{place}}")
+        out = s.render({"current_date": "2026-07-29", "place": "x"})
+        assert out == "Date: 2026-07-29 in x"
+
+    def test_unknown_vars_left_intact(self):
+        s = PromptSection("env", "Hello {{missing}}")
+        assert s.render({}) == "Hello {{missing}}"
+
+    def test_variables_listed(self):
+        s = PromptSection("x", "{{b}} {{a}} {{ a }}")
+        assert s.variables == ["a", "b"]
+
+
+class TestProvider:
+    def make(self):
+        return PromptProvider(
+            sections=[
+                PromptSection("one", "first", order=10),
+                PromptSection("two", "second {{v}}", order=20),
+                PromptSection("three", "third", order=30),
+            ],
+            variables={"v": "val"},
+        )
+
+    def test_render_order_and_join(self):
+        p = self.make()
+        assert p.get_system_prompt() == "first\n\nsecond val\n\nthird"
+
+    def test_disable_enable(self):
+        p = self.make()
+        p.disable_section("two")
+        assert "second" not in p.get_system_prompt()
+        p.enable_section("two")
+        assert "second val" in p.get_system_prompt()
+
+    def test_add_remove_reorder(self):
+        p = self.make()
+        p.add_section("zero", "zeroth", order=5)
+        assert p.get_system_prompt().startswith("zeroth")
+        p.reorder_section("zero", 99)
+        assert p.get_system_prompt().endswith("zeroth")
+        p.remove_section("zero")
+        assert "zeroth" not in p.get_system_prompt()
+        # add_section without order appends
+        p.add_section("tail", "the tail")
+        assert p.get_system_prompt().endswith("the tail")
+
+    def test_per_render_variable_override(self):
+        p = self.make()
+        assert "second over" in p.get_system_prompt({"v": "over"})
+        assert "second val" in p.get_system_prompt()  # default untouched
+
+    def test_validate_reports_missing(self):
+        p = PromptProvider(
+            sections=[PromptSection("a", "{{known}} {{unknown}}")],
+            variables={"known": 1},
+        )
+        assert p.validate() == ["unknown"]
+        assert p.validate({"unknown": 2}) == []
+        p.disable_section("a")
+        assert p.validate() == []
+
+
+class TestV1:
+    def test_loads_13_sections(self):
+        p = PromptProviderV1()
+        assert len(SECTION_FILES) == 13
+        assert len(p.sections) == 13
+        assert [s.name for s in p.sections][:3] == [
+            "intro", "environment", "capabilities",
+        ]
+
+    def test_renders_clean(self):
+        p = PromptProviderV1(variables={"current_date": "2026-07-29"})
+        out = p.get_system_prompt()
+        assert "Kafka" in out
+        assert "2026-07-29" in out
+        assert not re.search(r"\{\{\s*\w+\s*\}\}", out), "unresolved vars"
+        assert p.validate() == []
+
+    def test_sandbox_env_override(self):
+        p = PromptProviderV1(variables={"sandbox_env": "CUSTOM ENV DESC"})
+        assert "CUSTOM ENV DESC" in p.get_system_prompt()
+
+    def test_dynamic_global_prompt_section(self):
+        p = PromptProviderV1()
+        p.add_section("global_prompt", "Always answer in French.")
+        out = p.get_system_prompt()
+        assert out.endswith("Always answer in French.")

@@ -1,0 +1,295 @@
+"""API server tests: endpoint surface, SSE protocol (all four event kinds
++ [DONE]), thread persistence through HTTP, CRUD, and error paths.
+Uses aiohttp's in-process test client with a scripted FakeLLM injected
+through create_app's DI seams — no JAX, no network."""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from kafka_tpu.core.types import StreamChunk
+from kafka_tpu.db import LocalDBClient
+from kafka_tpu.llm.base import LLMProvider
+from kafka_tpu.server import ServingConfig, create_app
+from kafka_tpu.tools import Tool
+
+
+def text_turn(*parts, cid="chatcmpl-s1"):
+    chunks = [StreamChunk(role="assistant", id=cid)]
+    chunks += [StreamChunk(content=p, id=cid) for p in parts]
+    chunks.append(StreamChunk(
+        finish_reason="stop", id=cid,
+        usage={"prompt_tokens": 7, "completion_tokens": len(parts),
+               "total_tokens": 7 + len(parts)},
+    ))
+    return chunks
+
+
+def tool_turn(name, args, call_id="call_1", cid="chatcmpl-s2"):
+    return [
+        StreamChunk(role="assistant", id=cid),
+        StreamChunk(tool_calls=[{
+            "index": 0, "id": call_id, "type": "function",
+            "function": {"name": name, "arguments": json.dumps(args)},
+        }], id=cid),
+        StreamChunk(finish_reason="tool_calls", id=cid),
+    ]
+
+
+class FakeLLM(LLMProvider):
+    provider_name = "fake"
+
+    def __init__(self, turns):
+        self.turns = list(turns)
+
+    async def stream_completion(self, messages, **kw):
+        if not self.turns:
+            script = text_turn("fallback")
+        else:
+            script = self.turns.pop(0)
+        for chunk in script:
+            yield chunk
+
+    def get_available_models(self):
+        return [{"id": "fake-model", "object": "model", "owned_by": "test",
+                 "created": 0}]
+
+
+def make_client(tmp_path, turns):
+    """(client, llm, db) with the app fully wired around a FakeLLM."""
+    llm = FakeLLM(turns)
+    db = LocalDBClient(str(tmp_path / "server.db"))
+
+    def add(a: int, b: int):
+        return a + b
+
+    async def build():
+        app = await create_app(
+            cfg=ServingConfig(db_path=str(tmp_path / "server.db")),
+            llm_provider=llm,
+            db=db,
+            tools=[Tool(name="add", description="", handler=add)],
+        )
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        return client
+
+    return build(), llm, db
+
+
+def parse_sse(text):
+    events = []
+    for line in text.splitlines():
+        if not line.startswith("data: "):
+            continue
+        payload = line[len("data: "):]
+        if payload == "[DONE]":
+            events.append("[DONE]")
+        else:
+            events.append(json.loads(payload))
+    return events
+
+
+class TestBasics:
+    def test_health_and_models(self, tmp_path):
+        built, _, _ = make_client(tmp_path, [])
+
+        async def go():
+            client = await built
+            try:
+                h = await client.get("/health")
+                assert h.status == 200
+                hj = await h.json()
+                assert hj["status"] == "ok" and hj["kafka_initialized"]
+                m = await client.get("/v1/models")
+                mj = await m.json()
+                assert mj["data"][0]["id"] == "fake-model"
+            finally:
+                await client.close()
+
+        asyncio.run(go())
+
+    def test_invalid_body_400(self, tmp_path):
+        built, _, _ = make_client(tmp_path, [])
+
+        async def go():
+            client = await built
+            try:
+                r = await client.post("/v1/chat/completions", json={"bad": 1})
+                assert r.status == 400
+            finally:
+                await client.close()
+
+        asyncio.run(go())
+
+
+class TestThreadCRUD:
+    def test_full_lifecycle(self, tmp_path):
+        built, _, _ = make_client(tmp_path, [])
+
+        async def go():
+            client = await built
+            try:
+                r = await client.post("/v1/threads", json={"thread_id": "t-x"})
+                assert r.status == 201
+                assert (await r.json())["thread_id"] == "t-x"
+
+                r = await client.get("/v1/threads")
+                assert [t["thread_id"] for t in (await r.json())["threads"]] == ["t-x"]
+
+                r = await client.get("/v1/threads/t-x")
+                assert r.status == 200
+
+                r = await client.put("/v1/threads/t-x/config",
+                                     json={"model": "m2"})
+                assert r.status == 200
+
+                r = await client.get("/v1/threads/t-x/messages")
+                assert (await r.json())["messages"] == []
+
+                r = await client.delete("/v1/threads/t-x/messages")
+                assert r.status == 200
+                r = await client.delete("/v1/threads/t-x")
+                assert r.status == 200
+                r = await client.get("/v1/threads/t-x")
+                assert r.status == 404
+            finally:
+                await client.close()
+
+        asyncio.run(go())
+
+    def test_missing_thread_404(self, tmp_path):
+        built, _, _ = make_client(tmp_path, [])
+
+        async def go():
+            client = await built
+            try:
+                r = await client.get("/v1/threads/ghost/messages")
+                assert r.status == 404
+            finally:
+                await client.close()
+
+        asyncio.run(go())
+
+
+class TestChatCompletions:
+    def test_nonstreaming_collects_final(self, tmp_path):
+        built, _, _ = make_client(tmp_path, [text_turn("hello ", "world")])
+
+        async def go():
+            client = await built
+            try:
+                r = await client.post("/v1/chat/completions", json={
+                    "model": "fake-model",
+                    "messages": [{"role": "user", "content": "hi"}],
+                })
+                assert r.status == 200
+                body = await r.json()
+                assert body["choices"][0]["message"]["content"] == "hello world"
+                assert body["usage"]["total_tokens"] == 9
+            finally:
+                await client.close()
+
+        asyncio.run(go())
+
+    def test_streaming_protocol(self, tmp_path):
+        built, _, _ = make_client(
+            tmp_path,
+            [tool_turn("add", {"a": 1, "b": 2}), text_turn("3", cid="chatcmpl-s3")],
+        )
+
+        async def go():
+            client = await built
+            try:
+                r = await client.post("/v1/chat/completions", json={
+                    "model": "fake-model", "stream": True,
+                    "messages": [{"role": "user", "content": "1+2?"}],
+                })
+                assert r.status == 200
+                assert r.headers["Content-Type"].startswith("text/event-stream")
+                events = parse_sse(await r.text())
+            finally:
+                await client.close()
+            assert events[-1] == "[DONE]"
+            kinds = [
+                e.get("type") or e.get("object")
+                for e in events if e != "[DONE]"
+            ]
+            assert "chat.completion.chunk" in kinds
+            assert "tool_result" in kinds
+            assert "tool_messages" in kinds
+            assert kinds[-1] == "agent_done"
+            # tool_messages batch precedes agent_done and contains the pair
+            tm = next(e for e in events
+                      if isinstance(e, dict) and e.get("type") == "tool_messages")
+            roles = [m["role"] for m in tm["messages"]]
+            assert roles == ["assistant", "tool", "assistant"]
+
+        asyncio.run(go())
+
+    def test_thread_chat_persists_and_replays(self, tmp_path):
+        built, llm, db = make_client(
+            tmp_path,
+            [text_turn("first"), text_turn("second", cid="chatcmpl-s4")],
+        )
+
+        async def go():
+            client = await built
+            try:
+                for q in ("q1", "q2"):
+                    r = await client.post(
+                        "/v1/threads/t-chat/chat/completions",
+                        json={"model": "fake-model",
+                              "messages": [{"role": "user", "content": q}]},
+                    )
+                    assert r.status == 200
+                r = await client.get("/v1/threads/t-chat/messages")
+                msgs = (await r.json())["messages"]
+            finally:
+                await client.close()
+            assert [m.get("content") for m in msgs] == [
+                "q1", "first", "q2", "second"]
+
+        asyncio.run(go())
+
+
+class TestAgentRun:
+    def test_agent_run_sse(self, tmp_path):
+        built, _, _ = make_client(tmp_path, [text_turn("done deal")])
+
+        async def go():
+            client = await built
+            try:
+                r = await client.post("/v1/agent/run", json={
+                    "messages": [{"role": "user", "content": "go"}],
+                })
+                assert r.status == 200
+                events = parse_sse(await r.text())
+            finally:
+                await client.close()
+            done = [e for e in events
+                    if isinstance(e, dict) and e.get("type") == "agent_done"]
+            assert done and done[0]["final_content"] == "done deal"
+
+        asyncio.run(go())
+
+    def test_thread_agent_run_creates_thread(self, tmp_path):
+        built, _, db = make_client(tmp_path, [text_turn("ok")])
+
+        async def go():
+            client = await built
+            try:
+                r = await client.post("/v1/threads/t-agent/agent/run", json={
+                    "messages": [{"role": "user", "content": "go"}],
+                })
+                assert r.status == 200
+                await r.text()
+                r = await client.get("/v1/threads/t-agent/messages")
+                return (await r.json())["messages"]
+            finally:
+                await client.close()
+
+        msgs = asyncio.run(go())
+        assert [m["role"] for m in msgs] == ["user", "assistant"]
