@@ -1,0 +1,154 @@
+"""LocalSandbox — URL-direct HTTP sandbox client.
+
+Parity: reference src/sandbox/local.py:18-349 — health probe (:125),
+`run_tool` as POST /run with the SSE stream parsed from raw BYTES as they
+arrive (:207-274; line-buffered readers add latency to streamed tool
+output), and /claim (:310).  Also used to talk to subprocess sandboxes
+(sandbox/process.py) and any remote implementing the same protocol.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Any, AsyncIterator, Dict, Optional
+
+import httpx
+
+from ..tools.types import ToolEvent
+from .base import Sandbox
+from .types import SandboxConfig
+
+logger = logging.getLogger("kafka_tpu.sandbox.local")
+
+DEFAULT_TOOL_TIMEOUT_S = 300.0
+
+
+class LocalSandbox(Sandbox):
+    def __init__(
+        self,
+        url: str,
+        sandbox_id: Optional[str] = None,
+        client: Optional[httpx.AsyncClient] = None,
+    ):
+        self.url = url.rstrip("/")
+        self.sandbox_id = sandbox_id or self.url
+        self._client = client or httpx.AsyncClient(timeout=None)
+
+    async def aclose(self) -> None:
+        await self._client.aclose()
+
+    # -- health --------------------------------------------------------
+
+    async def check_health(self) -> Dict[str, Any]:
+        try:
+            r = await self._client.get(f"{self.url}/health", timeout=5.0)
+            r.raise_for_status()
+            data = r.json()
+            data.setdefault("healthy", True)
+            return data
+        except Exception as e:
+            return {"healthy": False, "claimed": False, "error": str(e)}
+
+    # -- execution -----------------------------------------------------
+
+    async def run_tool(
+        self,
+        name: str,
+        arguments: Dict[str, Any],
+        tool_call_id: Optional[str] = None,
+        timeout: Optional[float] = None,
+    ) -> AsyncIterator[ToolEvent]:
+        payload = {
+            "tool": name,
+            "arguments": arguments,
+            "tool_call_id": tool_call_id,
+        }
+        timeout = timeout or DEFAULT_TOOL_TIMEOUT_S
+        terminal_seen = False
+        try:
+            async with self._client.stream(
+                "POST",
+                f"{self.url}/run",
+                json=payload,
+                timeout=httpx.Timeout(10.0, read=timeout),
+            ) as resp:
+                if resp.status_code != 200:
+                    body = (await resp.aread()).decode(errors="replace")
+                    yield ToolEvent(
+                        "error",
+                        f"sandbox /run returned {resp.status_code}: {body[:500]}",
+                        tool_name=name, tool_call_id=tool_call_id,
+                    )
+                    return
+                # byte-level SSE parse: emit each frame the moment its
+                # terminating blank line arrives (reference local.py:207-274)
+                buf = b""
+                async for chunk in resp.aiter_raw():
+                    buf += chunk
+                    while b"\n\n" in buf:
+                        frame, buf = buf.split(b"\n\n", 1)
+                        ev = self._parse_frame(frame, name, tool_call_id)
+                        if ev is None:
+                            continue
+                        if ev.terminal:
+                            terminal_seen = True
+                        yield ev
+                        if terminal_seen:
+                            return
+        except httpx.HTTPError as e:
+            yield ToolEvent(
+                "error", f"sandbox connection failed: {e}",
+                tool_name=name, tool_call_id=tool_call_id,
+            )
+            return
+        if not terminal_seen:
+            # stream ended without a terminal event (sandbox crashed
+            # mid-tool): surface that rather than hanging the agent
+            yield ToolEvent(
+                "error", "sandbox stream ended without a result",
+                tool_name=name, tool_call_id=tool_call_id,
+            )
+
+    def _parse_frame(
+        self, frame: bytes, name: str, tool_call_id: Optional[str]
+    ) -> Optional[ToolEvent]:
+        for line in frame.split(b"\n"):
+            if not line.startswith(b"data: "):
+                continue
+            payload = line[len(b"data: "):]
+            if payload == b"[DONE]":
+                return None
+            try:
+                obj = json.loads(payload)
+            except json.JSONDecodeError:
+                logger.warning("unparseable sandbox SSE frame: %r", payload[:200])
+                return None
+            return ToolEvent(
+                kind=obj.get("kind", "delta"),
+                data=obj.get("data"),
+                tool_name=name,
+                tool_call_id=tool_call_id,
+            )
+        return None
+
+    # -- lifecycle -----------------------------------------------------
+
+    async def claim(self, config: SandboxConfig) -> bool:
+        try:
+            r = await self._client.post(
+                f"{self.url}/claim", json=config.to_dict(), timeout=10.0
+            )
+            if r.status_code == 409:
+                return False
+            r.raise_for_status()
+            return bool(r.json().get("claimed"))
+        except httpx.HTTPError as e:
+            logger.warning("claim failed for %s: %s", self.sandbox_id, e)
+            return False
+
+    async def reset(self) -> None:
+        try:
+            await self._client.post(f"{self.url}/reset", timeout=10.0)
+        except httpx.HTTPError as e:
+            logger.warning("reset failed for %s: %s", self.sandbox_id, e)

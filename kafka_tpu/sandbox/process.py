@@ -1,0 +1,112 @@
+"""ProcessSandboxFactory — sandboxes as local subprocesses.
+
+The in-tree equivalent of the reference's Daytona cloud factory
+(src/sandbox/daytona.py:394-479: create-from-snapshot, connect, restart):
+each sandbox is a `python -m kafka_tpu.sandbox.server` subprocess on its
+own port, carrying the full sandbox protocol (health/claim/run/reset).
+Sandbox ids encode the port (`proc-<port>-<suffix>`) so `connect` can
+re-attach after a manager restart without any registry.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import socket
+import sys
+import uuid
+from typing import Dict, Optional
+
+from .base import Sandbox
+from .local import LocalSandbox
+from .manager import SandboxFactory
+
+logger = logging.getLogger("kafka_tpu.sandbox.process")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class ProcessSandboxFactory(SandboxFactory):
+    def __init__(self, boot_timeout_s: float = 30.0):
+        self.boot_timeout_s = boot_timeout_s
+        self._procs: Dict[str, asyncio.subprocess.Process] = {}
+
+    @staticmethod
+    def _url_for(sandbox_id: str) -> Optional[str]:
+        # proc-<port>-<suffix>
+        parts = sandbox_id.split("-")
+        if len(parts) < 3 or parts[0] != "proc":
+            return None
+        try:
+            port = int(parts[1])
+        except ValueError:
+            return None
+        return f"http://127.0.0.1:{port}"
+
+    async def _spawn(self, sandbox_id: str, port: int) -> None:
+        proc = await asyncio.create_subprocess_exec(
+            sys.executable, "-m", "kafka_tpu.sandbox.server",
+            "--port", str(port), "--sandbox-id", sandbox_id,
+            stdout=asyncio.subprocess.DEVNULL,
+            stderr=asyncio.subprocess.DEVNULL,
+        )
+        self._procs[sandbox_id] = proc
+
+    async def create(self, thread_id: str) -> Sandbox:
+        port = _free_port()
+        sandbox_id = f"proc-{port}-{uuid.uuid4().hex[:8]}"
+        await self._spawn(sandbox_id, port)
+        sandbox = LocalSandbox(self._url_for(sandbox_id), sandbox_id)
+        await sandbox.wait_until_live(
+            timeout=self.boot_timeout_s, poll_interval=0.1
+        )
+        logger.info("spawned sandbox %s for thread %s", sandbox_id, thread_id)
+        return sandbox
+
+    async def connect(self, sandbox_id: str) -> Optional[Sandbox]:
+        url = self._url_for(sandbox_id)
+        if url is None:
+            return None
+        sandbox = LocalSandbox(url, sandbox_id)
+        status = await sandbox.check_health()
+        if not status.get("healthy"):
+            # process may be gone entirely — only return a handle if the
+            # manager might still restart it through us
+            if sandbox_id not in self._procs:
+                await sandbox.aclose()
+                return None
+        return sandbox
+
+    async def restart(self, sandbox_id: str) -> Optional[Sandbox]:
+        url = self._url_for(sandbox_id)
+        if url is None:
+            return None
+        old = self._procs.pop(sandbox_id, None)
+        if old is not None and old.returncode is None:
+            old.kill()
+            await old.wait()
+        port = int(sandbox_id.split("-")[1])
+        try:
+            await self._spawn(sandbox_id, port)
+            sandbox = LocalSandbox(url, sandbox_id)
+            await sandbox.wait_until_live(
+                timeout=self.boot_timeout_s, poll_interval=0.1
+            )
+            return sandbox
+        except Exception as e:
+            logger.warning("restart of %s failed: %s", sandbox_id, e)
+            return None
+
+    async def terminate(self, sandbox_id: str) -> None:
+        proc = self._procs.pop(sandbox_id, None)
+        if proc is not None and proc.returncode is None:
+            proc.kill()
+            await proc.wait()
+
+    async def aclose(self) -> None:
+        for sandbox_id in list(self._procs):
+            await self.terminate(sandbox_id)

@@ -1,0 +1,286 @@
+"""The sandbox-side server: what runs INSIDE a sandbox.
+
+The reference's sandboxes are Daytona cloud VMs baked from a snapshot image
+whose contents are out-of-repo; the app only speaks their HTTP protocol
+(`GET /health`, `POST /claim`, `POST /run` streaming SSE — SURVEY §5.8).
+This module implements that protocol in-tree as an aiohttp app, so the
+whole sandbox tier runs end-to-end locally: the manager spawns one of these
+as a subprocess per thread (sandbox/process.py) the way the reference
+provisions a VM per thread.
+
+Tools served:
+  * `create_shell` / `shell_exec` — persistent bash sessions (stdout+stderr
+    merged, streamed line-by-line; reference server_tools/shell.py)
+  * `notebook_run_cell` — persistent Python namespace per kernel with
+    stdout capture and last-expression echo (reference notebook.py)
+  * `reset` clears shells/kernels; `claim` binds a thread config.
+
+SSE framing: `data: {json ToolEvent}` frames, terminated by `data: [DONE]`
+— byte-compatible with what LocalSandbox parses.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import asyncio
+import contextlib
+import io
+import json
+import logging
+import uuid
+from typing import Any, AsyncIterator, Dict, Optional
+
+from aiohttp import web
+
+logger = logging.getLogger("kafka_tpu.sandbox.server")
+
+SBX_KEY = web.AppKey("sandbox_state", dict)
+
+SHELL_SENTINEL = "__KAFKA_TPU_DONE__"
+
+
+class ShellSession:
+    """One persistent bash process with merged stdout/stderr."""
+
+    def __init__(self, shell_id: str):
+        self.shell_id = shell_id
+        self.proc: Optional[asyncio.subprocess.Process] = None
+        self._lock = asyncio.Lock()
+
+    async def start(self) -> None:
+        self.proc = await asyncio.create_subprocess_exec(
+            "bash", "--noprofile", "--norc", "-s",
+            stdin=asyncio.subprocess.PIPE,
+            stdout=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.STDOUT,
+        )
+
+    async def exec(
+        self, command: str, timeout: float = 30.0
+    ) -> AsyncIterator[Dict[str, Any]]:
+        """Run one command, yielding output lines then a terminal result."""
+        assert self.proc is not None and self.proc.stdin is not None
+        async with self._lock:  # one command at a time per shell
+            sentinel_cmd = f'\nprintf "%s %s\\n" "{SHELL_SENTINEL}" "$?"\n'
+            self.proc.stdin.write((command + sentinel_cmd).encode())
+            await self.proc.stdin.drain()
+            lines: list = []
+            exit_code: Optional[int] = None
+            assert self.proc.stdout is not None
+            try:
+                while True:
+                    line = await asyncio.wait_for(
+                        self.proc.stdout.readline(), timeout=timeout
+                    )
+                    if not line:  # shell died
+                        yield {"kind": "error",
+                               "data": "shell process exited unexpectedly"}
+                        return
+                    text = line.decode(errors="replace")
+                    if text.startswith(SHELL_SENTINEL):
+                        try:
+                            exit_code = int(text.split()[1])
+                        except (IndexError, ValueError):
+                            exit_code = -1
+                        break
+                    lines.append(text)
+                    yield {"kind": "delta", "data": text}
+            except asyncio.TimeoutError:
+                yield {
+                    "kind": "error",
+                    "data": f"command timed out after {timeout:.0f}s "
+                            f"(partial output: {''.join(lines)[-2000:]!r})",
+                }
+                # the shell may still be running the command; kill and
+                # replace the process so the session stays usable
+                self.proc.kill()
+                await self.start()
+                return
+            output = "".join(lines)
+            result = output if exit_code == 0 else (
+                f"{output}\n[exit code: {exit_code}]"
+            )
+            yield {"kind": "result", "data": result}
+
+    def close(self) -> None:
+        if self.proc is not None and self.proc.returncode is None:
+            self.proc.kill()
+
+
+class NotebookKernel:
+    """Persistent exec namespace with stdout capture + last-expr echo."""
+
+    def __init__(self, kernel_id: str):
+        self.kernel_id = kernel_id
+        self.ns: Dict[str, Any] = {"__name__": "__main__"}
+
+    def run_cell(self, code: str) -> str:
+        buf = io.StringIO()
+        try:
+            tree = ast.parse(code, mode="exec")
+        except SyntaxError as e:
+            raise RuntimeError(f"SyntaxError: {e}") from e
+        last_expr: Optional[ast.Expression] = None
+        if tree.body and isinstance(tree.body[-1], ast.Expr):
+            last_expr = ast.Expression(tree.body.pop().value)
+        with contextlib.redirect_stdout(buf):
+            exec(compile(tree, "<cell>", "exec"), self.ns)  # noqa: S102
+            if last_expr is not None:
+                value = eval(compile(last_expr, "<cell>", "eval"), self.ns)  # noqa: S307
+                if value is not None:
+                    print(repr(value), file=buf)
+        return buf.getvalue()
+
+
+def create_sandbox_app(sandbox_id: Optional[str] = None) -> web.Application:
+    app = web.Application()
+    app[SBX_KEY] = {
+        "sandbox_id": sandbox_id or f"sbx-{uuid.uuid4().hex[:12]}",
+        "claimed": False,
+        "claim_config": None,
+        "shells": {},  # shell_id -> ShellSession
+        "kernels": {},  # kernel_id -> NotebookKernel
+    }
+    r = app.router
+    r.add_get("/health", health)
+    r.add_post("/claim", claim)
+    r.add_post("/run", run_tool)
+    r.add_post("/reset", reset)
+    app.on_cleanup.append(_cleanup)
+    return app
+
+
+async def _cleanup(app: web.Application) -> None:
+    for shell in app[SBX_KEY]["shells"].values():
+        shell.close()
+
+
+async def health(request: web.Request) -> web.Response:
+    s = request.app[SBX_KEY]
+    return web.json_response({
+        "healthy": True,
+        "claimed": s["claimed"],
+        "sandbox_id": s["sandbox_id"],
+        "shells": sorted(s["shells"]),
+        "kernels": sorted(s["kernels"]),
+    })
+
+
+async def claim(request: web.Request) -> web.Response:
+    s = request.app[SBX_KEY]
+    try:
+        config = await request.json()
+    except Exception:
+        config = {}
+    if s["claimed"] and s["claim_config"] and config.get("thread_id") not in (
+        None, (s["claim_config"] or {}).get("thread_id")
+    ):
+        return web.json_response(
+            {"claimed": False, "error": "already claimed by another thread"},
+            status=409,
+        )
+    s["claimed"] = True
+    s["claim_config"] = config
+    return web.json_response({"claimed": True, "sandbox_id": s["sandbox_id"]})
+
+
+async def reset(request: web.Request) -> web.Response:
+    s = request.app[SBX_KEY]
+    for shell in s["shells"].values():
+        shell.close()
+    s["shells"].clear()
+    s["kernels"].clear()
+    s["claimed"] = False
+    s["claim_config"] = None
+    return web.json_response({"reset": True})
+
+
+async def run_tool(request: web.Request) -> web.StreamResponse:
+    s = request.app[SBX_KEY]
+    body = await request.json()
+    name = body.get("tool") or body.get("name")
+    args = body.get("arguments") or {}
+    if isinstance(args, str):
+        try:
+            args = json.loads(args)
+        except json.JSONDecodeError:
+            args = {"_raw": args}
+
+    resp = web.StreamResponse(
+        status=200,
+        headers={"Content-Type": "text/event-stream",
+                 "Cache-Control": "no-cache"},
+    )
+    await resp.prepare(request)
+
+    async def send(event: Dict[str, Any]) -> None:
+        await resp.write(
+            b"data: " + json.dumps(event, separators=(",", ":")).encode()
+            + b"\n\n"
+        )
+
+    try:
+        if name == "create_shell":
+            shell_id = args.get("shell_id") or f"shell-{len(s['shells'])}"
+            if shell_id not in s["shells"]:
+                session = ShellSession(shell_id)
+                await session.start()
+                s["shells"][shell_id] = session
+            await send({"kind": "result",
+                        "data": json.dumps({"shell_id": shell_id})})
+        elif name == "shell_exec":
+            shell_id = args.get("shell_id") or "default"
+            if shell_id not in s["shells"]:
+                session = ShellSession(shell_id)
+                await session.start()
+                s["shells"][shell_id] = session
+            timeout = float(args.get("timeout", 30.0))
+            async for ev in s["shells"][shell_id].exec(
+                args.get("command", ""), timeout=timeout
+            ):
+                await send(ev)
+        elif name == "notebook_run_cell":
+            kernel_id = args.get("kernel_id") or "default"
+            kernel = s["kernels"].setdefault(
+                kernel_id, NotebookKernel(kernel_id)
+            )
+            timeout = float(args.get("timeout", 300.0))
+            try:
+                out = await asyncio.wait_for(
+                    asyncio.to_thread(kernel.run_cell, args.get("code", "")),
+                    timeout=timeout,
+                )
+                await send({"kind": "result", "data": out})
+            except asyncio.TimeoutError:
+                await send({"kind": "error",
+                            "data": f"cell timed out after {timeout:.0f}s"})
+            except Exception as e:
+                await send({"kind": "error",
+                            "data": f"{type(e).__name__}: {e}"})
+        else:
+            await send({"kind": "error", "data": f"unknown sandbox tool: {name}"})
+    except Exception as e:
+        logger.exception("sandbox tool failed")
+        with contextlib.suppress(Exception):
+            await send({"kind": "error", "data": f"{type(e).__name__}: {e}"})
+    with contextlib.suppress(Exception):
+        await resp.write(b"data: [DONE]\n\n")
+        await resp.write_eof()
+    return resp
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(prog="kafka_tpu.sandbox.server")
+    p.add_argument("--port", type=int, default=8081)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--sandbox-id", default=None)
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    web.run_app(
+        create_sandbox_app(args.sandbox_id), host=args.host, port=args.port
+    )
+
+
+if __name__ == "__main__":
+    main()

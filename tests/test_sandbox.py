@@ -1,0 +1,400 @@
+"""Sandbox tier tests: the in-tree sandbox server protocol (health/claim/
+run SSE/reset), LocalSandbox byte-level SSE client, shell/notebook
+persistence, SandboxManager lifecycle (ready cache, pending dedupe,
+reuse/restart/create), LazySandbox resolution, and warm pools."""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp.test_utils import TestServer
+
+from kafka_tpu.db import LocalDBClient
+from kafka_tpu.sandbox import (
+    LazySandbox,
+    LocalSandbox,
+    SandboxConfig,
+    SandboxError,
+    SandboxFactory,
+    SandboxManager,
+    SandboxTool,
+    notebook_tools,
+    shell_tools,
+)
+from kafka_tpu.sandbox.server import create_sandbox_app
+from kafka_tpu.sandbox.warm import HTTPWarmSandboxFactory, ProcessWarmPool
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def start_sandbox(sandbox_id="sbx-test"):
+    """In-process sandbox server + a LocalSandbox client bound to it."""
+    server = TestServer(create_sandbox_app(sandbox_id))
+    await server.start_server()
+    url = f"http://127.0.0.1:{server.port}"
+    return server, LocalSandbox(url, sandbox_id)
+
+
+async def drain(sandbox, name, args):
+    events = []
+    async for ev in sandbox.run_tool(name, args):
+        events.append(ev)
+    return events
+
+
+class TestSandboxProtocol:
+    def test_health_and_claim(self):
+        async def go():
+            server, sbx = await start_sandbox()
+            try:
+                h = await sbx.check_health()
+                assert h["healthy"] and not h["claimed"]
+                ok = await sbx.claim(SandboxConfig(thread_id="t1"))
+                assert ok
+                h = await sbx.check_health()
+                assert h["claimed"]
+                # same thread re-claims fine
+                assert await sbx.claim(SandboxConfig(thread_id="t1"))
+                # different thread is rejected
+                assert not await sbx.claim(SandboxConfig(thread_id="t2"))
+                # reset clears the claim
+                await sbx.reset()
+                assert not (await sbx.check_health())["claimed"]
+            finally:
+                await sbx.aclose()
+                await server.close()
+
+        run(go())
+
+    def test_shell_exec_streams_and_persists(self):
+        async def go():
+            server, sbx = await start_sandbox()
+            try:
+                evs = await drain(sbx, "create_shell", {"shell_id": "s1"})
+                assert evs[-1].kind == "result"
+                assert json.loads(evs[-1].data)["shell_id"] == "s1"
+
+                evs = await drain(sbx, "shell_exec",
+                                  {"shell_id": "s1", "command": "cd /tmp && pwd"})
+                assert evs[-1].kind == "result"
+                assert "/tmp" in evs[-1].data
+                # cwd persisted across calls in the same shell
+                evs = await drain(sbx, "shell_exec",
+                                  {"shell_id": "s1", "command": "pwd"})
+                assert "/tmp" in evs[-1].data
+                # deltas streamed before the result
+                evs = await drain(
+                    sbx, "shell_exec",
+                    {"shell_id": "s1", "command": "echo a; echo b"})
+                deltas = [e for e in evs if e.kind == "delta"]
+                assert [d.data.strip() for d in deltas] == ["a", "b"]
+            finally:
+                await sbx.aclose()
+                await server.close()
+
+        run(go())
+
+    def test_shell_nonzero_exit_reported(self):
+        async def go():
+            server, sbx = await start_sandbox()
+            try:
+                evs = await drain(sbx, "shell_exec",
+                                  {"command": "exit 3"})
+                assert evs[-1].kind == "result"
+                assert "[exit code: 3]" in evs[-1].data
+            finally:
+                await sbx.aclose()
+                await server.close()
+
+        run(go())
+
+    def test_shell_timeout_recovers(self):
+        async def go():
+            server, sbx = await start_sandbox()
+            try:
+                evs = await drain(sbx, "shell_exec",
+                                  {"command": "sleep 5", "timeout": 0.5})
+                assert evs[-1].kind == "error"
+                assert "timed out" in evs[-1].data
+                # the session was replaced and still works
+                evs = await drain(sbx, "shell_exec", {"command": "echo ok"})
+                assert evs[-1].kind == "result" and "ok" in evs[-1].data
+            finally:
+                await sbx.aclose()
+                await server.close()
+
+        run(go())
+
+    def test_notebook_state_persists(self):
+        async def go():
+            server, sbx = await start_sandbox()
+            try:
+                evs = await drain(sbx, "notebook_run_cell", {"code": "x = 41"})
+                assert evs[-1].kind == "result"
+                evs = await drain(sbx, "notebook_run_cell", {"code": "x + 1"})
+                assert evs[-1].data.strip() == "42"
+                # stdout captured
+                evs = await drain(sbx, "notebook_run_cell",
+                                  {"code": "print('hi'); x"})
+                assert evs[-1].data == "hi\n41\n"
+                # errors are data
+                evs = await drain(sbx, "notebook_run_cell", {"code": "1/0"})
+                assert evs[-1].kind == "error"
+                assert "ZeroDivisionError" in evs[-1].data
+            finally:
+                await sbx.aclose()
+                await server.close()
+
+        run(go())
+
+    def test_unknown_tool_and_dead_sandbox(self):
+        async def go():
+            server, sbx = await start_sandbox()
+            try:
+                evs = await drain(sbx, "no_such", {})
+                assert evs[-1].kind == "error"
+            finally:
+                await sbx.aclose()
+                await server.close()
+            # after shutdown: connection error surfaces as error event
+            dead = LocalSandbox(f"http://127.0.0.1:{server.port}", "dead")
+            try:
+                evs = await drain(dead, "shell_exec", {"command": "echo"})
+                assert evs[-1].kind == "error"
+                assert not (await dead.check_health())["healthy"]
+            finally:
+                await dead.aclose()
+
+        run(go())
+
+
+class TestSandboxTools:
+    def test_shell_tool_through_tool_interface(self):
+        async def go():
+            server, sbx = await start_sandbox()
+            try:
+                create, execute = shell_tools(sbx)
+                out = await execute.run({"command": "echo via-tool"})
+                assert "via-tool" in out
+                (nb,) = notebook_tools(sbx)
+                out = await nb.run({"code": "2**10"})
+                assert out.strip() == "1024"
+            finally:
+                await sbx.aclose()
+                await server.close()
+
+        run(go())
+
+    def test_unbound_tool_errors_cleanly(self):
+        async def go():
+            (nb,) = notebook_tools(None)
+            events = [e async for e in nb.run_stream({"code": "1"})]
+            assert events[-1].kind == "error"
+            assert "no sandbox bound" in events[-1].data
+
+        run(go())
+
+
+class FakeSandbox(LocalSandbox):
+    """In-memory sandbox for manager tests (no HTTP)."""
+
+    def __init__(self, sandbox_id, healthy=True):
+        self.sandbox_id = sandbox_id
+        self.healthy = healthy
+        self.claimed = False
+        self.claims = []
+
+    async def check_health(self):
+        return {"healthy": self.healthy, "claimed": self.claimed}
+
+    async def claim(self, config):
+        self.claimed = True
+        self.claims.append(config)
+        return True
+
+    async def reset(self):
+        self.claimed = False
+
+    async def run_tool(self, name, arguments, tool_call_id=None, timeout=None):
+        from kafka_tpu.tools.types import ToolEvent
+
+        yield ToolEvent("result", f"{name} ran", tool_name=name)
+
+    async def aclose(self):
+        pass
+
+
+class FakeFactory(SandboxFactory):
+    def __init__(self):
+        self.sandboxes = {}
+        self.created = 0
+        self.restarted = []
+
+    async def create(self, thread_id):
+        self.created += 1
+        sbx = FakeSandbox(f"fake-{self.created}")
+        self.sandboxes[sbx.sandbox_id] = sbx
+        return sbx
+
+    async def connect(self, sandbox_id):
+        return self.sandboxes.get(sandbox_id)
+
+    async def restart(self, sandbox_id):
+        self.restarted.append(sandbox_id)
+        sbx = self.sandboxes.get(sandbox_id)
+        if sbx is not None:
+            sbx.healthy = True
+            sbx.claimed = False
+        return sbx
+
+
+@pytest.fixture()
+def db(tmp_path):
+    client = LocalDBClient(str(tmp_path / "sbx.db"))
+    run(client.initialize())
+    yield client
+    run(client.close())
+
+
+class TestManager:
+    def test_create_then_ready_cache(self, db):
+        async def go():
+            factory = FakeFactory()
+            mgr = SandboxManager(db, factory)
+            await db.create_thread("t1")
+            assert await mgr.get_sandbox_if_ready("t1") is None
+            sbx = await mgr.ensure_sandbox("t1")
+            assert sbx.claimed
+            assert sbx.claims[0].thread_id == "t1"
+            assert sbx.claims[0].env["THREAD_ID"] == "t1"
+            assert sbx.claims[0].vm_api_key.startswith("vmk_")
+            # id persisted; ready cache returns the same instance
+            assert await db.get_thread_sandbox_id("t1") == sbx.sandbox_id
+            assert await mgr.get_sandbox_if_ready("t1") is sbx
+            assert factory.created == 1
+            return factory
+
+        run(go())
+
+    def test_reuse_after_cache_loss(self, db):
+        async def go():
+            factory = FakeFactory()
+            mgr1 = SandboxManager(db, factory)
+            await db.create_thread("t1")
+            sbx = await mgr1.ensure_sandbox("t1")
+            # new manager (server restart): finds it via db + connect
+            mgr2 = SandboxManager(db, factory)
+            found = await mgr2.get_sandbox_if_ready("t1")
+            assert found is sbx
+            assert factory.created == 1
+
+        run(go())
+
+    def test_restart_when_dead(self, db):
+        async def go():
+            factory = FakeFactory()
+            mgr = SandboxManager(db, factory)
+            await db.create_thread("t1")
+            sbx = await mgr.ensure_sandbox("t1")
+            # kill it
+            sbx.healthy = False
+            mgr._ready.clear()
+            sbx2 = await mgr.ensure_sandbox("t1")
+            assert sbx2 is sbx  # restarted in place
+            assert factory.restarted == [sbx.sandbox_id]
+            assert sbx2.claimed
+
+        run(go())
+
+    def test_claim_reconciliation(self, db):
+        async def go():
+            factory = FakeFactory()
+            mgr = SandboxManager(db, factory)
+            await db.create_thread("t1")
+            sbx = await mgr.ensure_sandbox("t1")
+            sbx.claimed = False  # someone unclaimed it out-of-band
+            again = await mgr.get_sandbox_if_ready("t1")
+            assert again.claimed  # re-claimed on the readiness probe
+
+        run(go())
+
+    def test_background_creation_dedupes(self, db):
+        async def go():
+            factory = FakeFactory()
+            mgr = SandboxManager(db, factory)
+            await db.create_thread("t1")
+            mgr.ensure_sandbox_background("t1")
+            mgr.ensure_sandbox_background("t1")  # deduped by pending set
+            for _ in range(100):
+                if await mgr.get_sandbox_if_ready("t1") is not None:
+                    break
+                await asyncio.sleep(0.02)
+            assert factory.created == 1
+
+        run(go())
+
+    def test_release(self, db):
+        async def go():
+            factory = FakeFactory()
+            mgr = SandboxManager(db, factory)
+            await db.create_thread("t1")
+            sbx = await mgr.ensure_sandbox("t1")
+            await mgr.release_sandbox("t1")
+            assert not sbx.claimed  # reset
+            assert await mgr.get_sandbox_if_ready("t1") is sbx  # reconnects
+
+        run(go())
+
+
+class TestLazySandbox:
+    def test_resolves_when_ready(self, db):
+        async def go():
+            factory = FakeFactory()
+            mgr = SandboxManager(db, factory)
+            await db.create_thread("t1")
+            lazy = LazySandbox("t1", mgr, timeout=5.0)
+            mgr.ensure_sandbox_background("t1")
+            events = [e async for e in lazy.run_tool("anything", {})]
+            assert events[-1].kind == "result"
+            assert lazy.sandbox_id.startswith("fake-")
+
+        run(go())
+
+    def test_timeout_yields_error_event(self, db):
+        async def go():
+            factory = FakeFactory()
+            mgr = SandboxManager(db, factory)
+            await db.create_thread("t1")
+            lazy = LazySandbox("t1", mgr, timeout=0.3)
+            # nothing ever creates the sandbox
+            events = [e async for e in lazy.run_tool("x", {})]
+            assert events[-1].kind == "error"
+            assert "not ready" in events[-1].data
+
+        run(go())
+
+
+class TestWarmPools:
+    def test_http_pool_unreachable_returns_none(self):
+        async def go():
+            pool = HTTPWarmSandboxFactory("http://127.0.0.1:1", "env")
+            assert await pool.claim_warm() is None
+
+        run(go())
+
+    def test_process_pool_claims_and_manager_uses_it(self, db):
+        async def go():
+            factory = FakeFactory()
+            pool = ProcessWarmPool(factory, size=1)
+            await pool.fill()
+            warm_id = pool._pool[0]
+            mgr = SandboxManager(db, factory, warm_factory=pool)
+            await db.create_thread("t1")
+            sbx = await mgr.ensure_sandbox("t1")
+            assert sbx.sandbox_id == warm_id  # warm sandbox was used
+            await asyncio.sleep(0.05)  # let the background refill run
+            assert factory.created >= 2  # refill happened
+
+        run(go())
