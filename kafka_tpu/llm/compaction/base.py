@@ -26,8 +26,12 @@ CONTEXT_LENGTH_PATTERNS = (
     "context_length_exceeded",
     "context length",
     "maximum context",
-    "max_tokens",  # anthropic: prompt is too long: ... max_tokens
     "prompt is too long",
+    # anthropic: "input length and `max_tokens` exceed context limit" —
+    # matched on the distinctive phrase, not the bare "max_tokens" token,
+    # so validation errors like "max_tokens must be positive" don't
+    # trigger a pointless compaction retry
+    "exceed context limit",
     "too many tokens",
     "token limit",
     "input is too long",

@@ -34,6 +34,12 @@ class LocalSandbox(Sandbox):
         self.url = url.rstrip("/")
         self.sandbox_id = sandbox_id or self.url
         self._client = client or httpx.AsyncClient(timeout=None)
+        self._vm_api_key: Optional[str] = None
+
+    def _auth_headers(self) -> Dict[str, str]:
+        if self._vm_api_key:
+            return {"Authorization": f"Bearer {self._vm_api_key}"}
+        return {}
 
     async def aclose(self) -> None:
         await self._client.aclose()
@@ -71,6 +77,7 @@ class LocalSandbox(Sandbox):
                 "POST",
                 f"{self.url}/run",
                 json=payload,
+                headers=self._auth_headers(),
                 timeout=httpx.Timeout(10.0, read=timeout),
             ) as resp:
                 if resp.status_code != 200:
@@ -96,7 +103,11 @@ class LocalSandbox(Sandbox):
                         yield ev
                         if terminal_seen:
                             return
-        except httpx.HTTPError as e:
+        except Exception as e:
+            # httpx transport errors, malformed URLs (e.g. a sandbox whose
+            # port is gone — httpx.InvalidURL subclasses Exception, not
+            # HTTPError), and raw socket errors all mean the same thing to
+            # the agent: this sandbox is unreachable.
             yield ToolEvent(
                 "error", f"sandbox connection failed: {e}",
                 tool_name=name, tool_call_id=tool_call_id,
@@ -142,13 +153,22 @@ class LocalSandbox(Sandbox):
             if r.status_code == 409:
                 return False
             r.raise_for_status()
-            return bool(r.json().get("claimed"))
-        except httpx.HTTPError as e:
+            claimed = bool(r.json().get("claimed"))
+            if claimed and config.vm_api_key:
+                self._vm_api_key = config.vm_api_key
+            return claimed
+        except Exception as e:  # unreachable/malformed sandbox == not claimed
             logger.warning("claim failed for %s: %s", self.sandbox_id, e)
             return False
 
     async def reset(self) -> None:
         try:
-            await self._client.post(f"{self.url}/reset", timeout=10.0)
-        except httpx.HTTPError as e:
+            r = await self._client.post(
+                f"{self.url}/reset", headers=self._auth_headers(), timeout=10.0
+            )
+            r.raise_for_status()
+            # only a confirmed reset releases the key — the server still
+            # requires it otherwise
+            self._vm_api_key = None
+        except Exception as e:
             logger.warning("reset failed for %s: %s", self.sandbox_id, e)

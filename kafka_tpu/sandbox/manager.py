@@ -93,8 +93,19 @@ class SandboxManager:
             status = await sandbox.check_health()
             if status.get("healthy"):
                 if not status.get("claimed"):
-                    # claim reconciliation: re-claim with fresh config
-                    await sandbox.claim(await self.build_claim_config(thread_id))
+                    # claim reconciliation: re-claim with fresh config; a
+                    # failure means someone else claimed it in the gap —
+                    # drop it from the cache rather than serve a sandbox
+                    # whose tools will be rejected
+                    ok = await sandbox.claim(
+                        await self.build_claim_config(thread_id)
+                    )
+                    if not ok:
+                        logger.warning(
+                            "re-claim failed for %s; dropping", thread_id
+                        )
+                        self._ready.pop(thread_id, None)
+                        return None
                 return sandbox
             logger.warning("cached sandbox for %s went unhealthy", thread_id)
             self._ready.pop(thread_id, None)
@@ -112,8 +123,13 @@ class SandboxManager:
         status = await sandbox.check_health()
         if not status.get("healthy"):
             return None
-        if not status.get("claimed"):
-            await sandbox.claim(await self.build_claim_config(thread_id))
+        # Re-claim even when already claimed: a freshly connected client
+        # must (re)learn the vm_api_key or its tool calls are rejected.
+        # Same-thread re-claims presenting the key are idempotent
+        # server-side; a False here means the sandbox belongs to someone
+        # else (or the key rotated) — don't serve it.
+        if not await sandbox.claim(await self.build_claim_config(thread_id)):
+            return None
         self._ready[thread_id] = sandbox
         return sandbox
 
@@ -134,7 +150,11 @@ class SandboxManager:
             sandbox = await self._get_or_create(thread_id)
             await self.db.update_thread_sandbox_id(thread_id, sandbox.sandbox_id)
             await sandbox.wait_until_live(timeout=self.live_timeout_s)
-            await sandbox.claim(await self.build_claim_config(thread_id))
+            if not await sandbox.claim(await self.build_claim_config(thread_id)):
+                raise SandboxError(
+                    f"claim failed for thread {thread_id} on "
+                    f"sandbox {sandbox.sandbox_id}"
+                )
             self._ready[thread_id] = sandbox
             logger.info("sandbox %s ready for thread %s",
                         sandbox.sandbox_id, thread_id)
@@ -164,7 +184,11 @@ class SandboxManager:
             sandbox = await self._get_or_create(thread_id)
             await self.db.update_thread_sandbox_id(thread_id, sandbox.sandbox_id)
             await sandbox.wait_until_live(timeout=self.live_timeout_s)
-            await sandbox.claim(await self.build_claim_config(thread_id))
+            if not await sandbox.claim(await self.build_claim_config(thread_id)):
+                raise SandboxError(
+                    f"claim failed for thread {thread_id} on "
+                    f"sandbox {sandbox.sandbox_id}"
+                )
             self._ready[thread_id] = sandbox
             return sandbox
         finally:

@@ -37,8 +37,6 @@ logger = logging.getLogger("kafka_tpu.sandbox.server")
 
 SBX_KEY = web.AppKey("sandbox_state", dict)
 
-SHELL_SENTINEL = "__KAFKA_TPU_DONE__"
-
 
 class ShellSession:
     """One persistent bash process with merged stdout/stderr."""
@@ -47,8 +45,11 @@ class ShellSession:
         self.shell_id = shell_id
         self.proc: Optional[asyncio.subprocess.Process] = None
         self._lock = asyncio.Lock()
+        self._needs_respawn = False
 
     async def start(self) -> None:
+        if self.proc is not None and self.proc.returncode is None:
+            self.proc.kill()
         self.proc = await asyncio.create_subprocess_exec(
             "bash", "--noprofile", "--norc", "-s",
             stdin=asyncio.subprocess.PIPE,
@@ -59,49 +60,100 @@ class ShellSession:
     async def exec(
         self, command: str, timeout: float = 30.0
     ) -> AsyncIterator[Dict[str, Any]]:
-        """Run one command, yielding output lines then a terminal result."""
-        assert self.proc is not None and self.proc.stdin is not None
+        """Run one command, yielding output lines then a terminal result.
+
+        Commands run directly in the persistent shell (not a subshell) so
+        state like `cd`/exports persists across calls. Recovery invariants:
+
+        * a shell-terminating command (`exit 3`, a crash) ends the process
+          before the sentinel prints — the shell's own exit status becomes
+          the command's exit code and the next exec() respawns the shell;
+        * a command that leaves the shell in an unknown state (timeout, or
+          the HTTP client disconnecting mid-stream, which cancels this
+          generator at a yield) is killed in the `finally` below — no
+          `await` there, so it runs even under CancelledError/GeneratorExit
+          — and the next exec() respawns.
+        """
         async with self._lock:  # one command at a time per shell
-            sentinel_cmd = f'\nprintf "%s %s\\n" "{SHELL_SENTINEL}" "$?"\n'
-            self.proc.stdin.write((command + sentinel_cmd).encode())
-            await self.proc.stdin.drain()
-            lines: list = []
-            exit_code: Optional[int] = None
-            assert self.proc.stdout is not None
-            try:
-                while True:
-                    line = await asyncio.wait_for(
-                        self.proc.stdout.readline(), timeout=timeout
-                    )
-                    if not line:  # shell died
-                        yield {"kind": "error",
-                               "data": "shell process exited unexpectedly"}
-                        return
-                    text = line.decode(errors="replace")
-                    if text.startswith(SHELL_SENTINEL):
-                        try:
-                            exit_code = int(text.split()[1])
-                        except (IndexError, ValueError):
-                            exit_code = -1
-                        break
-                    lines.append(text)
-                    yield {"kind": "delta", "data": text}
-            except asyncio.TimeoutError:
-                yield {
-                    "kind": "error",
-                    "data": f"command timed out after {timeout:.0f}s "
-                            f"(partial output: {''.join(lines)[-2000:]!r})",
-                }
-                # the shell may still be running the command; kill and
-                # replace the process so the session stays usable
-                self.proc.kill()
+            if (self._needs_respawn or self.proc is None
+                    or self.proc.returncode is not None):
                 await self.start()
-                return
-            output = "".join(lines)
-            result = output if exit_code == 0 else (
-                f"{output}\n[exit code: {exit_code}]"
-            )
-            yield {"kind": "result", "data": result}
+                self._needs_respawn = False
+            assert self.proc.stdin is not None and self.proc.stdout is not None
+            # Per-exec random sentinel: output lines can never spoof it.
+            sentinel = f"__KAFKA_TPU_DONE_{uuid.uuid4().hex}__"
+            sentinel_cmd = f'\nprintf "%s %s\\n" "{sentinel}" "$?"\n'
+            # True while the shell may still be mid-command; cleared just
+            # before the terminal yield so a consumer that stops at the
+            # terminal event doesn't get its healthy shell killed.
+            dirty = True
+            try:
+                try:
+                    self.proc.stdin.write((command + sentinel_cmd).encode())
+                    await self.proc.stdin.drain()
+                except (BrokenPipeError, ConnectionResetError):
+                    # the pipe may break before the child is reaped
+                    # (returncode still None), so flag the respawn
+                    # explicitly rather than relying on returncode
+                    self._needs_respawn = True
+                    dirty = False
+                    yield {"kind": "error",
+                           "data": "shell was dead; respawning — retry"}
+                    return
+                lines: list = []
+                exit_code: Optional[int] = None
+                try:
+                    while True:
+                        line = await asyncio.wait_for(
+                            self.proc.stdout.readline(), timeout=timeout
+                        )
+                        if not line:  # stdout EOF: shell exited (`exit N`)…
+                            try:
+                                exit_code = await asyncio.wait_for(
+                                    self.proc.wait(), timeout=5.0
+                                )
+                            except asyncio.TimeoutError:
+                                # …or bash closed its own stdout but lives
+                                # on (e.g. `exec >&-`) — unusable either
+                                # way; kill rather than hold the lock
+                                self.proc.kill()
+                                exit_code = await self.proc.wait()
+                            break
+                        text = line.decode(errors="replace")
+                        # match mid-line too: output without a trailing
+                        # newline shares a line with the sentinel printf
+                        idx = text.find(sentinel)
+                        if idx != -1:
+                            if idx > 0:
+                                lines.append(text[:idx])
+                                yield {"kind": "delta", "data": text[:idx]}
+                            try:
+                                exit_code = int(text[idx:].split()[1])
+                            except (IndexError, ValueError):
+                                exit_code = -1
+                            break
+                        lines.append(text)
+                        yield {"kind": "delta", "data": text}
+                except asyncio.TimeoutError:
+                    # dirty stays True: the shell may still be running the
+                    # command; the finally kills it, next exec respawns
+                    yield {
+                        "kind": "error",
+                        "data": f"command timed out after {timeout:.0f}s "
+                                f"(partial output: {''.join(lines)[-2000:]!r})",
+                    }
+                    return
+                output = "".join(lines)
+                result = output if exit_code == 0 else (
+                    f"{output}\n[exit code: {exit_code}]"
+                )
+                dirty = False
+                yield {"kind": "result", "data": result}
+            finally:
+                if dirty:
+                    self._needs_respawn = True
+                    if self.proc is not None and self.proc.returncode is None:
+                        self.proc.kill()
 
     def close(self) -> None:
         if self.proc is not None and self.proc.returncode is None:
@@ -173,19 +225,60 @@ async def claim(request: web.Request) -> web.Response:
         config = await request.json()
     except Exception:
         config = {}
-    if s["claimed"] and s["claim_config"] and config.get("thread_id") not in (
-        None, (s["claim_config"] or {}).get("thread_id")
-    ):
-        return web.json_response(
-            {"claimed": False, "error": "already claimed by another thread"},
-            status=409,
-        )
+    existing = s["claim_config"] or {}
+    existing_key = existing.get("vm_api_key")
+    if s["claimed"]:
+        if existing_key:
+            # Once claimed with a key, re-claiming (which would overwrite
+            # the claim config, including the key) itself requires the key
+            # — otherwise an unauthenticated empty claim wipes the auth
+            # contract. A key holder may refresh without a thread_id.
+            presented = config.get("vm_api_key")
+            header = request.headers.get("Authorization")
+            if presented != existing_key and header != f"Bearer {existing_key}":
+                return web.json_response(
+                    {"claimed": False,
+                     "error": "missing or invalid vm_api_key"},
+                    status=401,
+                )
+            if config.get("thread_id") not in (None, existing.get("thread_id")):
+                return web.json_response(
+                    {"claimed": False,
+                     "error": "already claimed by another thread"},
+                    status=409,
+                )
+        # Keyless claim: only the exact same thread may overwrite the
+        # claim config (a claim presenting a NEW key must not be able to
+        # take over and lock the keyless owner out).
+        elif config.get("thread_id") != existing.get("thread_id"):
+            return web.json_response(
+                {"claimed": False,
+                 "error": "already claimed by another thread"},
+                status=409,
+            )
     s["claimed"] = True
     s["claim_config"] = config
     return web.json_response({"claimed": True, "sandbox_id": s["sandbox_id"]})
 
 
+def _auth_error(request: web.Request) -> Optional[web.Response]:
+    """Enforce the claim-config contract: once a claim carries a
+    vm_api_key, /run and /reset require it as a Bearer token."""
+    s = request.app[SBX_KEY]
+    key = (s["claim_config"] or {}).get("vm_api_key")
+    if not key:
+        return None
+    if request.headers.get("Authorization") == f"Bearer {key}":
+        return None
+    return web.json_response(
+        {"error": "missing or invalid vm_api_key"}, status=401
+    )
+
+
 async def reset(request: web.Request) -> web.Response:
+    err = _auth_error(request)
+    if err is not None:
+        return err
     s = request.app[SBX_KEY]
     for shell in s["shells"].values():
         shell.close()
@@ -197,6 +290,9 @@ async def reset(request: web.Request) -> web.Response:
 
 
 async def run_tool(request: web.Request) -> web.StreamResponse:
+    err = _auth_error(request)
+    if err is not None:
+        return err
     s = request.app[SBX_KEY]
     body = await request.json()
     name = body.get("tool") or body.get("name")

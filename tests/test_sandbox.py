@@ -170,6 +170,92 @@ class TestSandboxProtocol:
         run(go())
 
 
+class TestSandboxAuth:
+    def test_run_requires_key_once_claimed_with_one(self):
+        async def go():
+            server, sbx = await start_sandbox()
+            try:
+                cfg = SandboxConfig(thread_id="t1", vm_api_key="vmk_secret")
+                assert await sbx.claim(cfg)
+                # the claiming client remembers the key: authorized
+                evs = await drain(sbx, "shell_exec", {"command": "echo hi"})
+                assert evs[-1].kind == "result" and "hi" in evs[-1].data
+                # a stranger without the key is rejected
+                other = LocalSandbox(sbx.url, "other")
+                try:
+                    evs = await drain(other, "shell_exec",
+                                      {"command": "echo hi"})
+                    assert evs[-1].kind == "error"
+                    assert "401" in evs[-1].data
+                finally:
+                    await other.aclose()
+            finally:
+                await sbx.aclose()
+                await server.close()
+
+        run(go())
+
+    def test_keyless_reclaim_cannot_wipe_key(self):
+        async def go():
+            server, sbx = await start_sandbox()
+            try:
+                cfg = SandboxConfig(thread_id="t1", vm_api_key="vmk_secret")
+                assert await sbx.claim(cfg)
+                # an empty claim (no key) must NOT overwrite the claim
+                # config and drop the auth requirement
+                stranger = LocalSandbox(sbx.url, "stranger")
+                try:
+                    assert not await stranger.claim(SandboxConfig(thread_id="t1"))
+                    evs = [e async for e in stranger.run_tool(
+                        "shell_exec", {"command": "echo x"})]
+                    assert evs[-1].kind == "error" and "401" in evs[-1].data
+                finally:
+                    await stranger.aclose()
+                # same-thread re-claim presenting the key still works
+                assert await sbx.claim(cfg)
+            finally:
+                await sbx.aclose()
+                await server.close()
+
+        run(go())
+
+    def test_reconnect_relearns_key_via_reclaim(self):
+        async def go():
+            server, sbx = await start_sandbox()
+            try:
+                cfg = SandboxConfig(thread_id="t1", vm_api_key="vmk_secret")
+                assert await sbx.claim(cfg)
+                # orchestrator restart: fresh client, sandbox still claimed.
+                # Re-claiming with the same key (from the DB) re-arms the
+                # client; without it, every tool call would 401.
+                fresh = LocalSandbox(sbx.url, "fresh")
+                try:
+                    assert await fresh.claim(cfg)
+                    evs = [e async for e in fresh.run_tool(
+                        "shell_exec", {"command": "echo back"})]
+                    assert evs[-1].kind == "result" and "back" in evs[-1].data
+                finally:
+                    await fresh.aclose()
+            finally:
+                await sbx.aclose()
+                await server.close()
+
+        run(go())
+
+    def test_no_key_claim_stays_open(self):
+        async def go():
+            server, sbx = await start_sandbox()
+            try:
+                assert await sbx.claim(SandboxConfig(thread_id="t1"))
+                evs = await drain(sbx, "shell_exec", {"command": "echo open"})
+                assert evs[-1].kind == "result" and "open" in evs[-1].data
+            finally:
+                await sbx.aclose()
+                await server.close()
+
+        run(go())
+
+
 class TestSandboxTools:
     def test_shell_tool_through_tool_interface(self):
         async def go():
