@@ -35,6 +35,17 @@ logger = logging.getLogger("kafka_tpu.sandbox.manager")
 RESTART_GRACE_S = 60.0  # reference manager.py: 60s grace before declaring dead
 
 
+async def _aclose_quiet(sandbox: Sandbox) -> None:
+    """Close a dropped sandbox handle without letting close errors mask
+    the drop decision (each handle owns an httpx client)."""
+    aclose = getattr(sandbox, "aclose", None)
+    if aclose is not None:
+        try:
+            await aclose()
+        except Exception:
+            logger.debug("sandbox aclose failed", exc_info=True)
+
+
 class SandboxFactory(abc.ABC):
     """Provisioning policy: how sandboxes are created/found/restarted."""
 
@@ -105,10 +116,12 @@ class SandboxManager:
                             "re-claim failed for %s; dropping", thread_id
                         )
                         self._ready.pop(thread_id, None)
+                        await _aclose_quiet(sandbox)
                         return None
                 return sandbox
             logger.warning("cached sandbox for %s went unhealthy", thread_id)
             self._ready.pop(thread_id, None)
+            await _aclose_quiet(sandbox)
 
         if thread_id in self._pending:
             return None
@@ -122,13 +135,17 @@ class SandboxManager:
             return None
         status = await sandbox.check_health()
         if not status.get("healthy"):
+            await _aclose_quiet(sandbox)
             return None
         # Re-claim even when already claimed: a freshly connected client
         # must (re)learn the vm_api_key or its tool calls are rejected.
         # Same-thread re-claims presenting the key are idempotent
         # server-side; a False here means the sandbox belongs to someone
-        # else (or the key rotated) — don't serve it.
+        # else (or the key rotated) — don't serve it. Close what we drop:
+        # LazySandbox re-polls this path every 200ms and each miss would
+        # otherwise leak a connected httpx client.
         if not await sandbox.claim(await self.build_claim_config(thread_id)):
+            await _aclose_quiet(sandbox)
             return None
         self._ready[thread_id] = sandbox
         return sandbox
@@ -146,6 +163,7 @@ class SandboxManager:
         self._tasks[thread_id] = task
 
     async def _ensure_sandbox_task(self, thread_id: str) -> None:
+        sandbox: Optional[Sandbox] = None
         try:
             sandbox = await self._get_or_create(thread_id)
             await self.db.update_thread_sandbox_id(thread_id, sandbox.sandbox_id)
@@ -160,6 +178,8 @@ class SandboxManager:
                         sandbox.sandbox_id, thread_id)
         except Exception:
             logger.exception("sandbox creation failed for thread %s", thread_id)
+            if sandbox is not None:
+                await _aclose_quiet(sandbox)
         finally:
             self._pending.discard(thread_id)
             self._tasks.pop(thread_id, None)

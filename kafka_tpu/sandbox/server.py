@@ -81,8 +81,16 @@ class ShellSession:
                 self._needs_respawn = False
             assert self.proc.stdin is not None and self.proc.stdout is not None
             # Per-exec random sentinel: output lines can never spoof it.
-            sentinel = f"__KAFKA_TPU_DONE_{uuid.uuid4().hex}__"
-            sentinel_cmd = f'\nprintf "%s %s\\n" "{sentinel}" "$?"\n'
+            # The printf SPLITS the sentinel across two arguments so the
+            # contiguous sentinel string never appears in the command text
+            # itself — a stdin-consuming command (`cat`) that swallows and
+            # echoes the printf line as data therefore cannot false-match;
+            # only the expanded printf output contains the joined sentinel.
+            token = uuid.uuid4().hex
+            sentinel = f"__KAFKA_TPU_DONE_{token}__"
+            sentinel_cmd = (
+                f'\nprintf "%s%s %s\\n" "__KAFKA_TPU_DONE_" "{token}__" "$?"\n'
+            )
             # True while the shell may still be mid-command; cleared just
             # before the terminal yield so a consumer that stops at the
             # terminal event doesn't get its healthy shell killed.
@@ -224,7 +232,17 @@ async def claim(request: web.Request) -> web.Response:
     try:
         config = await request.json()
     except Exception:
-        config = {}
+        # a malformed body must not become a real (keyless, threadless)
+        # claim that then 409-blocks the legitimate owner
+        return web.json_response(
+            {"claimed": False, "error": "claim body must be a JSON object"},
+            status=400,
+        )
+    if not isinstance(config, dict):
+        return web.json_response(
+            {"claimed": False, "error": "claim body must be a JSON object"},
+            status=400,
+        )
     existing = s["claim_config"] or {}
     existing_key = existing.get("vm_api_key")
     if s["claimed"]:
@@ -247,17 +265,27 @@ async def claim(request: web.Request) -> web.Response:
                      "error": "already claimed by another thread"},
                     status=409,
                 )
-        # Keyless claim: only the exact same thread may overwrite the
-        # claim config (a claim presenting a NEW key must not be able to
-        # take over and lock the keyless owner out).
-        elif config.get("thread_id") != existing.get("thread_id"):
+        # Keyless claim: only the exact same thread (or anyone, when no
+        # thread owns it) may overwrite the claim config — a claim
+        # presenting a NEW key must not be able to take over and lock the
+        # keyless owner out.
+        elif (existing.get("thread_id") is not None
+              and config.get("thread_id") != existing.get("thread_id")):
             return web.json_response(
                 {"claimed": False,
                  "error": "already claimed by another thread"},
                 status=409,
             )
+    # Merge rather than replace: a key-holder refresh that authenticated
+    # via the Authorization header (body without vm_api_key) must not wipe
+    # the stored key — that would disable /run//reset auth; same for an
+    # omitted thread_id erasing the thread binding.
+    merged = dict(config)
+    for sticky in ("vm_api_key", "thread_id"):
+        if merged.get(sticky) is None and existing.get(sticky) is not None:
+            merged[sticky] = existing[sticky]
     s["claimed"] = True
-    s["claim_config"] = config
+    s["claim_config"] = merged
     return web.json_response({"claimed": True, "sandbox_id": s["sandbox_id"]})
 
 

@@ -129,6 +129,13 @@ async def create_app(
         except Exception as e:  # server_tools are optional at boot
             logger.warning("builtin tools unavailable: %s", e)
             tools = []
+    if mcp_servers is None:
+        # reference server_tools/mcp_servers.py:8-13; override with
+        # KAFKA_TPU_MCP_SERVERS (JSON list, '[]' disables). Unreachable
+        # servers are skipped with a warning at connect time.
+        from ..server_tools.mcp_servers import default_mcp_servers
+
+        mcp_servers = default_mcp_servers()
 
     kafka = KafkaV1Provider(
         llm_provider,

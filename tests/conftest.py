@@ -18,6 +18,9 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+# Hermetic suite: never dial the default remote MCP server from tests
+# (individual tests override this to exercise the config parser).
+os.environ.setdefault("KAFKA_TPU_MCP_SERVERS", "[]")
 
 import jax  # noqa: E402
 

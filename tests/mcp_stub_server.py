@@ -1,0 +1,109 @@
+"""Scripted stdio MCP server used by tests/test_mcp.py.
+
+Speaks newline-delimited JSON-RPC 2.0 on stdin/stdout: answers
+`initialize`, `tools/list` (an `echo` tool and a `progress_echo` tool that
+emits two progress notifications first), and `tools/call`.
+"""
+
+import json
+import sys
+
+TOOLS = [
+    {
+        "name": "echo",
+        "description": "Echo the input back.",
+        "inputSchema": {
+            "type": "object",
+            "properties": {"text": {"type": "string"}},
+            "required": ["text"],
+        },
+    },
+    {
+        "name": "progress_echo",
+        "description": "Echo with progress notifications.",
+        "inputSchema": {
+            "type": "object",
+            "properties": {"text": {"type": "string"}},
+        },
+    },
+    {
+        "name": "fail",
+        "description": "Always reports a tool error.",
+        "inputSchema": {"type": "object", "properties": {}},
+    },
+]
+
+
+def send(msg):
+    sys.stdout.write(json.dumps(msg) + "\n")
+    sys.stdout.flush()
+
+
+def main():
+    for line in sys.stdin:
+        line = line.strip()
+        if not line:
+            continue
+        msg = json.loads(line)
+        method = msg.get("method")
+        msg_id = msg.get("id")
+        if method == "initialize":
+            send({
+                "jsonrpc": "2.0",
+                "id": msg_id,
+                "result": {
+                    "protocolVersion": msg["params"]["protocolVersion"],
+                    "capabilities": {"tools": {}},
+                    "serverInfo": {"name": "stub", "version": "1.0"},
+                },
+            })
+        elif method == "notifications/initialized":
+            pass
+        elif method == "tools/list":
+            send({"jsonrpc": "2.0", "id": msg_id,
+                  "result": {"tools": TOOLS}})
+        elif method == "tools/call":
+            params = msg.get("params", {})
+            name = params.get("name")
+            args = params.get("arguments", {})
+            token = params.get("_meta", {}).get("progressToken")
+            if name == "progress_echo" and token is not None:
+                for i in (1, 2):
+                    send({
+                        "jsonrpc": "2.0",
+                        "method": "notifications/progress",
+                        "params": {"progressToken": token, "progress": i,
+                                   "total": 2, "message": f"step {i}"},
+                    })
+            if name in ("echo", "progress_echo"):
+                send({
+                    "jsonrpc": "2.0", "id": msg_id,
+                    "result": {"content": [
+                        {"type": "text",
+                         "text": f"echo: {args.get('text', '')}"}
+                    ]},
+                })
+            elif name == "fail":
+                send({
+                    "jsonrpc": "2.0", "id": msg_id,
+                    "result": {"isError": True, "content": [
+                        {"type": "text", "text": "it broke"}
+                    ]},
+                })
+            else:
+                send({
+                    "jsonrpc": "2.0", "id": msg_id,
+                    "error": {"code": -32602,
+                              "message": f"unknown tool {name}"},
+                })
+        else:
+            if msg_id is not None:
+                send({
+                    "jsonrpc": "2.0", "id": msg_id,
+                    "error": {"code": -32601,
+                              "message": f"unknown method {method}"},
+                })
+
+
+if __name__ == "__main__":
+    main()

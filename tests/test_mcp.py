@@ -1,0 +1,288 @@
+"""MCP client tests: stdio round-trip against a scripted server, graceful
+connect failure, streamable-HTTP against an in-process server, and content
+flattening. Behavior parity: reference src/tools/agent.py:63-380."""
+
+import asyncio
+import json
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+
+import pytest
+
+from kafka_tpu.tools.mcp import (
+    MCPClientError,
+    MCPConnection,
+    _flatten_content,
+    _iter_sse_datas,
+)
+from kafka_tpu.tools.provider import AgentToolProvider
+from kafka_tpu.tools.types import MCPServerConfig
+
+STUB = str(Path(__file__).parent / "mcp_stub_server.py")
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def stdio_config(name="stub"):
+    return MCPServerConfig(name=name, command=sys.executable, args=[STUB])
+
+
+# ---------------------------------------------------------------------------
+# stdio transport
+# ---------------------------------------------------------------------------
+
+
+def test_stdio_connect_and_discover():
+    async def impl():
+        conn = MCPConnection(stdio_config(), timeout=10.0)
+        await conn.connect()
+        try:
+            assert conn.connected
+            assert conn.server_info["name"] == "stub"
+            tools = conn.discovered_tools()
+            assert {t.name for t in tools} == {"echo", "progress_echo",
+                                               "fail"}
+            echo = next(t for t in tools if t.name == "echo")
+            oai = echo.to_openai()
+            assert oai["function"]["parameters"]["required"] == ["text"]
+            assert echo.source == "mcp"
+        finally:
+            await conn.disconnect()
+
+    run(impl())
+
+
+def test_stdio_tool_call_roundtrip():
+    async def impl():
+        conn = MCPConnection(stdio_config(), timeout=10.0)
+        await conn.connect()
+        try:
+            assert await conn.call_tool("echo", {"text": "hi"}) == "echo: hi"
+        finally:
+            await conn.disconnect()
+
+    run(impl())
+
+
+def test_stdio_progress_streams_as_log_events():
+    async def impl():
+        conn = MCPConnection(stdio_config(), timeout=10.0)
+        await conn.connect()
+        try:
+            events = []
+            async for ev in conn.call_tool_stream("progress_echo",
+                                                  {"text": "x"}):
+                events.append(ev)
+            assert events[-1].kind == "result"
+            assert events[-1].data == "echo: x"
+            logs = [e.data for e in events if e.kind == "log"]
+            assert "step 1" in logs and "step 2" in logs
+        finally:
+            await conn.disconnect()
+
+    run(impl())
+
+
+def test_stdio_tool_error_is_error_event():
+    async def impl():
+        conn = MCPConnection(stdio_config(), timeout=10.0)
+        await conn.connect()
+        try:
+            events = [ev async for ev in conn.call_tool_stream("fail", {})]
+            assert events[-1].kind == "error"
+            assert "it broke" in events[-1].data
+        finally:
+            await conn.disconnect()
+
+    run(impl())
+
+
+def test_stdio_unknown_tool_jsonrpc_error():
+    async def impl():
+        conn = MCPConnection(stdio_config(), timeout=10.0)
+        await conn.connect()
+        try:
+            events = [ev async for ev in conn.call_tool_stream("nope", {})]
+            assert events[-1].kind == "error"
+            assert "unknown tool" in events[-1].data
+        finally:
+            await conn.disconnect()
+
+    run(impl())
+
+
+def test_spawn_failure_raises_mcp_error():
+    async def impl():
+        cfg = MCPServerConfig(name="bad", command="/nonexistent-binary-xyz")
+        conn = MCPConnection(cfg, timeout=5.0)
+        with pytest.raises(MCPClientError):
+            await conn.connect()
+
+    run(impl())
+
+
+# ---------------------------------------------------------------------------
+# provider integration: failures warn-and-skip, successes register tools
+# ---------------------------------------------------------------------------
+
+
+def test_provider_skips_unreachable_server():
+    async def impl():
+        provider = AgentToolProvider(mcp_servers=[
+            MCPServerConfig(name="dead", url="http://127.0.0.1:1",
+                            transport="streamable-http"),
+        ])
+        # must not raise (reference src/tools/agent.py:494-496)
+        await provider.connect()
+        assert provider.get_tools() == []
+        await provider.disconnect()
+
+    run(impl())
+
+
+def test_provider_registers_and_runs_mcp_tools():
+    async def impl():
+        provider = AgentToolProvider(mcp_servers=[stdio_config()])
+        await provider.connect()
+        try:
+            names = {t["function"]["name"] for t in provider.get_tools()}
+            assert "echo" in names
+            events = []
+            async for ev in provider.run_tool_stream(
+                "echo", {"text": "yo"}, tool_call_id="call_1"
+            ):
+                events.append(ev)
+            assert events[-1].kind == "result"
+            assert events[-1].data == "echo: yo"
+            assert events[-1].tool_call_id == "call_1"
+        finally:
+            await provider.disconnect()
+
+    run(impl())
+
+
+# ---------------------------------------------------------------------------
+# streamable-HTTP transport against an in-process server
+# ---------------------------------------------------------------------------
+
+
+class _HTTPStub(BaseHTTPRequestHandler):
+    def do_POST(self):
+        body = self.rfile.read(int(self.headers.get("Content-Length", 0)))
+        msg = json.loads(body)
+        method = msg.get("method")
+        msg_id = msg.get("id")
+        if msg_id is None:  # notification
+            self.send_response(202)
+            self.end_headers()
+            return
+        if method == "initialize":
+            result = {
+                "protocolVersion": msg["params"]["protocolVersion"],
+                "capabilities": {"tools": {}},
+                "serverInfo": {"name": "httpstub", "version": "1"},
+            }
+        elif method == "tools/list":
+            result = {"tools": [{
+                "name": "ping", "description": "",
+                "inputSchema": {"type": "object", "properties": {}},
+            }]}
+        elif method == "tools/call":
+            # reply as an SSE body to exercise the event-stream parse path
+            payload = json.dumps({
+                "jsonrpc": "2.0", "id": msg_id,
+                "result": {"content": [{"type": "text", "text": "pong"}]},
+            })
+            data = f"event: message\ndata: {payload}\n\n".encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+            return
+        else:
+            result = {}
+        data = json.dumps(
+            {"jsonrpc": "2.0", "id": msg_id, "result": result}
+        ).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Mcp-Session-Id", "sess-1")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def log_message(self, *args):
+        pass
+
+
+@pytest.fixture
+def http_stub():
+    server = ThreadingHTTPServer(("127.0.0.1", 0), _HTTPStub)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    yield f"http://127.0.0.1:{server.server_address[1]}/mcp"
+    server.shutdown()
+    thread.join(timeout=5)
+
+
+def test_streamable_http_roundtrip(http_stub):
+    async def impl():
+        conn = MCPConnection(
+            MCPServerConfig(name="h", url=http_stub,
+                            transport="streamable-http"),
+            timeout=10.0,
+        )
+        await conn.connect()
+        try:
+            assert conn.server_info["name"] == "httpstub"
+            assert conn._transport._session_id == "sess-1"
+            assert {t.name for t in conn.discovered_tools()} == {"ping"}
+            assert await conn.call_tool("ping", {}) == "pong"
+        finally:
+            await conn.disconnect()
+
+    run(impl())
+
+
+# ---------------------------------------------------------------------------
+# pure helpers
+# ---------------------------------------------------------------------------
+
+
+def test_flatten_content_blocks():
+    assert _flatten_content({"content": [
+        {"type": "text", "text": "a"},
+        {"type": "text", "text": "b"},
+        {"type": "resource", "resource": {"uri": "file:///x"}},
+    ]}) == "a\nb\nfile:///x"
+    assert _flatten_content({"structuredContent": {"k": 1}}) == '{"k": 1}'
+    assert _flatten_content(None) == ""
+
+
+def test_iter_sse_datas():
+    body = ("event: message\ndata: {\"a\": 1}\n\n"
+            "data: line1\ndata: line2\n\n")
+    assert list(_iter_sse_datas(body)) == ['{"a": 1}', "line1\nline2"]
+
+
+def test_default_mcp_servers_env(monkeypatch):
+    from kafka_tpu.server_tools.mcp_servers import default_mcp_servers
+
+    monkeypatch.setenv("KAFKA_TPU_MCP_SERVERS", json.dumps([
+        {"name": "x", "url": "http://localhost:9"},
+        {"bogus_field": 1},
+    ]))
+    servers = default_mcp_servers()
+    assert len(servers) == 1 and servers[0].name == "x"
+
+    monkeypatch.setenv("KAFKA_TPU_MCP_SERVERS", "[]")
+    assert default_mcp_servers() == []
+
+    monkeypatch.delenv("KAFKA_TPU_MCP_SERVERS")
+    defaults = default_mcp_servers()
+    assert len(defaults) == 1 and defaults[0].name == "fetch"

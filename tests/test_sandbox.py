@@ -242,6 +242,105 @@ class TestSandboxAuth:
 
         run(go())
 
+    def test_stdin_consuming_command_cannot_spoof_sentinel(self):
+        # `cat` swallows the sentinel printf line and echoes it as DATA;
+        # the split-argument printf means the echoed command text never
+        # contains the contiguous sentinel, so exec times out (correct)
+        # instead of false-matching and returning garbage forever.
+        async def go():
+            server, sbx = await start_sandbox()
+            try:
+                evs = await drain(sbx, "shell_exec",
+                                  {"command": "cat", "timeout": 2})
+                assert evs[-1].kind == "error"
+                assert "timed out" in evs[-1].data
+                # the session respawned; the next exec is clean
+                evs = await drain(sbx, "shell_exec",
+                                  {"command": "echo clean"})
+                assert evs[-1].kind == "result"
+                assert "clean" in evs[-1].data
+            finally:
+                await sbx.aclose()
+                await server.close()
+
+        run(go())
+
+    def test_header_auth_reclaim_preserves_key(self):
+        # A key-holder refresh authenticated via the Authorization header
+        # whose body omits vm_api_key must not wipe the stored key.
+        async def go():
+            import httpx
+
+            server, sbx = await start_sandbox()
+            try:
+                cfg = SandboxConfig(thread_id="t1", vm_api_key="vmk_secret")
+                assert await sbx.claim(cfg)
+                async with httpx.AsyncClient() as client:
+                    r = await client.post(
+                        f"{sbx.url}/claim",
+                        json={"thread_id": "t1"},
+                        headers={"Authorization": "Bearer vmk_secret"},
+                    )
+                    assert r.status_code == 200 and r.json()["claimed"]
+                    # auth is still enforced: unauthenticated /run 401s
+                    r = await client.post(
+                        f"{sbx.url}/run",
+                        json={"tool": "shell_exec",
+                              "arguments": {"command": "echo x"}},
+                    )
+                    assert r.status_code == 401
+            finally:
+                await sbx.aclose()
+                await server.close()
+
+        run(go())
+
+    def test_malformed_claim_body_rejected(self):
+        # Garbage claim bodies must not become real claims that 409-block
+        # the legitimate owner.
+        async def go():
+            import httpx
+
+            server, sbx = await start_sandbox()
+            try:
+                async with httpx.AsyncClient() as client:
+                    r = await client.post(
+                        f"{sbx.url}/claim",
+                        content=b"{not json",
+                        headers={"Content-Type": "application/json"},
+                    )
+                    assert r.status_code == 400
+                    r = await client.post(f"{sbx.url}/claim", json=[1, 2])
+                    assert r.status_code == 400
+                h = await sbx.check_health()
+                assert not h["claimed"]
+                assert await sbx.claim(SandboxConfig(thread_id="t1"))
+            finally:
+                await sbx.aclose()
+                await server.close()
+
+        run(go())
+
+    def test_threadless_keyless_claim_can_be_taken_over(self):
+        # A probe's `{}` claim binds no thread; the real owner's claim
+        # must still succeed rather than 409.
+        async def go():
+            import httpx
+
+            server, sbx = await start_sandbox()
+            try:
+                async with httpx.AsyncClient() as client:
+                    r = await client.post(f"{sbx.url}/claim", json={})
+                    assert r.status_code == 200
+                assert await sbx.claim(SandboxConfig(thread_id="t1"))
+                h = await sbx.check_health()
+                assert h["claimed"]
+            finally:
+                await sbx.aclose()
+                await server.close()
+
+        run(go())
+
     def test_no_key_claim_stays_open(self):
         async def go():
             server, sbx = await start_sandbox()
