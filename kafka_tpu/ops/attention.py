@@ -51,22 +51,34 @@ def causal_attention(
     Works for prefill (Sq == Skv), chunked prefill, and decode (Sq == 1)
     against a longer cache.
     """
-    n_rep = q.shape[2] // k.shape[2]
-    k = repeat_kv(k, n_rep)
-    v = repeat_kv(v, n_rep)
+    b, sq, hq, d = q.shape
+    hkv = k.shape[2]
+    n_rep = hq // hkv
     if scale is None:
-        scale = q.shape[-1] ** -0.5
+        scale = d**-0.5
 
-    qf = q.astype(jnp.float32) * scale
-    kf = k.astype(jnp.float32)
-    # [B, H, Sq, Skv]
-    logits = jnp.einsum("bqhd,bkhd->bhqk", qf, kf)
+    # Grouped GQA formulation: fold the repeat factor into the einsum batch
+    # dims instead of materializing n_rep copies of K/V (repeat_kv would
+    # stream the whole KV window through HBM n_rep times per layer).  The
+    # matmuls take bf16 inputs with f32 accumulation (the MXU-native mode);
+    # only the [.., Sq, Skv] score tensor is ever f32.
+    qg = q.reshape(b, sq, hkv, n_rep, d)
+    # [B, Hkv, G, Sq, Skv]
+    logits = (
+        jnp.einsum("bqhgd,bkhd->bhgqk", qg, k, preferred_element_type=jnp.float32)
+        * scale
+    )
 
-    mask = q_positions[:, None, :, None] >= kv_positions[:, None, None, :]
+    mask = q_positions[:, None, None, :, None] >= kv_positions[:, None, None, None, :]
     if kv_valid is not None:
-        mask = mask & kv_valid[:, None, None, :]
+        mask = mask & kv_valid[:, None, None, None, :]
     logits = jnp.where(mask, logits, NEG_INF)
 
     probs = jax.nn.softmax(logits, axis=-1)
-    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
-    return out.astype(q.dtype)
+    out = jnp.einsum(
+        "bhgqk,bkhd->bqhgd",
+        probs.astype(v.dtype),
+        v,
+        preferred_element_type=jnp.float32,
+    )
+    return out.reshape(b, sq, hq, d).astype(q.dtype)

@@ -14,16 +14,28 @@ compute.  Architecture:
   trash page and their samples are discarded).  Nothing recompiles as
   requests come and go — the continuous-batching invariant that keeps XLA
   happy.
-* **Index plans on device.** The decode step derives its paged read/write
-  indices from (page_table, seq_lens) inside jit; per step the host uploads
-  only small int arrays and downloads one [B] token vector.
+* **Device-resident decode state.** The control arrays the decode step
+  consumes (page table, last tokens, sequence lengths, sampling params) live
+  on the device between steps.  The step function returns the next step's
+  `last_tokens` and `seq_lens`, so in steady state the host uploads
+  *nothing* — it re-uploads control arrays only when scheduling changes them
+  (admit/retire/page-growth), and `last_tokens` is never round-tripped.
+* **Pipelined async token fetch.** Device→host transfers are the latency
+  killer (on tunneled TPUs a blocking fetch costs ~100ms — ~40x the step
+  itself).  Each step's sampled-token vector starts an async copy and joins
+  a FIFO; the host only blocks on a fetch once `fetch_lag` newer steps have
+  been dispatched behind it, by which point the transfer has long landed.
+  Token events are therefore emitted a few steps late; the scheduler
+  reconciles (stop tokens found in flight truncate the output and retire
+  the slot, which at worst wasted `fetch_lag` speculative decode steps).
 * **Host-side scheduler** (`step()`): admit waiting requests when a batch
-  slot + pages are free (prefill), then run one decode for everyone, then
-  retire finished sequences.  Preemption: if page allocation fails
-  mid-decode, the youngest request is rolled back to the waiting queue and
-  its pages freed (it will re-prefill later — the conversation itself is
-  durable in the thread store, which is the recovery model the reference
-  uses for sandboxes, SURVEY §5.4).
+  slot + pages are free (prefill), dispatch one decode for everyone, drain
+  matured token fetches, retire finished sequences.  Preemption: if page
+  allocation fails mid-decode, in-flight fetches are drained and the
+  youngest request is rolled back to the waiting queue with its pages freed
+  (it will re-prefill later — the conversation itself is durable in the
+  thread store, which is the recovery model the reference uses for
+  sandboxes, SURVEY §5.4).
 
 Determinism note: with f32 compute ("highest" matmul precision) resumed
 requests reproduce their solo trajectories exactly (tested).  At serving
@@ -62,7 +74,7 @@ from .kv_cache import (
 
 logger = logging.getLogger("kafka_tpu.engine")
 
-WAITING, ACTIVE, FINISHED = "waiting", "active", "finished"
+WAITING, ACTIVE, DRAINING, FINISHED = "waiting", "active", "draining", "finished"
 
 # Compiled step functions are cached per (model cfg, engine shape) so that
 # multiple engine instances (tests, restarts) reuse compilations.
@@ -77,6 +89,14 @@ class EngineConfig:
     max_pages_per_seq: int = 16  # attention window = this * page_size
     prefill_buckets: Tuple[int, ...] = (16, 32, 64, 128, 256, 512)
     max_new_tokens_default: int = 512
+    # In-flight token fetches tolerated before the host blocks on the oldest.
+    # Sized so fetch_lag * step_time exceeds the device->host round trip —
+    # then every blocking read finds its transfer already complete.
+    fetch_lag: int = 32
+    # Also pop a fetch once it has been in flight this long (seconds) —
+    # bounds token latency when the pipeline fills slower than fetch_lag
+    # steps (e.g. a lone interactive request).
+    fetch_wait_s: float = 0.15
 
     @property
     def max_window(self) -> int:
@@ -104,6 +124,10 @@ class GenRequest:
     finish_reason: Optional[str] = None
     submit_time: float = 0.0
     first_token_time: Optional[float] = None
+    # tokens sampled on device / processed on host (emission lags dispatch
+    # by up to fetch_lag steps)
+    dispatched: int = 0
+    drained: int = 0
     # True while re-entering after preemption: the prefill's sampled token
     # was already emitted before preemption and must not be re-emitted.
     resumed: bool = False
@@ -128,6 +152,23 @@ class TokenEvent:
     token_id: Optional[int]
     finished: bool = False
     finish_reason: Optional[str] = None
+
+
+@dataclasses.dataclass
+class _Fetch:
+    """One in-flight sampled-token transfer awaiting host processing.
+
+    For decode steps `arr` is the [B] token vector and `items[i]` records
+    which request slot i's lane belonged to at dispatch (None for idle
+    lanes); for prefill `arr` is a scalar and `items` has one entry.
+    `final[i]` marks the request's last dispatched token (it hit a length/
+    window limit at dispatch time) with its finish reason.
+    """
+
+    arr: jnp.ndarray
+    items: List[Optional[GenRequest]]
+    final: List[Optional[str]]  # finish reason if this is the last token
+    t0: float = 0.0  # dispatch time (fetch_wait_s aging)
 
 
 class InferenceEngine:
@@ -174,6 +215,15 @@ class InferenceEngine:
         self._prefill_fns: Dict[int, Callable] = {}
         self._decode_fn = self._build_decode_fn()
         self._counter = itertools.count()
+        # device-resident decode control state (see module docstring)
+        self._d_last = self._dev(np.zeros(B, np.int32))
+        self._d_seq_lens = self._dev(np.zeros(B, np.int32))
+        self._d_table = None
+        self._d_active = None
+        self._d_temps = self._d_top_ks = self._d_top_ps = self._d_seeds = None
+        self._ctl_dirty = True
+        self._pending: List[_Fetch] = []
+        self._out_events: List[TokenEvent] = []
 
     def _dev(self, x) -> jnp.ndarray:
         """Host -> device, replicated across the mesh when one is active."""
@@ -218,7 +268,8 @@ class InferenceEngine:
             toks = sample_tokens_per_slot(
                 logits, SamplingParams(temps, top_ks, top_ps), keys, allowed_mask
             )
-            return cache.k, cache.v, toks
+            next_lens = seq_lens + active.astype(jnp.int32)
+            return cache.k, cache.v, toks, next_lens
 
         jitted = jax.jit(fn, donate_argnums=(1, 2))
         _FN_CACHE[cache_key] = jitted
@@ -298,6 +349,8 @@ class InferenceEngine:
         Must run on the thread that drives `step()` (the engine is
         single-writer; EngineWorker routes cancels through its inbox for
         this reason). Returns False for unknown/already-finished ids.
+        In-flight fetches for the request are simply discarded as they
+        mature.
         """
         req = self._requests.get(request_id)
         if req is None or req.state == FINISHED:
@@ -309,7 +362,9 @@ class InferenceEngine:
                 pass
         req.state = FINISHED
         req.finish_reason = "cancelled"
-        self._release(req)
+        if req.slot >= 0 or req.seq is not None:
+            self._release_slot(req)
+        self._requests.pop(request_id, None)
         return True
 
     @property
@@ -318,19 +373,24 @@ class InferenceEngine:
 
     @property
     def has_work(self) -> bool:
-        return self.num_active > 0 or bool(self.waiting)
+        return self.num_active > 0 or bool(self.waiting) or bool(self._pending)
 
     def step(self) -> List[TokenEvent]:
-        """One scheduler iteration: admit, decode, retire."""
-        events: List[TokenEvent] = []
-        events.extend(self._admit())
+        """One scheduler iteration: drain matured fetches, admit, decode."""
+        self._drain(block=False)
+        self._admit()
         if self.num_active:
-            events.extend(self._decode_once())
-        return events
+            self._dispatch_decode()
+            self._drain(block=False)
+        if not self.num_active and not self.waiting and self._pending:
+            # nothing left to dispatch: flush the pipeline
+            self._drain(block=True)
+        out, self._out_events = self._out_events, []
+        return out
 
     def run_to_completion(self) -> Dict[str, GenRequest]:
         """Drain all requests (testing/bench convenience)."""
-        registry = {r.request_id: r for r in self._all_requests()}
+        registry = dict(self._requests)
         done: Dict[str, GenRequest] = {}
         while self.has_work:
             for ev in self.step():
@@ -349,11 +409,61 @@ class InferenceEngine:
         return req
 
     # ------------------------------------------------------------------
-    # scheduler internals
+    # fetch pipeline
     # ------------------------------------------------------------------
 
-    def _all_requests(self):
-        return [s for s in self.slots if s is not None] + self.waiting
+    def _drain(self, block: bool) -> None:
+        """Process matured token fetches into events (self._out_events).
+
+        Non-blocking mode only pops entries older than `fetch_lag` steps —
+        their async copies have had fetch_lag dispatches' worth of wall time
+        to land, so the np.asarray below is effectively free.  `is_ready`
+        cannot be used as the signal: it reports *compute* completion, not
+        transfer completion, and popping on it would reintroduce the
+        blocking round trip per step.
+        """
+        while self._pending:
+            if not block:
+                aged = (
+                    time.monotonic() - self._pending[0].t0
+                    >= self.ecfg.fetch_wait_s
+                )
+                if len(self._pending) <= self.ecfg.fetch_lag and not aged:
+                    break
+            entry = self._pending.pop(0)
+            toks = np.asarray(entry.arr)
+            vals = toks.reshape(-1)
+            for i, req in enumerate(entry.items):
+                if req is None or req.state == FINISHED:
+                    continue
+                self._process_token(req, int(vals[i if len(vals) > 1 else 0]),
+                                    entry.final[i])
+
+    def _process_token(self, req: GenRequest, token: int,
+                       final_reason: Optional[str]) -> None:
+        req.drained += 1
+        req.output_ids.append(token)
+        if req.first_token_time is None:
+            req.first_token_time = time.monotonic()
+        if token in req.stop_token_ids:
+            reason = "stop"
+        elif final_reason is not None:
+            reason = final_reason
+        else:
+            self._out_events.append(TokenEvent(req.request_id, token))
+            return
+        req.finish_reason = reason
+        req.state = FINISHED
+        if req.slot >= 0 or req.seq is not None:
+            self._release_slot(req)  # stop token found while still ACTIVE
+        self._requests.pop(req.request_id, None)
+        self._out_events.append(
+            TokenEvent(req.request_id, token, finished=True, finish_reason=reason)
+        )
+
+    # ------------------------------------------------------------------
+    # scheduler internals
+    # ------------------------------------------------------------------
 
     def _free_slot(self) -> Optional[int]:
         for i, s in enumerate(self.slots):
@@ -365,8 +475,7 @@ class InferenceEngine:
         total = len(req.prefill_ids) + 1  # +1 so decode always has a slot
         return -(-total // self.ecfg.page_size)
 
-    def _admit(self) -> List[TokenEvent]:
-        events: List[TokenEvent] = []
+    def _admit(self) -> None:
         while self.waiting:
             slot = self._free_slot()
             if slot is None:
@@ -376,7 +485,7 @@ class InferenceEngine:
                 break  # wait for pages to free up
             self.waiting.pop(0)
             try:
-                events.extend(self._prefill_request(req, slot))
+                self._prefill_request(req, slot)
             except OutOfPagesError:
                 # couldn't grow mid-prefill; roll back and retry later
                 if req.seq:
@@ -385,9 +494,8 @@ class InferenceEngine:
                 req.seq = None
                 self.waiting.insert(0, req)
                 break
-        return events
 
-    def _prefill_request(self, req: GenRequest, slot: int) -> List[TokenEvent]:
+    def _prefill_request(self, req: GenRequest, slot: int) -> None:
         ecfg = self.ecfg
         req.seq = req.seq or SequencePages(seq_id=req.request_id)
         start = req.seq.length  # >0 when resuming from a prefix-cache hit
@@ -434,76 +542,159 @@ class InferenceEngine:
         req.state = ACTIVE
         req.slot = slot
         self.slots[slot] = req
+        self._ctl_dirty = True
         if req.resumed:
             # Re-entry after preemption: the pending last token is already in
-            # output_ids; the freshly sampled one is its deterministic
-            # duplicate (same seed, same position) — drop it.
+            # output_ids (outputs are complete — preemption drains the
+            # pipeline); the freshly sampled token is its deterministic
+            # duplicate (same seed, same position) — drop it and seed the
+            # device last-token lane from the host-known value.
             req.resumed = False
-            return []
-        req.first_token_time = time.monotonic()
-        return self._emit(req, int(tok))
+            self._d_last = self._d_last.at[slot].set(req.output_ids[-1])
+            return
+        # Seed the device last-token lane directly from the device scalar —
+        # the token value itself is fetched asynchronously.
+        self._d_last = self._d_last.at[slot].set(tok)
+        req.dispatched += 1
+        final = self._limit_reason_after_dispatch(req)
+        tok.copy_to_host_async()
+        self._pending.append(
+            _Fetch(arr=tok, items=[req], final=[final], t0=time.monotonic())
+        )
+        if final is not None:
+            self._to_draining(req)
 
-    def _decode_once(self) -> List[TokenEvent]:
+    def _limit_reason_after_dispatch(self, req: GenRequest) -> Optional[str]:
+        """After a dispatch, has the request hit a host-known limit?
+
+        Mirrors the emission-side rules: `dispatched` counts every sampled
+        token, and the window check matches "the cache is full after this
+        token's KV lands".  Stop tokens are the only finish the host cannot
+        predict; those are discovered when the fetch matures.
+        """
+        if req.dispatched >= req.max_new_tokens:
+            return "length"
+        if req.seq is not None and req.seq.length + 1 >= self.ecfg.max_window:
+            return "length"
+        return None
+
+    def _to_draining(self, req: GenRequest) -> None:
+        """Stop dispatching for a request; its tokens are still in flight."""
+        req.state = DRAINING
+        self._release_slot(req)
+
+    def _dispatch_decode(self) -> None:
         ecfg = self.ecfg
-        B, ps = ecfg.max_batch, ecfg.page_size
 
         # grow pages for sequences about to write past their capacity
         for req in list(s for s in self.slots if s is not None):
             if req.state != ACTIVE or req.seq is None:
-                continue  # already preempted by an earlier iteration
-            try:
-                self.pool.ensure_capacity(req.seq, req.seq.length + 1)
-            except OutOfPagesError:
-                self._preempt_youngest()
-                if req.state != ACTIVE:
-                    continue  # req itself was the preemption victim
-                try:
-                    self.pool.ensure_capacity(req.seq, req.seq.length + 1)
-                except OutOfPagesError:
-                    # still no room: roll this one back too rather than let
-                    # it write into the trash page and corrupt its state
-                    self._preempt(req)
-                    continue
+                continue  # already preempted/retired by an earlier iteration
+            if self._ensure_pages(req):
+                continue
 
-        active = np.array([s is not None for s in self.slots])
-        if not active.any():
-            return []
-        seq_lens = np.array(
-            [s.seq.length if s else 0 for s in self.slots], np.int32
-        )
-        last_tokens = np.array(
-            [
-                (s.output_ids[-1] if s and s.output_ids else 0)
-                for s in self.slots
-            ],
-            np.int32,
-        )
-        temps = np.array([s.temperature if s else 0.0 for s in self.slots], np.float32)
-        top_ks = np.array([s.top_k if s else 0 for s in self.slots], np.int32)
-        top_ps = np.array([s.top_p if s else 1.0 for s in self.slots], np.float32)
-        seeds = np.array([s.seed if s else 0 for s in self.slots], np.uint32)
-        table = page_table_array(
-            [s.seq if s else None for s in self.slots], ecfg.max_pages_per_seq
-        )
+        active_slots = [s for s in self.slots if s is not None]
+        if not active_slots:
+            return
+        if any(s.logits_mask_fn is not None for s in active_slots):
+            # constrained decoding: the next mask depends on every token
+            # emitted so far, so the pipeline must be drained (complete
+            # output_ids) before the mask is built — the constrained batch
+            # runs synchronously.
+            self._drain(block=True)
+            active_slots = [s for s in self.slots if s is not None]
+            if not active_slots:
+                return
+        if self._ctl_dirty:
+            self._refresh_ctl()
         allowed = self._build_allowed_mask()
 
-        self.k_pool, self.v_pool, toks = self._decode_fn(
+        self.k_pool, self.v_pool, toks, self._d_seq_lens = self._decode_fn(
             self.params, self.k_pool, self.v_pool,
-            self._dev(table), self._dev(last_tokens), self._dev(seq_lens),
-            self._dev(active), self._dev(temps), self._dev(top_ks),
-            self._dev(top_ps), self._dev(seeds),
+            self._d_table, self._d_last, self._d_seq_lens,
+            self._d_active, self._d_temps, self._d_top_ks,
+            self._d_top_ps, self._d_seeds,
             None if allowed is None else self._dev(allowed),
         )
-        toks = np.asarray(toks)
+        self._d_last = toks
+        toks.copy_to_host_async()
         self._step_count += 1
 
-        events: List[TokenEvent] = []
-        for i, req in enumerate(self.slots):
+        items: List[Optional[GenRequest]] = []
+        final: List[Optional[str]] = []
+        for req in self.slots:
             if req is None:
+                items.append(None)
+                final.append(None)
                 continue
             req.seq.length += 1  # the last_token's kv was just written
-            events.extend(self._emit(req, int(toks[i])))
-        return events
+            req.dispatched += 1
+            items.append(req)
+            final.append(self._limit_reason_after_dispatch(req))
+        self._pending.append(
+            _Fetch(arr=toks, items=items, final=final, t0=time.monotonic())
+        )
+        for req, fin in zip(list(self.slots), final):
+            if req is not None and fin is not None:
+                self._to_draining(req)
+
+    def _ensure_pages(self, req: GenRequest) -> bool:
+        """Grow req's pages for one more token.  Returns True if req was
+        retired/preempted and must be skipped this step."""
+        try:
+            if self.pool.ensure_capacity(req.seq, req.seq.length + 1):
+                self._ctl_dirty = True  # table grew
+            return False
+        except OutOfPagesError:
+            pass
+        # Free lagged pages: finished-but-unfetched requests hold none, but
+        # stop tokens hiding in the pipeline may retire slots when drained.
+        self._drain(block=True)
+        if req.state != ACTIVE or req.seq is None:
+            return True
+        try:
+            self.pool.ensure_capacity(req.seq, req.seq.length + 1)
+            self._ctl_dirty = True
+            return False
+        except OutOfPagesError:
+            self._preempt_youngest()
+        if req.state != ACTIVE or req.seq is None:
+            return True
+        try:
+            self.pool.ensure_capacity(req.seq, req.seq.length + 1)
+            self._ctl_dirty = True
+            return False
+        except OutOfPagesError:
+            # still no room: roll this one back too rather than let it
+            # write into the trash page and corrupt its state
+            self._preempt(req)
+            return True
+
+    def _refresh_ctl(self) -> None:
+        """Re-upload host-authored control arrays after a scheduling change.
+
+        `_d_last` is never rebuilt from host state — the latest tokens may
+        still be in flight; it is maintained on device (decode feeds it
+        forward, admits patch single lanes).
+        """
+        slots = self.slots
+        self._d_table = self._dev(page_table_array(
+            [s.seq if s else None for s in slots], self.ecfg.max_pages_per_seq
+        ))
+        self._d_seq_lens = self._dev(np.array(
+            [s.seq.length if s is not None and s.seq else 0 for s in slots],
+            np.int32,
+        ))
+        self._d_active = self._dev(np.array([s is not None for s in slots], bool))
+        self._d_temps = self._dev(np.array(
+            [s.temperature if s else 0.0 for s in slots], np.float32))
+        self._d_top_ks = self._dev(np.array(
+            [s.top_k if s else 0 for s in slots], np.int32))
+        self._d_top_ps = self._dev(np.array(
+            [s.top_p if s else 1.0 for s in slots], np.float32))
+        self._d_seeds = self._dev(np.array(
+            [s.seed if s else 0 for s in slots], np.uint32))
+        self._ctl_dirty = False
 
     def _build_allowed_mask(self) -> Optional[np.ndarray]:
         """Batched constrained-decoding mask, if any slot constrains.
@@ -530,32 +721,21 @@ class InferenceEngine:
             return None
         return np.stack(rows)
 
-    def _emit(self, req: GenRequest, token: int) -> List[TokenEvent]:
-        """Record a sampled token; retire the request if it's done."""
-        req.output_ids.append(token)
-        stop = token in req.stop_token_ids
-        length = len(req.output_ids) >= req.max_new_tokens
-        window = req.seq.length + 1 >= self.ecfg.max_window
-        if stop or length or window:
-            req.state = FINISHED
-            req.finish_reason = "stop" if stop else "length"
-            self._release(req)
-            return [
-                TokenEvent(req.request_id, token, finished=True,
-                           finish_reason=req.finish_reason)
-            ]
-        return [TokenEvent(req.request_id, token)]
+    def _release_slot(self, req: GenRequest) -> None:
+        """Free a request's batch slot and pages (it may keep draining).
 
-    def _release(self, req: GenRequest) -> None:
+        Pages freed here can be re-allocated while older dispatched steps
+        still write into them; that is safe by program order — any later
+        prefill/decode for the new owner executes after those writes and
+        either overwrites the slots or leaves them masked by kv_valid.
+        """
         if req.slot >= 0:
             self.slots[req.slot] = None
             req.slot = -1
+            self._ctl_dirty = True
         if req.seq is not None:
             self.pool.free_sequence(req.seq)
             req.seq = None
-        # The caller owns the GenRequest; dropping the registry entry on
-        # retirement keeps a long-lived engine's memory flat.
-        self._requests.pop(req.request_id, None)
 
     def _preempt_youngest(self) -> None:
         """Roll the most recent request back to the waiting queue."""
@@ -566,10 +746,13 @@ class InferenceEngine:
 
     def _preempt(self, victim: GenRequest) -> None:
         logger.warning("preempting %s (out of KV pages)", victim.request_id)
-        self.slots[victim.slot] = None
-        victim.slot = -1
-        self.pool.free_sequence(victim.seq)
-        victim.seq = None
+        # Preemption needs complete outputs (prefill_ids below); the caller
+        # (_ensure_pages) has already drained the pipeline.
+        assert not self._pending, "preempt with in-flight fetches"
+        assert victim.dispatched == victim.drained, (
+            "preempt victim has unprocessed dispatched tokens"
+        )
+        self._release_slot(victim)
         # Re-prefill later over prompt + generated-so-far, derived from the
         # immutable prompt (idempotent across repeated preemptions). The
         # final output token stays out: its KV was never written (it is the

@@ -1,0 +1,168 @@
+"""Decode-step profiler: where do the ~90ms/step go?
+
+Builds the bench configuration (llama-3.2-1b, batch 8), prefers the real
+TPU, and times nested subsets of the decode step:
+
+  A. engine.step() loop            — everything (host scheduling included)
+  B. decode_fn device loop         — jitted step only, device-resident args
+  C. variant: greedy argmax only   — drops the top-k/top-p sort pipeline
+  D. variant: no logits head       — drops the [H, V] projection + sampling
+  E. variant: no attention gather  — decode against a contiguous window view
+
+Prints a table of ms/step so the deltas attribute cost to each stage.
+
+Usage: python scripts/profile_decode.py [--model llama-3.2-1b] [--steps 50]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kafka_tpu.models import get_config, init_params
+from kafka_tpu.models.llama import KVCache, PagedView, forward
+from kafka_tpu.ops.sampling import SamplingParams, sample_tokens_per_slot
+from kafka_tpu.runtime import EngineConfig, GenRequest, InferenceEngine
+from kafka_tpu.runtime.kv_cache import page_table_array
+
+
+def timed_loop(fn, steps: int) -> float:
+    fn()  # warmup/compile
+    jax.effects_barrier()
+    t0 = time.monotonic()
+    for _ in range(steps):
+        fn()
+    jax.effects_barrier()
+    return (time.monotonic() - t0) / steps * 1e3
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="llama-3.2-1b")
+    ap.add_argument("--steps", type=int, default=50)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--prompt-len", type=int, default=128)
+    args = ap.parse_args()
+
+    cfg = get_config(args.model)
+    print(f"# devices: {jax.devices()}")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    jax.block_until_ready(params)
+
+    ecfg = EngineConfig(
+        max_batch=args.batch, page_size=16,
+        max_pages_per_seq=-(-(args.prompt_len + 256 + 16) // 16),
+    )
+    ecfg.num_pages = args.batch * ecfg.max_pages_per_seq + 1
+    engine = InferenceEngine(cfg, params, ecfg)
+
+    rng = np.random.RandomState(0)
+    for i in range(args.batch):
+        engine.submit(GenRequest(
+            request_id=f"p-{i}",
+            prompt_ids=rng.randint(4, cfg.vocab_size - 4, args.prompt_len).tolist(),
+            max_new_tokens=10_000,
+        ))
+    while engine.num_active < args.batch:
+        engine.step()
+
+    # ---- A. full scheduler loop -----------------------------------------
+    ms_a = timed_loop(lambda: engine.step(), args.steps)
+    print(f"A engine.step() full loop      : {ms_a:8.2f} ms/step")
+
+    # ---- device-resident args for the raw fn loops ----------------------
+    B, ps, C = ecfg.max_batch, ecfg.page_size, ecfg.max_window
+    table = jnp.asarray(page_table_array(
+        [s.seq if s else None for s in engine.slots], ecfg.max_pages_per_seq))
+    seq_lens = jnp.asarray(np.array(
+        [s.seq.length if s else 0 for s in engine.slots], np.int32))
+    last = jnp.asarray(np.array(
+        [(s.output_ids[-1] if s and s.output_ids else 0) for s in engine.slots],
+        np.int32))
+    active = jnp.ones((B,), bool)
+    temps = jnp.zeros((B,), jnp.float32)
+    top_ks = jnp.zeros((B,), jnp.int32)
+    top_ps = jnp.ones((B,), jnp.float32)
+    seeds = jnp.zeros((B,), jnp.uint32)
+
+    state = {"k": engine.k_pool, "v": engine.v_pool, "last": last}
+
+    def run_b():
+        k, v, toks = engine._decode_fn(
+            engine.params, state["k"], state["v"], table, state["last"],
+            seq_lens, active, temps, top_ks, top_ps, seeds, None)
+        state["k"], state["v"], state["last"] = k, v, toks
+        toks.block_until_ready()
+
+    ms_b = timed_loop(run_b, args.steps)
+    print(f"B decode_fn device loop        : {ms_b:8.2f} ms/step"
+          f"   (host sched overhead: {ms_a - ms_b:.2f})")
+
+    # ---- C/D/E variants --------------------------------------------------
+    def make_variant(mode: str):
+        def fn(params, k_pool, v_pool, page_table, last_tokens, seq_lens_):
+            positions = seq_lens_[:, None]
+            write_page = page_table[jnp.arange(B), seq_lens_ // ps]
+            write_idx = (write_page * ps + seq_lens_ % ps)[:, None]
+            read_idx = (
+                page_table[:, :, None] * ps + jnp.arange(ps)[None, None, :]
+            ).reshape(B, C)
+            kv_positions = jnp.broadcast_to(jnp.arange(C)[None, :], (B, C))
+            kv_valid = kv_positions <= seq_lens_[:, None]
+            paged = PagedView(write_idx, read_idx, kv_positions, kv_valid)
+            logits, cache = forward(
+                params, cfg, last_tokens[:, None], positions,
+                kv_cache=KVCache(k_pool, v_pool), paged=paged)
+            if mode == "no_logits":
+                tok = jnp.sum(logits[:, 0, :8], axis=-1).astype(jnp.int32) % 17
+            else:  # argmax
+                tok = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+            return cache.k, cache.v, tok
+
+        return jax.jit(fn, donate_argnums=(1, 2))
+
+    for mode, label in [("argmax", "C greedy argmax (no sort)    "),
+                        ("no_logits", "D no vocab head + argmax     ")]:
+        fn = make_variant(mode)
+
+        def run(fn=fn):
+            k, v, toks = fn(engine.params, state["k"], state["v"], table,
+                            state["last"], seq_lens)
+            state["k"], state["v"], state["last"] = k, v, toks
+            toks.block_until_ready()
+
+        ms = timed_loop(run, args.steps)
+        print(f"{label}: {ms:8.2f} ms/step")
+
+    # ---- E. logits head alone (bf16 vs f32-cast) -------------------------
+    x = jnp.ones((B, cfg.hidden_size), cfg.activation_dtype)
+    head = params["embed"]
+
+    f32 = jax.jit(lambda x, h: jnp.einsum(
+        "bh,vh->bv", x.astype(jnp.float32), h.astype(jnp.float32)))
+    bf16 = jax.jit(lambda x, h: jnp.einsum(
+        "bh,vh->bv", x, h, preferred_element_type=jnp.float32))
+    ms = timed_loop(lambda: f32(x, head).block_until_ready(), args.steps)
+    print(f"E logits head f32-cast         : {ms:8.2f} ms/step")
+    ms = timed_loop(lambda: bf16(x, head).block_until_ready(), args.steps)
+    print(f"F logits head bf16->f32 accum  : {ms:8.2f} ms/step")
+
+    # ---- G. sampling pipeline alone --------------------------------------
+    logits = jnp.ones((B, cfg.vocab_size), jnp.float32)
+    keys = jax.vmap(jax.random.key)(jnp.arange(B, dtype=jnp.uint32))
+    samp = jax.jit(lambda lg: sample_tokens_per_slot(
+        lg, SamplingParams(temps, top_ks, top_ps), keys, None))
+    ms = timed_loop(lambda: samp(logits).block_until_ready(), args.steps)
+    print(f"G sampling pipeline (greedy)   : {ms:8.2f} ms/step")
+
+
+if __name__ == "__main__":
+    main()
