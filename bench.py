@@ -92,10 +92,12 @@ def main() -> None:
         reqs.append(r)
     while engine.num_active < args.batch:  # admit everyone (prefill)
         engine.step()
-    # Flush in-flight fetches so the clock covers only tokens whose
-    # dispatch AND drain fall inside the measured window (the async
-    # pipeline would otherwise credit pre-clock prefill/decode work).
+    # Flush in-flight fetches and discard their buffered events so the
+    # clock covers only tokens whose dispatch AND drain fall inside the
+    # measured window (the async pipeline would otherwise credit pre-clock
+    # prefill/decode work to the measurement).
     engine._drain(block=True)
+    engine._out_events.clear()
     t0 = time.monotonic()
     tokens = 0
     while engine.has_work:

@@ -37,6 +37,11 @@ class ModelConfig:
     rope_low_freq_factor: float = 1.0
     rope_high_freq_factor: float = 4.0
     rope_original_max_position: int = 8192
+    # Paged-decode attention backend: "xla" (gather + reference attention) or
+    # "pallas" (ops/pallas paged kernel; interpret mode off-TPU).  Engines
+    # resolve EngineConfig.attention_backend="auto" to one of these — plain
+    # forward() callers keep the portable XLA path by default.
+    attention_backend: str = "xla"
 
     @property
     def q_per_kv(self) -> int:

@@ -53,22 +53,29 @@ class KVCache(NamedTuple):
 class PagedView(NamedTuple):
     """Index plan for one step against a paged KV pool.
 
-    The pool stores k/v as [L, num_pages * page_size, Hkv, D] — a flat slot
-    axis shared by all sequences. The runtime's page tables translate each
+    The pool stores k/v as [L, num_pages * page_size, Hkv*D] — a flat slot
+    axis shared by all sequences, heads merged into the minor axis (see
+    runtime/kv_cache.py). The runtime's page tables translate each
     sequence's logical positions to physical slots; the model only ever sees
     these precomputed flat indices, so the same layer math serves contiguous
-    and paged caches (and the Pallas paged kernel swaps in transparently).
+    and paged caches.
 
     write_idx:    [B, S]  flat slot for each new token's k/v
     read_idx:     [B, C]  flat slots forming each sequence's attention window
     kv_positions: [B, C]  absolute position of each window slot
     kv_valid:     [B, C]  False for unallocated/beyond-length slots
+    page_table:   [B, P]  physical page ids (pallas decode backend only)
+    seq_lens:     [B]     cached token counts (pallas decode backend only)
+    page_size:    static int (pallas decode backend only)
     """
 
     write_idx: jnp.ndarray
     read_idx: jnp.ndarray
     kv_positions: jnp.ndarray
     kv_valid: jnp.ndarray
+    page_table: Optional[jnp.ndarray] = None
+    seq_lens: Optional[jnp.ndarray] = None
+    page_size: Optional[int] = None
 
 
 def init_kv_cache(cfg: ModelConfig, batch: int, capacity: int, dtype=None) -> KVCache:
@@ -129,19 +136,41 @@ def _attention_block(
     k = apply_rope(k, cos, sin)
 
     if paged is not None:
-        # Paged pool: k_cache/v_cache are [TOTAL_SLOTS, Hkv, D] this layer.
-        k_cache = k_cache.at[paged.write_idx].set(k.astype(k_cache.dtype))
-        v_cache = v_cache.at[paged.write_idx].set(v.astype(v_cache.dtype))
-        k_win = k_cache[paged.read_idx]  # [B, C, Hkv, D]
-        v_win = v_cache[paged.read_idx]
-        out = causal_attention(
-            q,
-            k_win,
-            v_win,
-            q_positions=positions,
-            kv_positions=paged.kv_positions,
-            kv_valid=paged.kv_valid,
+        # Paged pool: k_cache/v_cache are [TOTAL_SLOTS, Hkv*D] this layer.
+        b, s, hkv, d = k.shape
+        k_cache = k_cache.at[paged.write_idx].set(
+            k.reshape(b, s, hkv * d).astype(k_cache.dtype)
         )
+        v_cache = v_cache.at[paged.write_idx].set(
+            v.reshape(b, s, hkv * d).astype(v_cache.dtype)
+        )
+        if (
+            cfg.attention_backend == "pallas"
+            and s == 1
+            and paged.page_table is not None
+        ):
+            from ..ops.pallas import paged_decode_attention
+
+            out = paged_decode_attention(
+                q[:, 0],  # [B, Hq, D]
+                k_cache,
+                v_cache,
+                paged.page_table,
+                paged.seq_lens,
+                page_size=paged.page_size,
+                interpret=jax.default_backend() != "tpu",
+            )[:, None]  # [B, 1, Hq, D]
+        else:
+            k_win = k_cache[paged.read_idx].reshape(b, -1, hkv, d)
+            v_win = v_cache[paged.read_idx].reshape(b, -1, hkv, d)
+            out = causal_attention(
+                q,
+                k_win,
+                v_win,
+                q_positions=positions,
+                kv_positions=paged.kv_positions,
+                kv_valid=paged.kv_valid,
+            )
     elif k_cache is None:
         out = causal_attention(
             q, k, v, q_positions=positions, kv_positions=positions
@@ -191,8 +220,9 @@ def forward(
     kv_cache: optional KVCache. Contiguous form: k/v [L, B, C, Hkv, D],
         new k/v written at `cache_positions` (default `positions`), attention
         over the whole cache gated by `kv_valid` [B, C]. Paged form (when
-        `paged` is given): k/v [L, TOTAL_SLOTS, Hkv, D], reads/writes follow
-        the PagedView index plan.
+        `paged` is given): k/v [L, TOTAL_SLOTS, Hkv*D] (heads merged into
+        the minor axis, runtime/kv_cache.py), reads/writes follow the
+        PagedView index plan.
     Returns (logits [B, S, vocab] float32, updated cache or None).
     """
     x = params["embed"][token_ids].astype(cfg.activation_dtype)

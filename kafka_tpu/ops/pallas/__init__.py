@@ -1,0 +1,18 @@
+"""Pallas TPU kernels — the framework's native tier.
+
+The reference has no native code at all (SURVEY §2.3: its compute lived
+behind remote gateways); these kernels are the TPU-native equivalent of the
+CUDA kernels a GPU serving stack would carry.  Each kernel is validated
+against the XLA reference formulation in ops/attention.py, which remains the
+numerics ground truth and the portable fallback (CPU tests, non-TPU
+platforms, and sharded meshes where GSPMD cannot partition a custom call).
+
+Selection is driven by `ModelConfig.attention_backend`:
+  "auto"   — pallas on single-device TPU paged decode, xla otherwise
+  "pallas" — force the kernels (interpret mode off-TPU; tests use this)
+  "xla"    — force the reference path
+"""
+
+from .paged_attention import paged_decode_attention
+
+__all__ = ["paged_decode_attention"]

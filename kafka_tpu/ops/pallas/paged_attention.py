@@ -1,0 +1,246 @@
+"""Paged-attention decode kernel.
+
+One decode step attends each sequence's KV window, which lives scattered
+across physical pages of the shared pool (runtime/kv_cache.py).  The XLA
+reference path materializes the whole [B, C, Hkv, D] window per layer via a
+gather — it reads the *configured* window regardless of how long each
+sequence actually is, and round-trips the gathered copy through HBM.  This
+kernel walks each sequence's page list directly:
+
+* grid = (B,): one program per sequence.  The page table and sequence
+  lengths ride in as **scalar-prefetch** arguments so the kernel can
+  dereference physical page ids at runtime.
+* the kernel iterates only over the sequence's *valid* pages — a dynamic
+  `fori_loop` over chunks of `pages_per_chunk` pages, each chunk landed in
+  VMEM by manually issued per-page async DMAs, double-buffered so chunk
+  c+1's copies overlap chunk c's compute.  A sequence 300 tokens into an
+  8k window reads 300 tokens' worth of KV, not 8k.
+* online softmax (m, l, acc) in VMEM scratch across chunks.  GQA is an
+  unrolled per-kv-head loop over query groups — no repeat_kv
+  materialization.
+
+Layout contract: the pool stores each slot's row as Hkv*D merged lanes
+([TOTAL_SLOTS, Hkv*D]) — Mosaic requires DMA slices to be lane-tile (128)
+aligned, so per-head layouts with D=64 cannot be page-DMA'd; the merged row
+(512 lanes for 8x64) can.  Mosaic also cannot unfold merged lanes back to
+heads in-kernel, so GQA is expressed *algebraically*: the caller expands q
+block-diagonally to [Hq, Hkv*D] (zeros outside each query head's own
+kv-head lane block), QK^T over merged rows then contracts exactly the right
+D lanes per head in one full-width MXU matmul, and the PV product yields
+[Hq, Hkv*D] from which the caller slices each row's own kv-head block.
+
+Numerics ground truth: ops.attention.causal_attention (tests compare both
+paths on random page layouts).  f32 accumulation throughout.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _decode_kernel(
+    # scalar prefetch
+    page_table_ref,  # [B, P] i32
+    seq_lens_ref,    # [B] i32
+    # inputs
+    q_ref,        # [1, Hq, Hkv*D] VMEM block — block-diagonal expanded q
+    k_pages_hbm,  # [num_pages, ps, Hkv*D] in HBM/ANY
+    v_pages_hbm,  # [num_pages, ps, Hkv*D] in HBM/ANY
+    out_ref,      # [1, Hq, Hkv*D] VMEM block — caller slices per-head lanes
+    # scratch
+    kbuf,     # [2, CP*ps, Hkv*D] pool dtype
+    vbuf,     # [2, CP*ps, Hkv*D]
+    ksem,     # DMA sems [2, CP]
+    vsem,     # DMA sems [2, CP]
+    m_ref,    # [Hq, 1] f32 running max
+    l_ref,    # [Hq, 1] f32 running denominator
+    acc_ref,  # [Hq, Hkv*D] f32 running numerator
+    *,
+    page_size: int,
+    pages_per_chunk: int,
+    scale: float,
+):
+    b = pl.program_id(0)
+    ps, cp = page_size, pages_per_chunk
+    chunk = cp * ps
+    # query position is seq_len; it attends positions <= seq_len
+    n_valid = seq_lens_ref[b] + 1
+    n_pages = pl.cdiv(n_valid, ps)
+    n_chunks = pl.cdiv(n_pages, cp)
+
+    def issue(c, slot):
+        for j in range(cp):  # static unroll; per-page scattered DMA
+            @pl.when(c * cp + j < n_pages)
+            def _():
+                page = page_table_ref[b, c * cp + j]
+                pltpu.make_async_copy(
+                    k_pages_hbm.at[page],
+                    kbuf.at[slot, pl.ds(j * ps, ps)],
+                    ksem.at[slot, j],
+                ).start()
+                pltpu.make_async_copy(
+                    v_pages_hbm.at[page],
+                    vbuf.at[slot, pl.ds(j * ps, ps)],
+                    vsem.at[slot, j],
+                ).start()
+
+    def wait(c, slot):
+        for j in range(cp):
+            @pl.when(c * cp + j < n_pages)
+            def _():
+                page = page_table_ref[b, c * cp + j]
+                pltpu.make_async_copy(
+                    k_pages_hbm.at[page],
+                    kbuf.at[slot, pl.ds(j * ps, ps)],
+                    ksem.at[slot, j],
+                ).wait()
+                pltpu.make_async_copy(
+                    v_pages_hbm.at[page],
+                    vbuf.at[slot, pl.ds(j * ps, ps)],
+                    vsem.at[slot, j],
+                ).wait()
+
+    m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+    l_ref[...] = jnp.zeros_like(l_ref)
+    acc_ref[...] = jnp.zeros_like(acc_ref)
+    issue(0, 0)
+
+    def body(c, carry):
+        slot = jax.lax.rem(c, 2)
+
+        @pl.when(c + 1 < n_chunks)
+        def _():
+            issue(c + 1, jax.lax.rem(c + 1, 2))
+
+        wait(c, slot)
+
+        # mask: local slot index within the chunk vs remaining valid slots
+        remaining = n_valid - c * chunk
+        local = jax.lax.broadcasted_iota(jnp.int32, (1, chunk), dimension=1)
+        slot_mask = local < remaining  # [1, chunk]
+        local_col = jax.lax.broadcasted_iota(jnp.int32, (chunk, 1), dimension=0)
+        col_mask = local_col < remaining  # [chunk, 1]
+
+        # Merged-lane compute: q arrives pre-expanded block-diagonally
+        # ([Hq, Hkv*D], zeros outside each query head's own kv-head lane
+        # block), so QK^T over the full merged row contracts exactly each
+        # head's D lanes — one MXU matmul for all heads, no in-kernel
+        # reshape (Mosaic cannot unfold merged lanes).  Rows past the valid
+        # range were never DMA'd; zero V before the PV matmul — a NaN there
+        # would poison the accumulator even under zero probability weight
+        # (0 * NaN = NaN).  K needs no masking: its scores are overwritten
+        # by the NEG_INF mask.
+        kc = kbuf[slot].astype(jnp.float32)  # [chunk, HD]
+        vc = jnp.where(col_mask, vbuf[slot].astype(jnp.float32), 0.0)
+        qx = q_ref[0].astype(jnp.float32)  # [Hq, HD] block-diagonal
+        s = (
+            jax.lax.dot_general(
+                qx, kc,
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )  # [Hq, chunk]
+        s = jnp.where(slot_mask, s, NEG_INF)
+
+        m_prev = m_ref[...]  # [Hq, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        pexp = jnp.exp(s - m_new)
+        pexp = jnp.where(slot_mask, pexp, 0.0)
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(pexp, axis=-1, keepdims=True)
+        # [Hq, HD]: each row holds every kv head's weighted V; the caller
+        # slices out the row's own kv-head lane block.
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            pexp, vc,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[...] = m_new
+        return carry
+
+    jax.lax.fori_loop(0, n_chunks, body, 0)
+    denom = jnp.maximum(l_ref[...], 1e-30)
+    out_ref[0, :, :] = (acc_ref[...] / denom).astype(out_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("page_size", "pages_per_chunk", "scale", "interpret"),
+)
+def paged_decode_attention(
+    q: jnp.ndarray,            # [B, Hq, D] — one query token per sequence
+    k_pool: jnp.ndarray,       # [TOTAL_SLOTS, Hkv*D] merged-lane pool
+    v_pool: jnp.ndarray,       # [TOTAL_SLOTS, Hkv*D]
+    page_table: jnp.ndarray,   # [B, P] i32 physical page ids
+    seq_lens: jnp.ndarray,     # [B] i32 tokens already cached (query pos)
+    *,
+    page_size: int,
+    pages_per_chunk: int = 8,
+    scale: float | None = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Decode-step attention straight off the paged KV pool.
+
+    Returns [B, Hq, D] in q.dtype.  Inactive batch lanes (whose table rows
+    point at the trash page) produce garbage rows that the engine discards —
+    same contract as the XLA gather path.
+    """
+    B, Hq, D = q.shape
+    HD = k_pool.shape[1]
+    Hkv = HD // D
+    G = Hq // Hkv
+    P = page_table.shape[1]
+    if scale is None:
+        scale = D**-0.5
+    cp = min(pages_per_chunk, P)
+    k_pages = k_pool.reshape(-1, page_size, HD)
+    v_pages = v_pool.reshape(-1, page_size, HD)
+
+    # Block-diagonal query expansion (see module docstring): qx[b, qh] has
+    # q[b, qh] in its own kv head's D-lane block and zeros elsewhere.
+    kv_of_q = jnp.repeat(jnp.arange(Hkv), G)  # [Hq]
+    qx = jnp.zeros((B, Hq, Hkv, D), q.dtype)
+    qx = qx.at[:, jnp.arange(Hq), kv_of_q].set(q)
+    qx = qx.reshape(B, Hq, HD)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec((1, Hq, HD), lambda b, pt, sl: (b, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, Hq, HD), lambda b, pt, sl: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, cp * page_size, HD), k_pool.dtype),
+            pltpu.VMEM((2, cp * page_size, HD), v_pool.dtype),
+            pltpu.SemaphoreType.DMA((2, cp)),
+            pltpu.SemaphoreType.DMA((2, cp)),
+            pltpu.VMEM((Hq, 1), jnp.float32),
+            pltpu.VMEM((Hq, 1), jnp.float32),
+            pltpu.VMEM((Hq, HD), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _decode_kernel,
+        page_size=page_size,
+        pages_per_chunk=cp,
+        scale=scale,
+    )
+    out_wide = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Hq, HD), q.dtype),
+        interpret=interpret,
+    )(page_table, seq_lens, qx, k_pages, v_pages)
+    # each query row's result lives in its own kv head's lane block
+    return out_wide.reshape(B, Hq, Hkv, D)[:, jnp.arange(Hq), kv_of_q]

@@ -14,7 +14,9 @@ stacked-layer param tree (models/llama.py):
   embed [V, H]        -> replicated (lookup stays local)
   lm_head [H, V]      -> V on tp              (logits gathered at the end)
   norms               -> replicated
-  KV pool [L, S, Hkv, D] -> kv heads on tp    (each chip caches its heads)
+  KV pool [L, S, Hkv*D] -> kv heads on tp     (each chip caches its heads;
+                                               heads are the outer factor of
+                                               the merged minor axis)
 
 The leading L axis carries "pp" when a pipeline axis is used (stage split =
 contiguous layer ranges); kept None here — PP slicing happens above these
@@ -72,8 +74,11 @@ def param_specs(cfg: ModelConfig, mesh: Mesh) -> Params:
 
 
 def kv_pool_spec(cfg: ModelConfig, mesh: Mesh) -> P:
-    """[L, SLOTS, Hkv, D] pool: cache each chip's kv heads locally."""
-    return P(None, None, _kv_axis(cfg, mesh), None)
+    """[L, SLOTS, Hkv*D] pool: cache each chip's kv heads locally.
+
+    Heads are the outer factor of the merged minor axis, so sharding that
+    axis tp-ways lands whole heads per chip (tp | Hkv per _kv_axis)."""
+    return P(None, None, _kv_axis(cfg, mesh))
 
 
 def shard_params(params: Params, cfg: ModelConfig, mesh: Mesh) -> Params:

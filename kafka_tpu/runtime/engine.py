@@ -97,6 +97,10 @@ class EngineConfig:
     # bounds token latency when the pipeline fills slower than fetch_lag
     # steps (e.g. a lone interactive request).
     fetch_wait_s: float = 0.15
+    # Decode attention backend: "auto" resolves to the Pallas paged kernel
+    # on single-device TPU (when shapes meet its lane-alignment contract)
+    # and to the XLA gather path otherwise; "xla"/"pallas" force.
+    attention_backend: str = "auto"
 
     @property
     def max_window(self) -> int:
@@ -187,6 +191,9 @@ class InferenceEngine:
         self.cfg = cfg
         self.ecfg = engine_cfg or EngineConfig()
         self.mesh = mesh
+        self.cfg = cfg.replace(
+            attention_backend=self._resolve_backend(cfg, self.ecfg, mesh)
+        )
         ps = self.ecfg.page_size
         self.pool = PagePool(self.ecfg.num_pages, ps)
         k_pool, v_pool = make_kv_pool_arrays(cfg, self.ecfg.num_pages, ps, kv_dtype)
@@ -225,6 +232,27 @@ class InferenceEngine:
         self._pending: List[_Fetch] = []
         self._out_events: List[TokenEvent] = []
 
+    @staticmethod
+    def _resolve_backend(cfg: ModelConfig, ecfg: EngineConfig, mesh) -> str:
+        """Pick the decode attention backend (EngineConfig "auto" rule).
+
+        The Pallas kernel needs: a real TPU (it runs in slow interpret mode
+        anywhere else), no multi-device mesh (GSPMD cannot partition a
+        custom call — the TP path keeps the XLA formulation), a merged KV
+        row that is lane-tile aligned (Hkv*D % 128), and page rows aligned
+        to the bf16 sublane tile (page_size % 16).
+        """
+        choice = ecfg.attention_backend
+        if choice != "auto":
+            return choice
+        ok = (
+            jax.default_backend() == "tpu"
+            and (mesh is None or mesh.size == 1)
+            and (cfg.num_kv_heads * cfg.head_dim) % 128 == 0
+            and ecfg.page_size % 16 == 0
+        )
+        return "pallas" if ok else "xla"
+
     def _dev(self, x) -> jnp.ndarray:
         """Host -> device, replicated across the mesh when one is active."""
         arr = jnp.asarray(x)
@@ -255,7 +283,10 @@ class InferenceEngine:
             ).reshape(B, C)
             kv_positions = jnp.broadcast_to(jnp.arange(C)[None, :], (B, C))
             kv_valid = (kv_positions <= seq_lens[:, None]) & active[:, None]
-            paged = PagedView(write_idx, read_idx, kv_positions, kv_valid)
+            paged = PagedView(
+                write_idx, read_idx, kv_positions, kv_valid,
+                page_table=page_table, seq_lens=seq_lens, page_size=ps,
+            )
 
             logits, cache = forward(
                 params, cfg, last_tokens[:, None], positions,

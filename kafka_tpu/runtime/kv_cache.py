@@ -108,9 +108,20 @@ class PagePool:
 def make_kv_pool_arrays(
     cfg: ModelConfig, num_pages: int, page_size: int, dtype=None
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Allocate the device-side K and V pools."""
+    """Allocate the device-side K and V pools.
+
+    Layout is [L, TOTAL_SLOTS, Hkv*D] — heads and head_dim merged into the
+    minor (lane) axis.  This keeps the per-slot row a multiple of 128 lanes
+    for real model shapes, which the Pallas paged-decode kernel requires for
+    its page DMAs (Mosaic slices must be lane-tile aligned); the XLA gather
+    path just reshapes gathered rows back to [.., Hkv, D].
+    """
     dtype = dtype or cfg.activation_dtype
-    shape = (cfg.num_layers, num_pages * page_size, cfg.num_kv_heads, cfg.head_dim)
+    shape = (
+        cfg.num_layers,
+        num_pages * page_size,
+        cfg.num_kv_heads * cfg.head_dim,
+    )
     return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
 
 

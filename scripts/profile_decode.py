@@ -96,7 +96,7 @@ def main() -> None:
     state = {"k": engine.k_pool, "v": engine.v_pool, "last": last}
 
     def run_b():
-        k, v, toks = engine._decode_fn(
+        k, v, toks, _ = engine._decode_fn(
             engine.params, state["k"], state["v"], table, state["last"],
             seq_lens, active, temps, top_ks, top_ps, seeds, None)
         state["k"], state["v"], state["last"] = k, v, toks
