@@ -143,6 +143,11 @@ class KafkaV1Provider(KafkaAgent):
         if not self._initialized:
             await self.initialize()
         assert self.agent is not None
+        if self.thread_id is not None:
+            # Thread-scoped runs key the engine's KV prefix cache by thread,
+            # so each turn re-prefills only the conversation suffix
+            # (BASELINE config 2; providers without a cache ignore it).
+            kwargs.setdefault("prefix_key", self.thread_id)
         async for event in self.agent.run(
             messages,
             model=model or self.default_model,

@@ -161,6 +161,7 @@ class TPULLMProvider(LLMProvider):
         top_k: int = 0,
         seed: Optional[int] = None,
         logits_mask_fn=None,
+        prefix_key: Optional[str] = None,
         **kwargs: Any,
     ) -> AsyncIterator[StreamChunk]:
         self.validate_messages(messages)
@@ -183,6 +184,7 @@ class TPULLMProvider(LLMProvider):
             seed=seed if seed is not None else 0,
             stop_token_ids=tuple(self.tokenizer.stop_ids),
             logits_mask_fn=logits_mask_fn,
+            prefix_key=prefix_key,
         )
         loop = asyncio.get_running_loop()
         events = self.worker.submit(req, loop)

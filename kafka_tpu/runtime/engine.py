@@ -71,6 +71,7 @@ from .kv_cache import (
     make_kv_pool_arrays,
     page_table_array,
 )
+from .prefix_cache import PrefixCache
 
 logger = logging.getLogger("kafka_tpu.engine")
 
@@ -101,6 +102,8 @@ class EngineConfig:
     # on single-device TPU (when shapes meet its lane-alignment contract)
     # and to the XLA gather path otherwise; "xla"/"pallas" force.
     attention_backend: str = "auto"
+    # Thread-keyed prefix cache capacity (entries); 0 disables.
+    prefix_cache_entries: int = 64
 
     @property
     def max_window(self) -> int:
@@ -142,6 +145,9 @@ class GenRequest:
     prefill_ids: List[int] = dataclasses.field(default_factory=list)
     # constrained decoding: fn(output_ids) -> allowed token id list or None
     logits_mask_fn: Optional[Callable[[List[int]], Optional[List[int]]]] = None
+    # KV prefix reuse: requests sharing a key (thread id) share cached
+    # prompt-prefix pages and re-prefill only the suffix (BASELINE config 2)
+    prefix_key: Optional[str] = None
 
     @property
     def cached_len(self) -> int:
@@ -188,7 +194,6 @@ class InferenceEngine:
         params are placed per the TP rules, the KV pool is head-sharded, and
         the jitted step programs run SPMD with XLA inserting the collectives
         (all-reduce after row-parallel einsums, logit gather)."""
-        self.cfg = cfg
         self.ecfg = engine_cfg or EngineConfig()
         self.mesh = mesh
         self.cfg = cfg.replace(
@@ -231,6 +236,11 @@ class InferenceEngine:
         self._ctl_dirty = True
         self._pending: List[_Fetch] = []
         self._out_events: List[TokenEvent] = []
+        self.prefix_cache: Optional[PrefixCache] = (
+            PrefixCache(self.pool, self.ecfg.prefix_cache_entries)
+            if self.ecfg.prefix_cache_entries > 0
+            else None
+        )
 
     @staticmethod
     def _resolve_backend(cfg: ModelConfig, ecfg: EngineConfig, mesh) -> str:
@@ -485,6 +495,25 @@ class InferenceEngine:
             return
         req.finish_reason = reason
         req.state = FINISHED
+        if (
+            req.seq is not None
+            and req.prefix_key is not None
+            and self.prefix_cache is not None
+        ):
+            # Cache the thread's KV before the pages go back to the pool
+            # (the cache takes its own retains).  Store only tokens whose KV
+            # is actually materialized: seq.length counts them exactly — the
+            # final sampled token's KV is never written (it is the pending
+            # decode input), so on length-finishes the stored list must drop
+            # it or a page-aligned next turn would share a page containing
+            # an unwritten slot.  Positions past the stored range may hold
+            # discarded in-flight KV, but only whole pages strictly inside
+            # the stored range are ever shared.
+            self.prefix_cache.store(
+                req.prefix_key,
+                (req.prompt_ids + req.output_ids)[: req.seq.length],
+                req.seq.pages,
+            )
         if req.slot >= 0 or req.seq is not None:
             self._release_slot(req)  # stop token found while still ACTIVE
         self._requests.pop(req.request_id, None)
@@ -503,8 +532,29 @@ class InferenceEngine:
         return None
 
     def _pages_needed(self, req: GenRequest) -> int:
+        """Fresh pages the next prefill must allocate (net of shared ones)."""
         total = len(req.prefill_ids) + 1  # +1 so decode always has a slot
-        return -(-total // self.ecfg.page_size)
+        have = len(req.seq.pages) if req.seq is not None else 0
+        return max(0, -(-total // self.ecfg.page_size) - have)
+
+    def _attach_prefix(self, req: GenRequest) -> None:
+        """Attach shared prefix pages before the admission capacity gate.
+
+        Doing the lookup here (retaining the pages) rather than inside
+        prefill means the gate sizes `needed` net of the share — and a
+        subsequent cache reclaim under pressure cannot pull the entry this
+        request is about to reuse out from under it.
+        """
+        if (
+            req.prefix_key is None
+            or self.prefix_cache is None
+            or req.seq is not None
+        ):
+            return
+        hit = self.prefix_cache.lookup(req.prefix_key, req.prefill_ids)
+        if hit is not None:
+            req.seq = SequencePages(seq_id=req.request_id)
+            req.seq.pages, req.seq.length = hit
 
     def _admit(self) -> None:
         while self.waiting:
@@ -512,7 +562,12 @@ class InferenceEngine:
             if slot is None:
                 break
             req = self.waiting[0]
-            if self._pages_needed(req) > self.pool.free_pages:
+            self._attach_prefix(req)
+            needed = self._pages_needed(req)
+            if needed > self.pool.free_pages and not (
+                self.prefix_cache is not None
+                and self.prefix_cache.reclaim(needed)
+            ):
                 break  # wait for pages to free up
             self.waiting.pop(0)
             try:
@@ -529,7 +584,7 @@ class InferenceEngine:
     def _prefill_request(self, req: GenRequest, slot: int) -> None:
         ecfg = self.ecfg
         req.seq = req.seq or SequencePages(seq_id=req.request_id)
-        start = req.seq.length  # >0 when resuming from a prefix-cache hit
+        start = req.seq.length  # >0 after a prefix-cache hit (_attach_prefix)
         prompt = np.asarray(req.prefill_ids, np.int32)
         total = len(prompt)
         self.pool.ensure_capacity(req.seq, total + 1)
@@ -610,9 +665,22 @@ class InferenceEngine:
         return None
 
     def _to_draining(self, req: GenRequest) -> None:
-        """Stop dispatching for a request; its tokens are still in flight."""
+        """Stop dispatching for a request; its tokens are still in flight.
+
+        The batch slot frees immediately.  The sequence's pages free too —
+        unless the request carries a prefix_key, in which case they are kept
+        until the final fetch matures so the exact materialized tokens can
+        be stored into the prefix cache alongside them.
+        """
         req.state = DRAINING
-        self._release_slot(req)
+        if req.slot >= 0:
+            self.slots[req.slot] = None
+            req.slot = -1
+            self._ctl_dirty = True
+        if req.prefix_key is None or self.prefix_cache is None:
+            if req.seq is not None:
+                self.pool.free_sequence(req.seq)
+                req.seq = None
 
     def _dispatch_decode(self) -> None:
         ecfg = self.ecfg
@@ -678,8 +746,16 @@ class InferenceEngine:
             return False
         except OutOfPagesError:
             pass
-        # Free lagged pages: finished-but-unfetched requests hold none, but
-        # stop tokens hiding in the pipeline may retire slots when drained.
+        # Remedies in order of cost: evict cache entries (rebuild = one
+        # prefill, no victim), then drain the pipeline (stop tokens hiding
+        # in flight may retire slots), then preempt.
+        if self.prefix_cache is not None and self.prefix_cache.reclaim(1):
+            try:
+                self.pool.ensure_capacity(req.seq, req.seq.length + 1)
+                self._ctl_dirty = True
+                return False
+            except OutOfPagesError:
+                pass
         self._drain(block=True)
         if req.state != ACTIVE or req.seq is None:
             return True

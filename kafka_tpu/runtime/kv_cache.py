@@ -2,10 +2,12 @@
 
 TPU-first replacement for what the reference outsourced entirely (its KV
 state lived inside remote providers).  Here the KV pool is two device arrays
-[L, num_pages * page_size, Hkv, D]; sequences own ordered lists of physical
-pages.  The host-side allocator is refcounted so pages can be shared between
-sequences — the mechanism behind thread-keyed cache reuse and prefix sharing
-(BASELINE configs 2 and 5).
+[L, num_pages * page_size, Hkv*D] (heads merged into the minor axis — the
+lane-tile alignment the Pallas paged kernel's DMAs require; see
+make_kv_pool_arrays); sequences own ordered lists of physical pages.  The
+host-side allocator is refcounted so pages can be shared between sequences —
+the mechanism behind thread-keyed cache reuse and prefix sharing (BASELINE
+configs 2 and 5).
 
 Page tables, not the pool, are what the jitted step functions consume: a
 [B, max_pages] int32 array per step, from which read/write flat indices are

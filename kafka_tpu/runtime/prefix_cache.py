@@ -1,0 +1,124 @@
+"""Thread-keyed KV prefix cache over the refcounted page pool.
+
+BASELINE config 2: multi-turn threads re-serve the same conversation prefix
+every turn; without this, every request re-prefills from token zero.  The
+reference has the persistence half of the story (the thread store is the
+recovery log, src/db/supabase.py:100-175) — this is the cache optimization
+the TPU engine layers on top:
+
+* When a request carrying a ``prefix_key`` (the thread id) finishes, its
+  sequence's pages are **retained** into the cache together with the exact
+  token ids materialized in them.
+* The next request with the same key shares the longest common token-prefix
+  at page granularity: full pages are refcount-shared (never re-written —
+  new tokens only ever write pages at or past the first partial page), and
+  prefill resumes at the shared boundary (`SequencePages.length > 0`, which
+  the engine's chunked prefill already supports).
+* Entries are LRU; the engine evicts them under page pressure before it
+  preempts live requests — a cache entry is always strictly cheaper to
+  rebuild (one prefill) than a preempted request (prefill + lost batch
+  slot).
+
+Sharing is safe with the engine's async pipeline: a retiring request's
+in-flight decode steps only write KV at positions >= the stored token
+count, which land in the first partial (unshared) page or later.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .kv_cache import PagePool
+
+
+@dataclasses.dataclass
+class _Entry:
+    tokens: List[int]  # token ids whose KV the pages hold, in order
+    pages: List[int]   # physical pages (cache holds one retain on each)
+
+
+class PrefixCache:
+    """LRU map: prefix_key -> (tokens, retained pages)."""
+
+    def __init__(self, pool: PagePool, max_entries: int = 64):
+        self.pool = pool
+        self.max_entries = max_entries
+        self._entries: "OrderedDict[str, _Entry]" = OrderedDict()
+        # counters (observability + tests)
+        self.hits = 0
+        self.misses = 0
+        self.tokens_reused = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def lookup(
+        self, key: str, prompt_ids: Sequence[int]
+    ) -> Optional[Tuple[List[int], int]]:
+        """Return (retained shared pages, cached token count) or None.
+
+        The caller owns one retain on each returned page (released through
+        the sequence's normal free path).  Only whole pages are shared, and
+        at least one prompt token is always left to prefill — the prefill
+        must produce last-token logits.
+        """
+        entry = self._entries.get(key)
+        if entry is None:
+            self.misses += 1
+            return None
+        self._entries.move_to_end(key)
+        lcp = 0
+        limit = min(len(entry.tokens), len(prompt_ids) - 1)
+        while lcp < limit and entry.tokens[lcp] == prompt_ids[lcp]:
+            lcp += 1
+        shared_pages = lcp // self.pool.page_size
+        if shared_pages == 0:
+            self.misses += 1
+            return None
+        pages = list(entry.pages[:shared_pages])
+        self.pool.retain(pages)
+        self.hits += 1
+        cached = shared_pages * self.pool.page_size
+        self.tokens_reused += cached
+        return pages, cached
+
+    def store(self, key: str, tokens: Sequence[int], pages: Sequence[int]) -> None:
+        """Retain `pages` under `key`; replaces any previous entry."""
+        old = self._entries.pop(key, None)
+        if old is not None:
+            self.pool.release(old.pages)
+        n_pages = min(len(pages), -(-len(tokens) // self.pool.page_size))
+        kept = list(pages[:n_pages])
+        self.pool.retain(kept)
+        self._entries[key] = _Entry(tokens=list(tokens), pages=kept)
+        while len(self._entries) > self.max_entries:
+            self._evict_one()
+
+    def _evict_one(self) -> bool:
+        if not self._entries:
+            return False
+        _, entry = self._entries.popitem(last=False)
+        self.pool.release(entry.pages)
+        return True
+
+    def reclaim(self, pages_needed: int) -> bool:
+        """Evict LRU entries until the pool can satisfy `pages_needed`.
+
+        Released pages only become free when no live sequence shares them,
+        so eviction is attempted entry-by-entry and may legitimately fail.
+        """
+        while self.pool.free_pages < pages_needed:
+            if not self._evict_one():
+                return False
+        return True
+
+    def invalidate(self, key: str) -> None:
+        entry = self._entries.pop(key, None)
+        if entry is not None:
+            self.pool.release(entry.pages)
+
+    def clear(self) -> None:
+        while self._evict_one():
+            pass

@@ -1,0 +1,214 @@
+"""Thread-keyed KV prefix cache (BASELINE config 2).
+
+The load-bearing claims:
+  * turn N+1 of a thread re-prefills only the suffix past the shared pages
+    (engine counters prove the reuse; outputs prove correctness),
+  * shared pages are never re-written by the reusing sequence,
+  * cache entries are evicted under page pressure before requests suffer.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from kafka_tpu.models import ModelConfig, init_params
+from kafka_tpu.runtime import EngineConfig, GenRequest, InferenceEngine, PagePool
+from kafka_tpu.runtime.prefix_cache import PrefixCache
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = ModelConfig(name="prefix-test", vocab_size=128, hidden_size=64,
+                      intermediate_size=128, num_layers=2, num_heads=4,
+                      num_kv_heads=2, head_dim=16, dtype="float32")
+    params = init_params(cfg, jax.random.PRNGKey(7))
+    return cfg, params
+
+
+def make_engine(cfg, params, **kw):
+    defaults = dict(max_batch=4, page_size=8, num_pages=64, max_pages_per_seq=8,
+                    prefill_buckets=(8, 16, 32, 64))
+    defaults.update(kw)
+    return InferenceEngine(cfg, params, EngineConfig(**defaults), kv_dtype=jnp.float32)
+
+
+class TestPrefixCacheUnit:
+    def test_store_lookup_roundtrip(self):
+        pool = PagePool(num_pages=32, page_size=4)
+        cache = PrefixCache(pool, max_entries=4)
+        pages = pool.alloc(3)
+        tokens = list(range(10))  # 10 tokens -> 2 full pages + partial
+        cache.store("t1", tokens, pages)
+        hit = cache.lookup("t1", tokens + [99, 98])
+        assert hit is not None
+        shared, cached = hit
+        assert cached == 8  # 2 full pages of 4
+        assert shared == pages[:2]
+        # cache + our lookup retain: freeing the original keeps them alive
+        pool.release(pages)
+        assert pool.refcount[pages[0]] == 2  # cache + lookup
+
+    def test_lookup_respects_divergence(self):
+        pool = PagePool(num_pages=32, page_size=4)
+        cache = PrefixCache(pool, max_entries=4)
+        pages = pool.alloc(3)
+        cache.store("t", list(range(12)), pages)
+        # diverges at token 5 -> only 1 full page (4 tokens) shareable
+        hit = cache.lookup("t", [0, 1, 2, 3, 4, 77, 78, 79])
+        assert hit is not None and hit[1] == 4
+        # diverges at token 2 -> no full page
+        assert cache.lookup("t", [0, 1, 99, 98]) is None
+
+    def test_always_leaves_one_token_to_prefill(self):
+        pool = PagePool(num_pages=32, page_size=4)
+        cache = PrefixCache(pool, max_entries=4)
+        pages = pool.alloc(2)
+        tokens = list(range(8))
+        cache.store("t", tokens, pages)
+        # prompt identical to cached tokens: lcp capped at len-1 = 7 -> 1 page
+        hit = cache.lookup("t", tokens)
+        assert hit is not None and hit[1] == 4
+
+    def test_reclaim_evicts_lru(self):
+        pool = PagePool(num_pages=9, page_size=4)
+        cache = PrefixCache(pool, max_entries=8)
+        a, b = pool.alloc(4), pool.alloc(4)
+        cache.store("a", list(range(16)), a)
+        cache.store("b", list(range(16)), b)
+        pool.release(a)
+        pool.release(b)
+        assert pool.free_pages == 0
+        assert cache.reclaim(4)
+        assert pool.free_pages >= 4
+        assert cache.lookup("a", list(range(16)) + [1]) is None  # LRU evicted
+        assert cache.lookup("b", list(range(16)) + [1]) is not None
+
+    def test_store_replaces_previous_entry(self):
+        pool = PagePool(num_pages=16, page_size=4)
+        cache = PrefixCache(pool, max_entries=4)
+        p1 = pool.alloc(2)
+        cache.store("t", list(range(8)), p1)
+        pool.release(p1)
+        p2 = pool.alloc(2)
+        cache.store("t", list(range(8, 16)), p2)
+        pool.release(p2)
+        # first entry's pages returned to the pool
+        assert pool.free_pages == 15 - 2
+
+
+class TestEnginePrefixReuse:
+    def test_turn_two_prefills_only_suffix(self, model):
+        cfg, params = model
+        eng = make_engine(cfg, params)
+        p1 = list(np.random.RandomState(0).randint(1, 128, size=20))
+        r1 = GenRequest(request_id="turn1", prompt_ids=p1, max_new_tokens=6,
+                        prefix_key="thread-A")
+        eng.submit(r1)
+        eng.run_to_completion()
+        assert len(eng.prefix_cache) == 1
+
+        # turn 2: conversation grew by turn-1 output + new user tokens
+        p2 = p1 + r1.output_ids + [5, 9, 2]
+        r2 = GenRequest(request_id="turn2", prompt_ids=p2, max_new_tokens=6,
+                        prefix_key="thread-A")
+        eng.submit(r2)
+        eng.run_to_completion()
+        assert eng.prefix_cache.hits == 1
+        # 20 prompt + 6 output = 26 materialized -> 3 full pages of 8 shared
+        assert eng.prefix_cache.tokens_reused == 24
+
+        # correctness: same tokens as a cache-less engine
+        eng2 = make_engine(cfg, params, prefix_cache_entries=0)
+        ref = eng2.generate(p2, max_new_tokens=6)
+        assert r2.output_ids == ref.output_ids
+
+    def test_page_aligned_turn_boundary_not_corrupted(self, model):
+        """Regression: the final sampled token's KV is never written; if the
+        materialized count lands exactly on a page boundary the stored entry
+        must not claim that token, or turn 2 shares a page with an unwritten
+        slot and silently generates wrong tokens."""
+        cfg, params = model
+        eng = make_engine(cfg, params)
+        # 20 prompt + 4 output = 24 tokens = exactly 3 pages of 8, but only
+        # 23 KV slots are materialized (length-finish drops the last write)
+        p1 = list(np.random.RandomState(5).randint(1, 128, size=20))
+        r1 = GenRequest(request_id="t1", prompt_ids=p1, max_new_tokens=4,
+                        prefix_key="aligned")
+        eng.submit(r1)
+        eng.run_to_completion()
+        p2 = p1 + r1.output_ids + [11, 12]
+        r2 = GenRequest(request_id="t2", prompt_ids=p2, max_new_tokens=6,
+                        prefix_key="aligned")
+        eng.submit(r2)
+        eng.run_to_completion()
+        ref = make_engine(cfg, params, prefix_cache_entries=0).generate(
+            p2, max_new_tokens=6)
+        assert r2.output_ids == ref.output_ids
+
+    def test_no_key_no_cache(self, model):
+        cfg, params = model
+        eng = make_engine(cfg, params)
+        eng.generate([1, 2, 3, 4, 5, 6, 7, 8, 9], max_new_tokens=4)
+        assert len(eng.prefix_cache) == 0
+
+    def test_divergent_second_turn_still_correct(self, model):
+        cfg, params = model
+        eng = make_engine(cfg, params)
+        p1 = list(np.random.RandomState(1).randint(1, 128, size=17))
+        r1 = GenRequest(request_id="a", prompt_ids=p1, max_new_tokens=4,
+                        prefix_key="t")
+        eng.submit(r1)
+        eng.run_to_completion()
+        # second turn shares only part of the prompt then diverges mid-page
+        p2 = p1[:10] + [100, 101, 102, 103, 104]
+        r2 = GenRequest(request_id="b", prompt_ids=p2, max_new_tokens=5,
+                        prefix_key="t")
+        eng.submit(r2)
+        eng.run_to_completion()
+        ref = make_engine(cfg, params, prefix_cache_entries=0).generate(
+            p2, max_new_tokens=5)
+        assert r2.output_ids == ref.output_ids
+
+    def test_pages_released_after_cache_clear(self, model):
+        cfg, params = model
+        eng = make_engine(cfg, params)
+        r = GenRequest(request_id="x", prompt_ids=list(range(1, 20)),
+                       max_new_tokens=4, prefix_key="t")
+        eng.submit(r)
+        eng.run_to_completion()
+        held = 64 - 1 - eng.pool.free_pages
+        assert held > 0  # cache holds the thread's pages
+        eng.prefix_cache.clear()
+        assert eng.pool.free_pages == 63  # everything back
+
+    def test_pressure_evicts_cache_not_requests(self, model):
+        cfg, params = model
+        # pool sized so a cached thread + a new long request can't coexist
+        eng = make_engine(cfg, params, max_batch=2, num_pages=9,
+                          max_pages_per_seq=8)
+        r1 = GenRequest(request_id="t1", prompt_ids=list(range(1, 25)),
+                        max_new_tokens=4, prefix_key="thread-A")
+        eng.submit(r1)
+        eng.run_to_completion()
+        assert len(eng.prefix_cache) == 1
+        # a fat unrelated request must displace the cache, not deadlock
+        r2 = GenRequest(request_id="big", prompt_ids=list(range(1, 40)),
+                        max_new_tokens=8)
+        eng.submit(r2)
+        done = eng.run_to_completion()
+        assert "big" in done and len(done["big"].output_ids) == 8
+
+    def test_multi_turn_chain_keeps_reusing(self, model):
+        cfg, params = model
+        eng = make_engine(cfg, params, num_pages=64)
+        prompt = list(np.random.RandomState(3).randint(1, 128, size=12))
+        for turn in range(3):
+            r = GenRequest(request_id=f"turn{turn}", prompt_ids=list(prompt),
+                           max_new_tokens=4, prefix_key="chain")
+            eng.submit(r)
+            eng.run_to_completion()
+            prompt = prompt + r.output_ids + [7, 3]
+        assert eng.prefix_cache.hits == 2
+        assert eng.prefix_cache.tokens_reused > 0
