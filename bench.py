@@ -86,8 +86,6 @@ def main() -> None:
     # ---- cache-hit TTFT: same thread, prompt grown by one turn -----------
     # (BASELINE config 2: the second turn shares the first turn's pages and
     # prefills only the suffix)
-    from kafka_tpu.runtime import GenRequest
-
     base = prompt()
     turn1 = GenRequest(request_id="warm-t1", prompt_ids=base,
                        max_new_tokens=8, prefix_key="bench-thread")

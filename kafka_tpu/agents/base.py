@@ -136,9 +136,18 @@ class Agent:
         model: Optional[str] = None,
         temperature: float = 0.7,
         max_tokens: Optional[int] = None,
+        tool_choice: Any = None,
         **llm_kwargs: Any,
     ) -> AsyncIterator[Dict[str, Any]]:
-        """Run the agent loop over `messages`, yielding the event protocol."""
+        """Run the agent loop over `messages`, yielding the event protocol.
+
+        tool_choice follows OpenAI semantics: "required" constrains every
+        assistant turn to emit schema-valid tool-call JSON (the idle tool
+        terminates the run); {"type": "function", "function": {"name": X}}
+        forces one call to X, then reverts to free generation.  Constrained
+        decoding needs provider support (build_tool_call_mask_fn) — without
+        it the choice is advisory only.
+        """
         working: List[Dict[str, Any]] = to_message_dicts(messages)
         sys_prompt = await self._resolve_system_prompt()
         if sys_prompt and not any(m.get("role") == "system" for m in working):
@@ -154,14 +163,24 @@ class Agent:
             acc = ToolCallAccumulator()
             content_parts: List[str] = []
             streamed_any = False
+            iter_kwargs = dict(llm_kwargs)
+            iter_tools = tool_defs
+            if tool_choice == "none":
+                iter_tools = None  # OpenAI semantics: no tool use at all
+            elif tool_choice is not None and "logits_mask_fn" not in iter_kwargs:
+                mask_fn = self.llm.build_tool_call_mask_fn(
+                    tool_defs, tool_choice
+                )
+                if mask_fn is not None:
+                    iter_kwargs["logits_mask_fn"] = mask_fn
             try:
                 stream = self.llm.stream_completion(
                     working,
                     model=model,
                     temperature=temperature,
                     max_tokens=max_tokens,
-                    tools=tool_defs if tool_defs else None,
-                    **llm_kwargs,
+                    tools=iter_tools if iter_tools else None,
+                    **iter_kwargs,
                 )
                 async for chunk in stream:
                     streamed_any = streamed_any or bool(
@@ -187,6 +206,11 @@ class Agent:
                     iteration -= 1  # retry doesn't consume an iteration
                     continue
                 raise
+
+            if isinstance(tool_choice, dict):
+                # specific function forced exactly once; cleared only after
+                # the stream succeeded, so a compaction retry keeps the force
+                tool_choice = None
 
             content = "".join(content_parts)
             tool_calls = acc.result() if acc.has_calls else None

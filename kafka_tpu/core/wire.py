@@ -58,6 +58,7 @@ class AgentRunRequest(BaseModel):
     model: str = "llama-3.2-1b"
     temperature: float = 0.7
     max_tokens: Optional[int] = None
+    tool_choice: Optional[Union[str, Dict[str, Any]]] = None
 
 
 class CreateThreadRequest(BaseModel):

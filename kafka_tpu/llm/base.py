@@ -44,6 +44,20 @@ class LLMProvider(abc.ABC):
     #: provider family name, used in error messages and routing
     provider_name: str = "base"
 
+    def build_tool_call_mask_fn(
+        self,
+        tools: Optional[List[Dict[str, Any]]],
+        tool_choice: Any = "required",
+    ):
+        """Optional constrained-decoding hook (BASELINE config 4).
+
+        Providers with a local sampler return a `logits_mask_fn` that
+        forces generations to be schema-valid tool-call JSON; remote/
+        text-only providers return None and callers fall back to free
+        generation.
+        """
+        return None
+
     @abc.abstractmethod
     def stream_completion(
         self,

@@ -1,0 +1,886 @@
+"""Constrained JSON decoding for tool calls (BASELINE config 4).
+
+The engine's sampler accepts a per-request ``logits_mask_fn`` (runtime/
+engine.py); this module supplies the brain behind it: a mask that forces
+generations to be exactly
+
+    {"name": "<declared tool>", "parameters": {<schema keys>: <JSON>}}
+
+followed by end-of-turn — so forced tool calls always parse, the name is
+always a declared tool, and top-level parameter keys always come from the
+tool's JSON-schema ``properties`` (free JSON is allowed inside values,
+and for tools that declare no properties).
+
+Design, sized for a 128k vocab:
+
+* a character-level **JSON pushdown automaton** (`JsonPDA`) validates free
+  value regions incrementally — strings/escapes/\\u, the full number DFA,
+  literals, nested containers;
+* a **template automaton** (`ToolCallAutomaton`) walks the fixed skeleton,
+  a trie of tool names, a per-tool trie of parameter keys, and delegates
+  value regions to the PDA.  Canonical separators (`": "`, `", "`) keep the
+  skeleton deterministic;
+* a per-tokenizer **TokenIndex** (built once, cached) decodes every vocab
+  token and buckets ids by first character, and precomputes the
+  `string_safe` id set (no quote/backslash/control bytes).  Inside free
+  string content the allowed set is that precomputed array plus a handful
+  of trial-checked quote/escape tokens — never a Python scan of the vocab.
+  Structural positions probe the automaton for legal next characters and
+  trial-feed only the matching first-char buckets.
+
+The reference could not do any of this: its sampler lived behind a remote
+HTTPS gateway (src/llm/portkey.py), so tool-call JSON was best-effort.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+WS = " \t\n\r"
+DIGITS = "0123456789"
+# characters probed when asking an automaton "what may come next"
+PROBE_CHARS = (
+    "".join(chr(c) for c in range(0x20, 0x7F)) + "\t\n\r"
+)
+
+
+# ---------------------------------------------------------------------------
+# character-level JSON automaton
+# ---------------------------------------------------------------------------
+
+
+class JsonPDA:
+    """Incremental validator for a single JSON value.
+
+    `feed(ch)` returns False (and leaves state undefined) on an illegal
+    character; callers trial-feed copies.  `complete` is True when exactly
+    one whole value has been consumed (numbers complete implicitly, so a
+    terminal-number state with an empty stack also counts via
+    `would_complete`)."""
+
+    __slots__ = ("stack", "state", "lit", "max_depth")
+
+    # number DFA states that may legally end the number
+    _NUM_TERMINAL = {"num_zero", "num_int", "num_frac", "num_exp"}
+
+    def __init__(self, max_depth: int = 8) -> None:
+        self.stack: List[str] = []
+        self.state = "value"
+        self.lit = ""  # remaining chars of true/false/null
+        # nesting cap: keeps the worst-case "distance to a valid close"
+        # bounded, which the wrap-up mode (ToolCallMaskFn) relies on
+        self.max_depth = max_depth
+
+    def copy(self) -> "JsonPDA":
+        c = JsonPDA.__new__(JsonPDA)
+        c.stack = list(self.stack)
+        c.state = self.state
+        c.lit = self.lit
+        c.max_depth = self.max_depth
+        return c
+
+    # -- helpers --------------------------------------------------------
+
+    @property
+    def complete(self) -> bool:
+        return not self.stack and self.state == "end"
+
+    @property
+    def would_complete(self) -> bool:
+        """True if ending input here yields a complete value (covers the
+        implicit termination of top-level numbers)."""
+        return self.complete or (
+            not self.stack and self.state in self._NUM_TERMINAL
+        )
+
+    @property
+    def in_string(self) -> bool:
+        """Inside free string content (escape states excluded)."""
+        return self.state in ("in_str", "key_str")
+
+    def _value_done(self) -> None:
+        self.state = "end"
+
+    # -- transitions ----------------------------------------------------
+
+    def feed(self, ch: str) -> bool:  # noqa: C901 (a DFA is a big switch)
+        s = self.state
+        # number states terminate implicitly: close, then re-dispatch
+        if s.startswith("num"):
+            if self._feed_num(ch):
+                return True
+            if s in self._NUM_TERMINAL:
+                self._value_done()
+                return self.feed(ch)
+            return False
+
+        if s == "value":
+            if ch in WS:
+                return True
+            if ch == '"':
+                self.state = "in_str"
+            elif ch == "{":
+                if len(self.stack) >= self.max_depth:
+                    return False
+                self.stack.append("obj")
+                self.state = "key_or_close"
+            elif ch == "[":
+                if len(self.stack) >= self.max_depth:
+                    return False
+                self.stack.append("arr")
+                self.state = "value_or_close"
+            elif ch == "-":
+                self.state = "num_minus"
+            elif ch == "0":
+                self.state = "num_zero"
+            elif ch in "123456789":
+                self.state = "num_int"
+            elif ch == "t":
+                self.state, self.lit = "lit", "rue"
+            elif ch == "f":
+                self.state, self.lit = "lit", "alse"
+            elif ch == "n":
+                self.state, self.lit = "lit", "ull"
+            else:
+                return False
+            return True
+
+        if s == "lit":
+            if self.lit and ch == self.lit[0]:
+                self.lit = self.lit[1:]
+                if not self.lit:
+                    self._value_done()
+                return True
+            return False
+
+        if s == "in_str":
+            if ch == '"':
+                self._value_done()
+            elif ch == "\\":
+                self.state = "str_esc"
+            elif ord(ch) < 0x20:
+                return False
+            return True
+        if s == "str_esc":
+            if ch in '"\\/bfnrt':
+                self.state = "in_str"
+            elif ch == "u":
+                self.state = "str_u0"
+            else:
+                return False
+            return True
+        if s in ("str_u0", "str_u1", "str_u2", "str_u3"):
+            if ch in "0123456789abcdefABCDEF":
+                self.state = (
+                    "in_str" if s == "str_u3" else f"str_u{int(s[-1]) + 1}"
+                )
+                return True
+            return False
+
+        # object machinery
+        if s == "key_or_close":
+            if ch in WS:
+                return True
+            if ch == '"':
+                self.state = "key_str"
+                return True
+            if ch == "}":
+                self.stack.pop()
+                self._value_done()
+                return True
+            return False
+        if s == "key":
+            if ch in WS:
+                return True
+            if ch == '"':
+                self.state = "key_str"
+                return True
+            return False
+        if s == "key_str":
+            if ch == '"':
+                self.state = "colon"
+            elif ch == "\\":
+                self.state = "key_esc"
+            elif ord(ch) < 0x20:
+                return False
+            return True
+        if s == "key_esc":
+            if ch in '"\\/bfnrt':
+                self.state = "key_str"
+                return True
+            return False
+        if s == "colon":
+            if ch in WS:
+                return True
+            if ch == ":":
+                self.state = "value"
+                return True
+            return False
+
+        if s == "value_or_close":
+            if ch in WS:
+                return True
+            if ch == "]":
+                self.stack.pop()
+                self._value_done()
+                return True
+            self.state = "value"
+            return self.feed(ch)
+
+        if s == "end":
+            if ch in WS:
+                return True
+            if self.stack:
+                top = self.stack[-1]
+                if ch == ",":
+                    self.state = "key" if top == "obj" else "value"
+                    return True
+                if ch == "}" and top == "obj":
+                    self.stack.pop()
+                    self._value_done()
+                    return True
+                if ch == "]" and top == "arr":
+                    self.stack.pop()
+                    self._value_done()
+                    return True
+            return False
+
+        return False
+
+    def _feed_num(self, ch: str) -> bool:
+        s = self.state
+        if s == "num_minus":
+            if ch == "0":
+                self.state = "num_zero"
+            elif ch in "123456789":
+                self.state = "num_int"
+            else:
+                return False
+            return True
+        if s == "num_zero":
+            if ch == ".":
+                self.state = "num_frac_dot"
+            elif ch in "eE":
+                self.state = "num_exp_e"
+            else:
+                return False
+            return True
+        if s == "num_int":
+            if ch in DIGITS:
+                return True
+            if ch == ".":
+                self.state = "num_frac_dot"
+            elif ch in "eE":
+                self.state = "num_exp_e"
+            else:
+                return False
+            return True
+        if s == "num_frac_dot":
+            if ch in DIGITS:
+                self.state = "num_frac"
+                return True
+            return False
+        if s == "num_frac":
+            if ch in DIGITS:
+                return True
+            if ch in "eE":
+                self.state = "num_exp_e"
+                return True
+            return False
+        if s == "num_exp_e":
+            if ch in "+-":
+                self.state = "num_exp_sign"
+                return True
+            if ch in DIGITS:
+                self.state = "num_exp"
+                return True
+            return False
+        if s == "num_exp_sign":
+            if ch in DIGITS:
+                self.state = "num_exp"
+                return True
+            return False
+        if s == "num_exp":
+            return ch in DIGITS
+        return False
+
+    def feed_text(self, text: str) -> bool:
+        for ch in text:
+            if not self.feed(ch):
+                return False
+        return True
+
+
+# ---------------------------------------------------------------------------
+# trie (tool names / parameter keys)
+# ---------------------------------------------------------------------------
+
+
+class _Trie:
+    def __init__(self, words: Iterable[str]):
+        self.root: Dict[str, Any] = {}
+        for w in words:
+            node = self.root
+            for ch in w:
+                node = node.setdefault(ch, {})
+            node[None] = True  # terminal marker (no char collides with None)
+
+    def step(self, node: Dict[str, Any], ch: str) -> Optional[Dict[str, Any]]:
+        return node.get(ch)
+
+    @staticmethod
+    def shortest_exit(node: Dict[str, Any]) -> str:
+        """First char of a shortest path from `node` to a terminal."""
+        if None in node:
+            return ""  # already terminal
+        best_ch, best_len = "", 1 << 30
+
+        def depth(n: Dict[str, Any]) -> int:
+            if None in n:
+                return 0
+            return 1 + min(depth(c) for k, c in n.items() if k is not None)
+
+        for k, child in node.items():
+            if k is None:
+                continue
+            d = 1 + depth(child)
+            if d < best_len:
+                best_len, best_ch = d, k
+        return best_ch
+
+
+# ---------------------------------------------------------------------------
+# tool-call template automaton
+# ---------------------------------------------------------------------------
+
+_HEAD = '{"name": "'
+_MID = '", "parameters": {'
+_TAIL = "}"
+
+
+class ToolCallAutomaton:
+    """Accepts exactly the canonical tool-call JSON (module docstring).
+
+    States:
+      head:<i>        inside the literal head
+      name            walking the tool-name trie
+      mid:<i>         inside the literal mid section
+      p_key_or_close  params object: '"' (first key) or '}' (no params)
+      p_key           walking the parameter-key trie (or free string)
+      p_colon:<i>     the literal '": '
+      p_value         inside a free JSON value (inner JsonPDA)
+      p_sep:<i>       the literal ', "' between entries
+      tail:<i>        the closing literal
+      done            only end-of-turn may follow
+    """
+
+    def __init__(
+        self,
+        tools: Sequence[Dict[str, Any]],
+        force_name: Optional[str] = None,
+    ):
+        self._props_by_name: Dict[str, Optional[List[str]]] = {}
+        names = []
+        for t in tools:
+            fn = t.get("function", t)
+            name = fn.get("name")
+            if not name:
+                continue
+            if force_name is not None and name != force_name:
+                continue
+            names.append(name)
+            params = fn.get("parameters") or {}
+            props = list((params.get("properties") or {}).keys())
+            if params.get("additionalProperties") is True or (
+                not props and "properties" not in params
+            ):
+                # explicitly open, or no schema at all: free-form keys
+                self._props_by_name[name] = None
+            else:
+                # declared property set (possibly empty -> params must be {})
+                self._props_by_name[name] = props
+        if not names:
+            raise ValueError("no tools to constrain to")
+        self._name_trie = _Trie(names)
+        self.reset()
+
+    def reset(self) -> None:
+        self.state: Tuple[str, Any] = ("head", 0)
+        self._name_chars: List[str] = []
+        self._name_node = self._name_trie.root
+        self._key_trie: Optional[_Trie] = None
+        self._key_node: Optional[Dict[str, Any]] = None
+        self._key_pda: Optional[JsonPDA] = None  # free-key fallback
+        self._value_pda: Optional[JsonPDA] = None
+
+    def copy(self) -> "ToolCallAutomaton":
+        c = ToolCallAutomaton.__new__(ToolCallAutomaton)
+        c._props_by_name = self._props_by_name
+        c._name_trie = self._name_trie
+        c.state = self.state
+        c._name_chars = list(self._name_chars)
+        c._name_node = self._name_node
+        c._key_trie = self._key_trie
+        c._key_node = self._key_node
+        c._key_pda = self._key_pda.copy() if self._key_pda else None
+        c._value_pda = self._value_pda.copy() if self._value_pda else None
+        return c
+
+    @property
+    def done(self) -> bool:
+        return self.state[0] == "done"
+
+    @property
+    def in_free_string(self) -> bool:
+        """Inside unconstrained string content (precomputed-set fast path)."""
+        kind = self.state[0]
+        if kind == "p_value":
+            return self._value_pda is not None and self._value_pda.in_string
+        if kind == "p_key" and self._key_trie is None:
+            return self._key_pda is not None and self._key_pda.state == "key_str"
+        return False
+
+    # ------------------------------------------------------------------
+
+    def _enter_params(self) -> None:
+        name = "".join(self._name_chars)
+        props = self._props_by_name.get(name)
+        self._key_trie = _Trie(props) if props is not None else None
+        self.state = ("p_key_or_close", None)
+
+    def _start_key(self) -> None:
+        if self._key_trie is not None:
+            self._key_node = self._key_trie.root
+        else:
+            pda = JsonPDA()
+            pda.state = "key_str"
+            self._key_pda = pda
+        self.state = ("p_key", None)
+
+    def feed(self, ch: str) -> bool:  # noqa: C901
+        kind, arg = self.state
+        if kind == "head":
+            if ch != _HEAD[arg]:
+                return False
+            self.state = ("name", None) if arg + 1 == len(_HEAD) else ("head", arg + 1)
+            return True
+
+        if kind == "name":
+            if ch == '"':
+                if None not in self._name_node:
+                    return False
+                self.state = ("mid", 1)  # the '"' consumed counts as _MID[0]
+                return True
+            nxt = self._name_trie.step(self._name_node, ch)
+            if nxt is None:
+                return False
+            self._name_node = nxt
+            self._name_chars.append(ch)
+            return True
+
+        if kind == "mid":
+            if ch != _MID[arg]:
+                return False
+            if arg + 1 == len(_MID):
+                self._enter_params()
+            else:
+                self.state = ("mid", arg + 1)
+            return True
+
+        if kind == "p_key_or_close":
+            if ch == "}":
+                self.state = ("tail", 0)
+                return True
+            if ch == '"':
+                if self._key_trie is not None and not self._key_trie.root:
+                    return False  # schema declares zero properties: {} only
+                self._start_key()
+                return True
+            return False
+
+        if kind == "p_key":
+            if self._key_trie is not None:
+                if ch == '"':
+                    if None not in self._key_node:  # type: ignore[operator]
+                        return False
+                    self.state = ("p_colon", 0)
+                    return True
+                nxt = self._key_trie.step(self._key_node, ch)  # type: ignore[arg-type]
+                if nxt is None:
+                    return False
+                self._key_node = nxt
+                return True
+            # free key: PDA string semantics
+            assert self._key_pda is not None
+            if not self._key_pda.feed(ch):
+                return False
+            if self._key_pda.state == "colon":  # closing quote consumed
+                self._key_pda = None
+                self.state = ("p_colon", 0)
+            return True
+
+        if kind == "p_colon":
+            lit = ": "
+            if ch != lit[arg]:
+                return False
+            if arg + 1 == len(lit):
+                self._value_pda = JsonPDA()
+                self.state = ("p_value", None)
+            else:
+                self.state = ("p_colon", arg + 1)
+            return True
+
+        if kind == "p_value":
+            pda = self._value_pda
+            assert pda is not None
+            if pda.feed(ch):
+                if pda.complete:
+                    self._value_pda = None
+                    self.state = ("p_after_value", None)
+                return True
+            # implicit value termination (numbers) on , or }
+            if pda.would_complete and ch in ",}":
+                self._value_pda = None
+                self.state = ("p_after_value", None)
+                return self.feed(ch)
+            return False
+
+        if kind == "p_after_value":
+            if ch == ",":
+                self.state = ("p_sep", 0)
+                return True
+            if ch == "}":
+                self.state = ("tail", 0)
+                return True
+            return False
+
+        if kind == "p_sep":
+            lit = ' "'
+            if ch != lit[arg]:
+                return False
+            if arg + 1 == len(lit):
+                self._start_key()
+            else:
+                self.state = ("p_sep", arg + 1)
+            return True
+
+        if kind == "tail":
+            if ch != _TAIL[arg]:
+                return False
+            if arg + 1 == len(_TAIL):
+                self.state = ("done", None)
+            else:
+                self.state = ("tail", arg + 1)
+            return True
+
+        return False  # done: no further text
+
+    def feed_text(self, text: str) -> bool:
+        for ch in text:
+            if not self.feed(ch):
+                return False
+        return True
+
+    def wrap_char(self) -> Optional[str]:
+        """Next char on a shortest path to `done` (wrap-up mode).
+
+        With JsonPDA.max_depth bounding nesting, the distance from any
+        reachable state to `done` is small and this greedy walk always
+        terminates the call.  Returns None when done."""
+        kind, arg = self.state
+        if kind == "done":
+            return None
+        if kind == "head":
+            return _HEAD[arg]
+        if kind == "mid":
+            return _MID[arg]
+        if kind == "tail":
+            return _TAIL[arg]
+        if kind == "p_colon":
+            return ": "[arg]
+        if kind == "p_sep":
+            # mid-separator: must finish it, then the shortest key
+            return ' "'[arg]
+        if kind == "name":
+            return _Trie.shortest_exit(self._name_node) or '"'
+        if kind == "p_key_or_close":
+            return "}"
+        if kind == "p_after_value":
+            return "}"
+        if kind == "p_key":
+            if self._key_trie is not None:
+                return _Trie.shortest_exit(self._key_node) or '"'  # type: ignore[arg-type]
+            return '"'  # close the free key
+        if kind == "p_value":
+            pda = self._value_pda
+            assert pda is not None
+            s = pda.state
+            if s == "value":
+                return "0"  # minimal value
+            if s == "in_str":
+                return '"'
+            if s == "str_esc":
+                return "n"
+            if s.startswith("str_u"):
+                return "0"
+            if s == "lit":
+                return pda.lit[0]
+            if s.startswith("num"):
+                if s in JsonPDA._NUM_TERMINAL:
+                    if pda.stack:
+                        return "}" if pda.stack[-1] == "obj" else "]"
+                    return "}"  # closes params via implicit value end
+                return "0"
+            if s == "key_or_close":
+                return "}"
+            if s == "key":
+                return '"'
+            if s in ("key_str",):
+                return '"'
+            if s == "key_esc":
+                return "n"
+            if s == "colon":
+                return ":"
+            if s == "value_or_close":
+                return "]"
+            if s == "end":
+                if pda.stack:
+                    return "}" if pda.stack[-1] == "obj" else "]"
+                return "}"  # value complete -> params close via p_after_value
+        return None
+
+    def min_close_chars(self, limit: int = 512) -> int:
+        """Characters on the shortest path from here to `done` (greedy walk
+        of wrap_char; bounded because JsonPDA caps nesting)."""
+        c = self.copy()
+        n = 0
+        while not c.done and n < limit:
+            ch = c.wrap_char()
+            if not ch:
+                break
+            if not c.feed(ch):  # pragma: no cover — wrap_char is always legal
+                break
+            n += 1
+        return n
+
+
+# ---------------------------------------------------------------------------
+# tokenizer-level mask
+# ---------------------------------------------------------------------------
+
+_TOKEN_INDEX_CACHE: Dict[int, "TokenIndex"] = {}
+_TOKEN_INDEX_LOCK = __import__("threading").Lock()
+
+
+class TokenIndex:
+    """Per-tokenizer vocab index for mask building (built once, cached)."""
+
+    def __init__(self, tokenizer) -> None:
+        self.vocab_size = tokenizer.vocab_size
+        texts: List[str] = []
+        for i in range(self.vocab_size):
+            try:
+                texts.append(tokenizer.decode([i]))
+            except Exception:
+                texts.append("")
+        self.texts = texts
+        self.buckets: Dict[str, List[int]] = {}
+        safe: List[int] = []
+        for i, t in enumerate(texts):
+            if not t or "�" in t:
+                # specials / tokens that don't decode standalone (partial
+                # UTF-8 byte tokens): excluded — the mask can only admit
+                # text it can validate
+                continue
+            self.buckets.setdefault(t[0], []).append(i)
+            if all(c not in '"\\' and ord(c) >= 0x20 for c in t):
+                safe.append(i)
+        self.string_safe = np.asarray(safe, np.int64)
+
+    @classmethod
+    def for_tokenizer(cls, tokenizer) -> "TokenIndex":
+        """Cached build; the lock keeps a warmup thread and the first
+        request from decoding the vocab twice (a 128k-vocab build is
+        seconds of work — see TokenIndex.warm)."""
+        key = id(tokenizer)
+        idx = _TOKEN_INDEX_CACHE.get(key)
+        if idx is not None:
+            return idx
+        with _TOKEN_INDEX_LOCK:
+            idx = _TOKEN_INDEX_CACHE.get(key)
+            if idx is None:
+                idx = cls(tokenizer)
+                _TOKEN_INDEX_CACHE[key] = idx
+        return idx
+
+    @classmethod
+    def warm(cls, tokenizer) -> None:
+        """Build the index off the event loop (daemon thread)."""
+        import threading
+
+        threading.Thread(
+            target=cls.for_tokenizer, args=(tokenizer,), daemon=True,
+            name="kafka-tpu-token-index",
+        ).start()
+
+
+class ToolCallMaskFn:
+    """`logits_mask_fn` forcing canonical tool-call JSON (engine protocol:
+    called with output_ids, returns allowed token ids or None)."""
+
+    # extra tokens kept in hand beyond the computed shortest-close distance
+    # (each close char needs at most one token)
+    WRAP_UP_SLACK = 4
+
+    def __init__(
+        self,
+        tokenizer,
+        tools: Sequence[Dict[str, Any]],
+        force_name: Optional[str] = None,
+        max_tokens: Optional[int] = None,
+    ):
+        self._tok = tokenizer
+        self._index = TokenIndex.for_tokenizer(tokenizer)
+        self._auto = ToolCallAutomaton(tools, force_name=force_name)
+        self._consumed = 0  # output_ids already fed (incremental)
+        self._fed_text_len = 0
+        self._max_tokens = max_tokens
+
+    def set_budget(self, max_tokens: int) -> None:
+        """Engine hook: the token budget after window clamping.  Near its
+        end the mask restricts to a shortest valid close (wrap-up), so a
+        bounded generation still parses."""
+        self._max_tokens = max_tokens
+
+    def __call__(self, output_ids: List[int]) -> Optional[List[int]]:
+        if self._consumed > len(output_ids):  # new attempt/rewind
+            self._auto.reset()
+            self._consumed = 0
+            self._fed_text_len = 0
+        text = self._tok.decode(output_ids)
+        delta = text[self._fed_text_len :]
+        if delta:
+            # generation is mask-constrained, so the delta always feeds
+            if not self._auto.feed_text(delta):
+                # defensive: unconstrained prefix (shouldn't happen) —
+                # give up and stop constraining
+                return None
+            self._fed_text_len = len(text)
+        self._consumed = len(output_ids)
+        if self._max_tokens is not None and not self._auto.done:
+            remaining = self._max_tokens - len(output_ids)
+            if remaining <= self._auto.min_close_chars() + self.WRAP_UP_SLACK:
+                wrapped = self._wrap_up_ids()
+                if wrapped:
+                    return wrapped
+        return self._allowed_ids()
+
+    def _allowed_ids(self) -> List[int]:
+        auto, idx = self._auto, self._index
+        if auto.done:
+            return [self._tok.eot_id]
+        allowed: List[int]
+        if auto.in_free_string:
+            # fast path: precomputed safe set + trial-checked specials
+            allowed = list(idx.string_safe)
+            for ch in ('"', "\\"):
+                for tid in idx.buckets.get(ch, ()):
+                    if self._trial(tid):
+                        allowed.append(tid)
+            return allowed
+        legal = [ch for ch in PROBE_CHARS if auto.copy().feed(ch)]
+        allowed = []
+        for ch in legal:
+            for tid in idx.buckets.get(ch, ()):
+                if self._trial(tid):
+                    allowed.append(tid)
+        if auto.done:  # pragma: no cover (handled above)
+            allowed.append(self._tok.eot_id)
+        return allowed
+
+    def _wrap_up_ids(self) -> List[int]:
+        """Allowed ids in wrap-up mode: tokens starting with the shortest
+        path-to-close character that validate fully."""
+        ch = self._auto.wrap_char()
+        if ch is None or ch == "":
+            return [self._tok.eot_id]
+        out = [
+            tid
+            for tid in self._index.buckets.get(ch, ())
+            if self._trial(tid)
+        ]
+        return out
+
+    def _trial(self, token_id: int) -> bool:
+        text = self._index.texts[token_id]
+        c = self._auto.copy()
+        for ch in text:
+            if c.done:
+                return False  # text runs past the end of the call
+            if not c.feed(ch):
+                return False
+        return True
+
+
+def build_tool_call_mask_fn(
+    tokenizer,
+    tools: Sequence[Dict[str, Any]],
+    tool_choice: Any = "required",
+) -> Optional[ToolCallMaskFn]:
+    """Resolve an OpenAI-style tool_choice into a mask fn (None = don't).
+
+    Only "required" and {"type": "function", "function": {"name": ...}}
+    constrain; "auto"/"none"/None and unrecognized values return None.  A
+    forced name that matches no declared tool degrades to unconstrained
+    with a warning rather than failing the request.
+    """
+    if not tools:
+        return None
+    force = None
+    if isinstance(tool_choice, dict):
+        force = (tool_choice.get("function") or {}).get("name")
+        declared = {
+            (t.get("function", t)).get("name") for t in tools
+        }
+        if force not in declared:
+            import logging
+
+            logging.getLogger("kafka_tpu.constrained").warning(
+                "tool_choice forces unknown function %r (declared: %s); "
+                "falling back to unconstrained generation",
+                force, sorted(n for n in declared if n),
+            )
+            return None
+    elif tool_choice != "required":
+        return None
+    return ToolCallMaskFn(tokenizer, tools, force_name=force)
+
+
+def validate_tool_call_json(
+    text: str, tools: Sequence[Dict[str, Any]]
+) -> bool:
+    """Post-hoc check used by tests: parses, names a declared tool, and
+    top-level parameter keys are declared properties."""
+    try:
+        obj = json.loads(text)
+    except json.JSONDecodeError:
+        return False
+    if not isinstance(obj, dict):
+        return False
+    by_name = {}
+    for t in tools:
+        fn = t.get("function", t)
+        by_name[fn.get("name")] = fn.get("parameters") or {}
+    if obj.get("name") not in by_name:
+        return False
+    params = obj.get("parameters")
+    if not isinstance(params, dict):
+        return False
+    schema = by_name[obj["name"]]
+    props = (schema.get("properties") or {}).keys()
+    if props and schema.get("additionalProperties") is not True:
+        return all(k in props for k in params)
+    return True

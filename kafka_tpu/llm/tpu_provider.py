@@ -106,6 +106,11 @@ class TPULLMProvider(LLMProvider):
         self.worker.start()
         self.max_images = max_images
         self._counter = itertools.count()
+        # pre-build the constrained-decoding vocab index off the event loop
+        # so the first tool_choice-constrained request doesn't stall serving
+        from .constrained import TokenIndex
+
+        TokenIndex.warm(tokenizer)
 
     # ------------------------------------------------------------------
 
@@ -137,6 +142,18 @@ class TPULLMProvider(LLMProvider):
             "supports_tools": True,
             "supports_streaming": True,
         }
+
+    def build_tool_call_mask_fn(
+        self,
+        tools: Optional[List[Dict[str, Any]]],
+        tool_choice: Any = "required",
+    ):
+        """Constrained decoding over the local sampler (llm/constrained.py):
+        the returned fn plugs into GenRequest.logits_mask_fn and forces
+        schema-valid tool-call JSON."""
+        from .constrained import build_tool_call_mask_fn
+
+        return build_tool_call_mask_fn(self.tokenizer, tools or [], tool_choice)
 
     def get_available_models(self) -> List[Dict[str, Any]]:
         return [

@@ -378,6 +378,12 @@ class InferenceEngine:
             req.max_new_tokens = self.ecfg.max_new_tokens_default
         if len(req.prompt_ids) + req.max_new_tokens > limit:
             req.max_new_tokens = max(1, limit - len(req.prompt_ids))
+        if req.logits_mask_fn is not None and hasattr(
+            req.logits_mask_fn, "set_budget"
+        ):
+            # constrained decoding: tell the mask the post-clamp budget so
+            # it can wrap the JSON up before tokens run out
+            req.logits_mask_fn.set_budget(req.max_new_tokens)
         req.prefill_ids = list(req.prompt_ids)
         req.submit_time = time.monotonic()
         req.state = WAITING

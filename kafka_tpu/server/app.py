@@ -239,6 +239,8 @@ async def _agent_events(
         temperature=req_body.temperature if req_body.temperature is not None else 0.7,
         max_tokens=req_body.max_tokens,
     )
+    if getattr(req_body, "tool_choice", None) is not None:
+        sampling["tool_choice"] = req_body.tool_choice
     messages = [m.model_dump(exclude_none=True) for m in req_body.messages]
     model = req_body.model or state["cfg"].model_name
     acc = MessageAccumulator()
