@@ -42,6 +42,11 @@ class ModelConfig:
     # resolve EngineConfig.attention_backend="auto" to one of these — plain
     # forward() callers keep the portable XLA path by default.
     attention_backend: str = "xla"
+    # Chunked-prefill attention over an "sp" mesh axis (ring attention, the
+    # chunk sequence-sharded; parallel/ring_attention.py).  Set by the
+    # engine when its mesh has sp > 1; forward(..., mesh=...) must receive
+    # the mesh.
+    prefill_ring: bool = False
 
     @property
     def q_per_kv(self) -> int:

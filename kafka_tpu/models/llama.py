@@ -76,6 +76,9 @@ class PagedView(NamedTuple):
     page_table: Optional[jnp.ndarray] = None
     seq_lens: Optional[jnp.ndarray] = None
     page_size: Optional[int] = None
+    # prefill-chunk bounds (pallas flash prefill backend only)
+    start: Optional[jnp.ndarray] = None
+    chunk_len: Optional[jnp.ndarray] = None
 
 
 def init_kv_cache(cfg: ModelConfig, batch: int, capacity: int, dtype=None) -> KVCache:
@@ -127,6 +130,7 @@ def _attention_block(
     kv_valid: Optional[jnp.ndarray],
     cache_positions: Optional[jnp.ndarray],
     paged: Optional["PagedView"] = None,
+    mesh=None,
 ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray], Optional[jnp.ndarray]]:
     """One attention sublayer. x: [B, S, H]. Returns (out, k_cache', v_cache')."""
     q = jnp.einsum("bsh,hnd->bsnd", x, lp["wq"])
@@ -160,6 +164,44 @@ def _attention_block(
                 page_size=paged.page_size,
                 interpret=jax.default_backend() != "tpu",
             )[:, None]  # [B, 1, Hq, D]
+        elif (
+            cfg.attention_backend == "pallas"
+            and s > 1
+            and b == 1
+            and paged.page_table is not None
+            and paged.start is not None
+        ):
+            from ..ops.pallas import paged_prefill_attention
+
+            out = paged_prefill_attention(
+                q[0],  # [S, Hq, D]
+                k_cache,
+                v_cache,
+                paged.page_table[0],
+                paged.start,
+                paged.chunk_len,
+                page_size=paged.page_size,
+                interpret=jax.default_backend() != "tpu",
+            )[None]
+        elif cfg.prefill_ring and s > 1:
+            # Chunked prefill over the sp axis: the chunk's own q/k/v ride
+            # the ring sequence-sharded; the paged window of earlier chunks
+            # (ctx_valid excludes the chunk's freshly written positions —
+            # those would otherwise be counted twice) is read locally from
+            # the pool by every sp rank (heads stay tp-sharded).
+            from ..parallel.ring_attention import ring_prefill_sharded
+
+            if mesh is None:
+                raise RuntimeError(
+                    "prefill_ring requires the mesh (forward(..., mesh=...))"
+                )
+            k_win = k_cache[paged.read_idx].reshape(b, -1, hkv, d)
+            v_win = v_cache[paged.read_idx].reshape(b, -1, hkv, d)
+            ctx_valid = paged.kv_valid & (paged.kv_positions < positions[:, :1])
+            out = ring_prefill_sharded(
+                mesh, q, k, v, positions,
+                k_win, v_win, paged.kv_positions, ctx_valid,
+            )
         else:
             k_win = k_cache[paged.read_idx].reshape(b, -1, hkv, d)
             v_win = v_cache[paged.read_idx].reshape(b, -1, hkv, d)
@@ -213,6 +255,7 @@ def forward(
     kv_valid: Optional[jnp.ndarray] = None,
     cache_positions: Optional[jnp.ndarray] = None,
     paged: Optional[PagedView] = None,
+    mesh=None,
 ) -> Tuple[jnp.ndarray, Optional[KVCache]]:
     """Run the decoder.
 
@@ -234,7 +277,7 @@ def forward(
         attn_in = rms_norm(h, lp["ln_attn"], cfg.rms_norm_eps)
         attn_out, kc, vc = _attention_block(
             attn_in, lp, cfg, cos, sin, positions, kc, vc, kv_valid,
-            cache_positions, paged,
+            cache_positions, paged, mesh,
         )
         h = h + attn_out
         mlp_in = rms_norm(h, lp["ln_mlp"], cfg.rms_norm_eps)

@@ -13,6 +13,7 @@ Selection is driven by `ModelConfig.attention_backend`:
   "xla"    — force the reference path
 """
 
+from .flash_prefill import paged_prefill_attention
 from .paged_attention import paged_decode_attention
 
-__all__ = ["paged_decode_attention"]
+__all__ = ["paged_decode_attention", "paged_prefill_attention"]

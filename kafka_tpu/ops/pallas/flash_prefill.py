@@ -1,0 +1,239 @@
+"""Flash prefill kernel over the paged KV pool.
+
+The XLA prefill path materializes the full [Hq, S, C] score tensor per
+layer — at an 8k window that is half a gigabyte of f32 per chunk per
+layer.  This kernel streams the KV window in page chunks with online
+softmax (flash attention), so peak memory is O(q_block x kv_chunk) and
+HBM traffic is one pass over the valid window per q block.
+
+Structure mirrors the decode kernel (paged_attention.py):
+
+* merged-lane pool [TOTAL_SLOTS, Hkv*D] (the DMA lane-alignment contract);
+* GQA via the block-diagonal q expansion — rows are (q position, q head)
+  pairs, each row's D lanes sit in its kv head's block, one full-width
+  MXU matmul per chunk, per-head lanes sliced out by the caller;
+* grid = (num_q_blocks,); per block, a dynamic fori_loop over the kv
+  chunks the causal mask can reach (a q block early in the prompt skips
+  the chunks after it entirely), each chunk double-buffer DMA'd.
+
+Causality: the engine writes the whole chunk's KV to the pool before
+attention, so kv slots carry absolute positions page-order; a query at
+absolute position p attends kv positions <= p, bounded by the written
+total (start + chunk_len).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _prefill_kernel(
+    # scalar prefetch
+    page_row_ref,   # [P] i32 physical pages of this sequence
+    bounds_ref,     # [2] i32: (start, chunk_len)
+    # inputs
+    qx_ref,         # [QB*Hq, Hkv*D] VMEM block (block-diagonal expanded)
+    k_pages_hbm,    # [num_pages, ps, Hkv*D] ANY
+    v_pages_hbm,    # [num_pages, ps, Hkv*D] ANY
+    out_ref,        # [QB*Hq, Hkv*D] VMEM block
+    # scratch
+    kbuf, vbuf, ksem, vsem,
+    m_ref, l_ref, acc_ref,
+    *,
+    num_q_heads: int,
+    page_size: int,
+    pages_per_chunk: int,
+    q_block: int,
+    scale: float,
+):
+    qb = pl.program_id(0)
+    ps, cp, hq = page_size, pages_per_chunk, num_q_heads
+    chunk = cp * ps
+    start = bounds_ref[0]
+    chunk_len = bounds_ref[1]
+    # kv positions this q block may attend: all of [0, kv_hi) — the block's
+    # last real query position + 1, already bounded by the written total
+    kv_hi = start + jnp.minimum((qb + 1) * q_block, chunk_len)
+    n_pages = pl.cdiv(kv_hi, ps)
+    n_chunks = pl.cdiv(n_pages, cp)
+
+    def issue(c, slot):
+        for j in range(cp):
+            @pl.when(c * cp + j < n_pages)
+            def _():
+                page = page_row_ref[c * cp + j]
+                pltpu.make_async_copy(
+                    k_pages_hbm.at[page],
+                    kbuf.at[slot, pl.ds(j * ps, ps)],
+                    ksem.at[slot, j],
+                ).start()
+                pltpu.make_async_copy(
+                    v_pages_hbm.at[page],
+                    vbuf.at[slot, pl.ds(j * ps, ps)],
+                    vsem.at[slot, j],
+                ).start()
+
+    def wait(c, slot):
+        for j in range(cp):
+            @pl.when(c * cp + j < n_pages)
+            def _():
+                page = page_row_ref[c * cp + j]
+                pltpu.make_async_copy(
+                    k_pages_hbm.at[page],
+                    kbuf.at[slot, pl.ds(j * ps, ps)],
+                    ksem.at[slot, j],
+                ).wait()
+                pltpu.make_async_copy(
+                    v_pages_hbm.at[page],
+                    vbuf.at[slot, pl.ds(j * ps, ps)],
+                    vsem.at[slot, j],
+                ).wait()
+
+    m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+    l_ref[...] = jnp.zeros_like(l_ref)
+    acc_ref[...] = jnp.zeros_like(acc_ref)
+    issue(0, 0)
+
+    rows = q_block * hq
+    # absolute q position of each folded row (row = q_idx * Hq + head)
+    row_ids = jax.lax.broadcasted_iota(jnp.int32, (rows, 1), 0)
+    q_pos = start + qb * q_block + row_ids // hq  # [rows, 1]
+
+    def body(c, carry):
+        slot = jax.lax.rem(c, 2)
+
+        @pl.when(c + 1 < n_chunks)
+        def _():
+            issue(c + 1, jax.lax.rem(c + 1, 2))
+
+        wait(c, slot)
+
+        col_ids = jax.lax.broadcasted_iota(jnp.int32, (1, chunk), 1)
+        kv_pos = c * chunk + col_ids  # [1, chunk]
+        mask = (q_pos >= kv_pos) & (kv_pos < kv_hi)  # [rows, chunk]
+        # column-shaped validity built directly (Mosaic cannot transpose a
+        # boolean vector)
+        col_iota = jax.lax.broadcasted_iota(jnp.int32, (chunk, 1), 0)
+        col_valid = col_iota < (kv_hi - c * chunk)
+
+        kc = kbuf[slot].astype(jnp.float32)  # [chunk, HD]
+        # zero junk V rows (never-DMA'd NaNs poison 0-weight matmuls)
+        vc = jnp.where(col_valid, vbuf[slot].astype(jnp.float32), 0.0)
+        qx = qx_ref[...].astype(jnp.float32)  # [rows, HD]
+        s = (
+            jax.lax.dot_general(
+                qx, kc,
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )  # [rows, chunk]
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        pexp = jnp.exp(s - m_new)
+        pexp = jnp.where(mask, pexp, 0.0)
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(pexp, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            pexp, vc,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[...] = m_new
+        return carry
+
+    jax.lax.fori_loop(0, n_chunks, body, 0)
+    denom = jnp.maximum(l_ref[...], 1e-30)
+    out_ref[...] = (acc_ref[...] / denom).astype(out_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("page_size", "pages_per_chunk", "q_block", "scale",
+                     "interpret"),
+)
+def paged_prefill_attention(
+    q: jnp.ndarray,          # [S, Hq, D] roped queries of this chunk
+    k_pool: jnp.ndarray,     # [TOTAL_SLOTS, Hkv*D] merged-lane pool
+    v_pool: jnp.ndarray,
+    page_row: jnp.ndarray,   # [P] i32 pages of this sequence
+    start: jnp.ndarray,      # scalar i32: chunk's first absolute position
+    chunk_len: jnp.ndarray,  # scalar i32: real tokens in the chunk
+    *,
+    page_size: int,
+    pages_per_chunk: int = 8,
+    q_block: int = 64,
+    scale: float | None = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Flash attention of one prefill chunk against the paged window.
+
+    Returns [S, Hq, D] in q.dtype.  Rows past chunk_len are garbage (their
+    KV went to the trash page) — same contract as the XLA path, which only
+    samples from the last real row.
+    """
+    S, Hq, D = q.shape
+    HD = k_pool.shape[1]
+    Hkv = HD // D
+    G = Hq // Hkv
+    if scale is None:
+        scale = D**-0.5
+    qb = min(q_block, S)
+    if S % qb:
+        raise ValueError(f"chunk length {S} not divisible by q_block {qb}")
+    cp = min(pages_per_chunk, page_row.shape[0])
+    k_pages = k_pool.reshape(-1, page_size, HD)
+    v_pages = v_pool.reshape(-1, page_size, HD)
+
+    # block-diagonal expansion, rows = (q position, head) pairs
+    kv_of_q = jnp.repeat(jnp.arange(Hkv), G)  # [Hq]
+    qx = jnp.zeros((S, Hq, Hkv, D), q.dtype)
+    qx = qx.at[:, jnp.arange(Hq), kv_of_q].set(q)
+    qx = qx.reshape(S * Hq, HD)
+    bounds = jnp.stack([jnp.asarray(start, jnp.int32),
+                        jnp.asarray(chunk_len, jnp.int32)])
+
+    rows = qb * Hq
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(S // qb,),
+        in_specs=[
+            pl.BlockSpec((rows, HD), lambda b, pr, bd: (b, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=pl.BlockSpec((rows, HD), lambda b, pr, bd: (b, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, cp * page_size, HD), k_pool.dtype),
+            pltpu.VMEM((2, cp * page_size, HD), v_pool.dtype),
+            pltpu.SemaphoreType.DMA((2, cp)),
+            pltpu.SemaphoreType.DMA((2, cp)),
+            pltpu.VMEM((rows, 1), jnp.float32),
+            pltpu.VMEM((rows, 1), jnp.float32),
+            pltpu.VMEM((rows, HD), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _prefill_kernel,
+        num_q_heads=Hq,
+        page_size=page_size,
+        pages_per_chunk=cp,
+        q_block=qb,
+        scale=scale,
+    )
+    out_wide = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S * Hq, HD), q.dtype),
+        interpret=interpret,
+    )(page_row, bounds, qx, k_pages, v_pages)
+    return out_wide.reshape(S, Hq, Hkv, D)[:, jnp.arange(Hq), kv_of_q]

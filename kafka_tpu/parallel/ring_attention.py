@@ -36,12 +36,14 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..ops.attention import NEG_INF, repeat_kv
 
 
-def _block_scores(q, k, q_pos, kv_pos, scale, mask_value=NEG_INF):
+def _block_scores(q, k, q_pos, kv_pos, scale, mask_value=NEG_INF, kv_valid=None):
     """Masked attention scores for one block pair. q:[B,Sq,H,D] k:[B,Sk,H,D]."""
     s = jnp.einsum(
         "bqhd,bkhd->bhqk", q.astype(jnp.float32) * scale, k.astype(jnp.float32)
     )
     mask = q_pos[:, None, :, None] >= kv_pos[:, None, None, :]
+    if kv_valid is not None:
+        mask = mask & kv_valid[:, None, None, :]
     return jnp.where(mask, s, mask_value)
 
 
@@ -52,12 +54,23 @@ def ring_attention(
     q_positions: jnp.ndarray,
     kv_positions: jnp.ndarray,
     axis_name: str = "sp",
+    k_ctx: Optional[jnp.ndarray] = None,
+    v_ctx: Optional[jnp.ndarray] = None,
+    ctx_positions: Optional[jnp.ndarray] = None,
+    ctx_valid: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """Causal attention with K/V ring-rotated across `axis_name`.
 
     Call inside shard_map. Shapes per shard: q/k/v [B, S_local, H(kv), D],
     positions [B, S_local] (absolute). GQA handled via repeat. Returns
     attention output [B, S_local, H, D] in q.dtype.
+
+    The optional context block (k_ctx/v_ctx [B, C, Hkv, D], replicated on
+    every rank, masked by ctx_valid) is attended before the ring starts —
+    this is how the engine's chunked prefill composes: the chunk's own KV
+    rides the ring sequence-sharded, while the paged window written by
+    earlier chunks/turns is read locally (SURVEY §2.2 CP; BASELINE config
+    5's 32k prefill tier).
     """
     # GQA expansion happens per-block inside the loop: the ring rotates the
     # compact Hkv tensors and each device re-expands locally, so ppermute
@@ -71,9 +84,27 @@ def ring_attention(
     acc = jnp.zeros((B, H, Sq, D), jnp.float32)
     m = jnp.full((B, H, Sq, 1), -jnp.inf, jnp.float32)
     l = jnp.zeros((B, H, Sq, 1), jnp.float32)
-    # mark the accumulators as varying over the ring axis so the scan carry
-    # type matches its output (JAX >= 0.9 shard_map vma tracking)
-    acc, m, l = (lax.pcast(x, (axis_name,), to="varying") for x in (acc, m, l))
+
+    if k_ctx is not None:
+        # accumulators become ring-varying through q, no pcast needed
+        s = _block_scores(
+            q, repeat_kv(k_ctx, q.shape[2] // k_ctx.shape[2]),
+            q_positions, ctx_positions, scale, -jnp.inf, kv_valid=ctx_valid,
+        )
+        m = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        safe_m = jnp.where(jnp.isfinite(m), m, 0.0)
+        p = jnp.where(jnp.isfinite(s), jnp.exp(s - safe_m), 0.0)
+        l = jnp.sum(p, axis=-1, keepdims=True)
+        acc = jnp.einsum(
+            "bhqk,bkhd->bhqd", p,
+            repeat_kv(v_ctx, q.shape[2] // v_ctx.shape[2]).astype(jnp.float32),
+        )
+    else:
+        # mark the accumulators as varying over the ring axis so the scan
+        # carry type matches its output (JAX >= 0.9 shard_map vma tracking)
+        acc, m, l = (
+            lax.pcast(x, (axis_name,), to="varying") for x in (acc, m, l)
+        )
 
     def body(carry, _):
         k_blk, v_blk, kv_pos, acc, m, l = carry
@@ -125,6 +156,54 @@ def ring_attention_sharded(
         out_specs=spec_a,
     )
     return fn(q, k, v, q_positions, kv_positions)
+
+
+def ring_prefill_sharded(
+    mesh: Mesh,
+    q: jnp.ndarray,            # [B, S, Hq, D] — the chunk's queries
+    k_chunk: jnp.ndarray,      # [B, S, Hkv, D] — the chunk's fresh KV
+    v_chunk: jnp.ndarray,
+    q_positions: jnp.ndarray,  # [B, S] absolute
+    k_ctx: jnp.ndarray,        # [B, C, Hkv, D] — paged window (prior chunks)
+    v_ctx: jnp.ndarray,
+    ctx_positions: jnp.ndarray,  # [B, C]
+    ctx_valid: jnp.ndarray,      # [B, C] — True only for pre-chunk positions
+    axis_name: str = "sp",
+) -> jnp.ndarray:
+    """Chunked-prefill attention with the chunk ring-sharded over sp.
+
+    The chunk's q/kv are sequence-sharded across the sp axis and rotate in
+    a ring; the already-materialized paged context is read locally by every
+    sp rank.  Heads additionally stay sharded over the mesh's tp axis when
+    it divides them (the same rule as sharding.py's kv_pool_spec) — so on a
+    tp x sp mesh each device holds 1/(tp*sp) of the chunk and 1/tp of the
+    context window, which is the whole point of the composition for 32k
+    windows.  S must divide by the sp size (the engine guarantees this by
+    choosing prefill buckets divisible by sp).
+    """
+    tp = mesh.shape.get("tp", 1)
+    hq, hkv = q.shape[2], k_chunk.shape[2]
+    head_ax = "tp" if (tp > 1 and hkv % tp == 0 and hq % tp == 0) else None
+    spec_a = P(None, axis_name, head_ax, None)
+    spec_p = P(None, axis_name)
+    rep_a = P(None, None, head_ax, None)
+    rep_p = P(None, None)
+
+    def per_shard(q_, kc_, vc_, qp_, kx_, vx_, cp_, cv_):
+        return ring_attention(
+            q_, kc_, vc_, qp_, qp_, axis_name=axis_name,
+            k_ctx=kx_, v_ctx=vx_, ctx_positions=cp_, ctx_valid=cv_,
+        )
+
+    fn = jax.shard_map(
+        per_shard,
+        mesh=mesh,
+        in_specs=(spec_a, spec_a, spec_a, spec_p,
+                  rep_a, rep_a, rep_p, rep_p),
+        out_specs=spec_a,
+    )
+    return fn(q, k_chunk, v_chunk, q_positions,
+              k_ctx, v_ctx, ctx_positions, ctx_valid)
 
 
 def ulysses_attention(

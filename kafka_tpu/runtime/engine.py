@@ -196,8 +196,27 @@ class InferenceEngine:
         (all-reduce after row-parallel einsums, logit gather)."""
         self.ecfg = engine_cfg or EngineConfig()
         self.mesh = mesh
+        sp = mesh.shape.get("sp", 1) if mesh is not None else 1
+        if sp > 1:
+            bad = [b for b in self.ecfg.prefill_buckets if b % sp]
+            if bad:
+                raise ValueError(
+                    f"prefill buckets {bad} not divisible by sp={sp}: the "
+                    "ring shards each chunk across the sp axis"
+                )
+        if (
+            self.ecfg.attention_backend == "pallas"
+            and mesh is not None
+            and mesh.size > 1
+        ):
+            raise ValueError(
+                "attention_backend='pallas' cannot run on a multi-device "
+                "mesh: GSPMD cannot partition a Pallas custom call — use "
+                "'auto' or 'xla' with TP/SP meshes"
+            )
         self.cfg = cfg.replace(
-            attention_backend=self._resolve_backend(cfg, self.ecfg, mesh)
+            attention_backend=self._resolve_backend(cfg, self.ecfg, mesh),
+            prefill_ring=sp > 1,
         )
         ps = self.ecfg.page_size
         self.pool = PagePool(self.ecfg.num_pages, ps)
@@ -277,7 +296,7 @@ class InferenceEngine:
     def _build_decode_fn(self):
         cfg, ecfg = self.cfg, self.ecfg
         ps, C, B = ecfg.page_size, ecfg.max_window, ecfg.max_batch
-        cache_key = ("decode", cfg, ps, C, B)
+        cache_key = ("decode", cfg, ps, C, B, self.mesh)
         if cache_key in _FN_CACHE:
             return _FN_CACHE[cache_key]
 
@@ -319,9 +338,9 @@ class InferenceEngine:
     def _get_prefill_fn(self, bucket: int):
         if bucket in self._prefill_fns:
             return self._prefill_fns[bucket]
-        cfg, ecfg = self.cfg, self.ecfg
+        cfg, ecfg, mesh = self.cfg, self.ecfg, self.mesh
         ps, C, P = ecfg.page_size, ecfg.max_window, ecfg.max_pages_per_seq
-        cache_key = ("prefill", cfg, bucket, ps, C, P)
+        cache_key = ("prefill", cfg, bucket, ps, C, P, self.mesh)
         if cache_key in _FN_CACHE:
             self._prefill_fns[bucket] = _FN_CACHE[cache_key]
             return _FN_CACHE[cache_key]
@@ -341,11 +360,15 @@ class InferenceEngine:
             read_idx = (page_row[:, None] * ps + jnp.arange(ps)[None, :]).reshape(1, C)
             kv_positions = jnp.arange(C)[None, :]
             kv_valid = kv_positions < (start + chunk_len)
-            paged = PagedView(write_idx, read_idx, kv_positions, kv_valid)
+            paged = PagedView(
+                write_idx, read_idx, kv_positions, kv_valid,
+                page_table=page_row[None, :], page_size=ps,
+                start=start, chunk_len=chunk_len,
+            )
 
             logits, cache = forward(
                 params, cfg, chunk[None, :], positions,
-                kv_cache=KVCache(k_pool, v_pool), paged=paged,
+                kv_cache=KVCache(k_pool, v_pool), paged=paged, mesh=mesh,
             )
             last = jnp.clip(chunk_len - 1, 0, S - 1)
             final_logits = logits[0, last][None, :]  # [1, V]
