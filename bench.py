@@ -75,6 +75,10 @@ def main() -> None:
     t0 = time.monotonic()
     engine.generate(prompt(), max_new_tokens=4)
     print(f"# warmup/compile: {time.monotonic() - t0:.1f}s", file=sys.stderr)
+    # warmup included XLA compiles; reset so percentiles reflect serving
+    from kafka_tpu.runtime.metrics import EngineMetrics
+
+    engine.metrics = EngineMetrics()
 
     # ---- TTFT: prompt submit -> first token, solo requests ---------------
     ttfts = []
@@ -127,6 +131,10 @@ def main() -> None:
     wall = time.monotonic() - t0
     decode_tps = tokens / wall
 
+    # the same counters GET /metrics exports (runtime/metrics.py) — bench
+    # and the server report one source of truth
+    snap = engine.metrics.snapshot(engine)
+
     # Headline = BASELINE.json's first metric (tokens/sec/chip). The
     # reference publishes no numbers, so vs_baseline is the improvement over
     # this framework's own round-1 measurement (88.6 tok/s/chip,
@@ -140,9 +148,14 @@ def main() -> None:
         "extras": {
             "p50_ttft_ms": round(ttft_p50, 2),
             "p50_cache_hit_ttft_ms": round(cache_hit_ttft_p50, 2),
-            "prefix_cache_hits": engine.prefix_cache.hits,
-            "prefix_tokens_reused": engine.prefix_cache.tokens_reused,
             "ttft_vs_200ms_north_star": round(200.0 / ttft_p50, 3),
+            "metrics": {  # same counters the server's GET /metrics exports
+                "ttft_ms": snap["ttft_ms"],
+                "tpot_ms": snap["tpot_ms"],
+                "batch_occupancy": snap["decode"]["batch_occupancy"],
+                "generated_tokens": snap["tokens"]["generated"],
+                "prefix_cache": snap.get("prefix_cache"),
+            },
             "decode_batch": args.batch,
             "gen_len": args.gen_len,
             "ttft_all_ms": [round(t, 2) for t in ttfts],

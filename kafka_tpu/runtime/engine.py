@@ -71,6 +71,7 @@ from .kv_cache import (
     make_kv_pool_arrays,
     page_table_array,
 )
+from .metrics import EngineMetrics
 from .prefix_cache import PrefixCache
 
 logger = logging.getLogger("kafka_tpu.engine")
@@ -218,6 +219,20 @@ class InferenceEngine:
             attention_backend=self._resolve_backend(cfg, self.ecfg, mesh),
             prefill_ring=sp > 1,
         )
+        if self.cfg.attention_backend == "pallas":
+            # flash prefill tiles chunks into q_block=64 rows (ops/pallas/
+            # flash_prefill.py); catch the misconfiguration at construction
+            # rather than as an opaque trace-time error
+            bad = [
+                b for b in self.ecfg.prefill_buckets
+                if b > 64 and b % 64
+            ]
+            if bad:
+                raise ValueError(
+                    f"prefill buckets {bad} incompatible with the pallas "
+                    "flash-prefill kernel: buckets over 64 must be "
+                    "multiples of its 64-row q blocks"
+                )
         ps = self.ecfg.page_size
         self.pool = PagePool(self.ecfg.num_pages, ps)
         k_pool, v_pool = make_kv_pool_arrays(cfg, self.ecfg.num_pages, ps, kv_dtype)
@@ -260,6 +275,7 @@ class InferenceEngine:
             if self.ecfg.prefix_cache_entries > 0
             else None
         )
+        self.metrics = EngineMetrics()
 
     @staticmethod
     def _resolve_backend(cfg: ModelConfig, ecfg: EngineConfig, mesh) -> str:
@@ -409,6 +425,7 @@ class InferenceEngine:
             req.logits_mask_fn.set_budget(req.max_new_tokens)
         req.prefill_ids = list(req.prompt_ids)
         req.submit_time = time.monotonic()
+        self.metrics.record_submit(len(req.prompt_ids))
         req.state = WAITING
         self.waiting.append(req)
         self._requests[req.request_id] = req
@@ -432,6 +449,7 @@ class InferenceEngine:
                 pass
         req.state = FINISHED
         req.finish_reason = "cancelled"
+        self.metrics.record_finish("cancelled")
         if req.slot >= 0 or req.seq is not None:
             self._release_slot(req)
         self._requests.pop(request_id, None)
@@ -515,6 +533,10 @@ class InferenceEngine:
         req.output_ids.append(token)
         if req.first_token_time is None:
             req.first_token_time = time.monotonic()
+            self.metrics.record_first_token(
+                req.first_token_time - req.submit_time
+            )
+        self.metrics.record_token()
         if token in req.stop_token_ids:
             reason = "stop"
         elif final_reason is not None:
@@ -524,6 +546,7 @@ class InferenceEngine:
             return
         req.finish_reason = reason
         req.state = FINISHED
+        self.metrics.record_finish(reason)
         if (
             req.seq is not None
             and req.prefix_key is not None
@@ -747,6 +770,7 @@ class InferenceEngine:
         self._d_last = toks
         toks.copy_to_host_async()
         self._step_count += 1
+        self.metrics.record_decode_step(len(active_slots))
 
         items: List[Optional[GenRequest]] = []
         final: List[Optional[str]] = []
@@ -882,6 +906,7 @@ class InferenceEngine:
 
     def _preempt(self, victim: GenRequest) -> None:
         logger.warning("preempting %s (out of KV pages)", victim.request_id)
+        self.metrics.record_preempt()
         # Preemption needs complete outputs (prefill_ids below); the caller
         # (_ensure_pages) has already drained the pipeline.
         assert not self._pending, "preempt with in-flight fetches"

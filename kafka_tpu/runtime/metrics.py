@@ -1,0 +1,137 @@
+"""Serving metrics: the TTFT/TPOT/occupancy counters BASELINE measures.
+
+The reference's observability was print statements (SURVEY §5.1/5.5 — it
+even returned zeroed token usage on the agent path).  Here the engine
+records real counters as it schedules, the server exports them at
+GET /metrics, and bench.py reads the same numbers — one source of truth.
+
+Everything is designed for the single-writer engine thread: recording is
+plain attribute math (no locks on the hot path); `snapshot()` is called
+from other threads and reads are torn-tolerant (worst case a metric is one
+step stale).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import time
+from typing import Deque, Dict, List, Optional
+
+
+def _percentiles(samples: List[float], pts=(50, 90, 99)) -> Dict[str, float]:
+    if not samples:
+        return {f"p{p}": 0.0 for p in pts}
+    s = sorted(samples)
+    out = {}
+    for p in pts:
+        # nearest-rank: smallest value with at least p% of samples <= it
+        idx = min(len(s) - 1, max(0, -(-p * len(s) // 100) - 1))
+        out[f"p{p}"] = s[idx]
+    return out
+
+
+@dataclasses.dataclass
+class EngineMetrics:
+    """Counters owned by the engine; histograms keep the last N samples."""
+
+    window: int = 512  # samples kept per histogram
+
+    requests_submitted: int = 0
+    requests_finished: int = 0
+    requests_cancelled: int = 0
+    requests_preempted: int = 0
+    prompt_tokens: int = 0
+    generated_tokens: int = 0
+    decode_steps: int = 0
+    decode_busy_slots: int = 0  # sum over steps -> occupancy = /steps/B
+
+    def __post_init__(self) -> None:
+        self.ttft_ms: Deque[float] = collections.deque(maxlen=self.window)
+        self.tpot_ms: Deque[float] = collections.deque(maxlen=self.window)
+        self._last_step_t: Optional[float] = None
+        self._started = time.monotonic()
+
+    # -- engine-thread recording ----------------------------------------
+
+    def record_submit(self, prompt_tokens: int) -> None:
+        self.requests_submitted += 1
+        self.prompt_tokens += prompt_tokens
+
+    def record_first_token(self, latency_s: float) -> None:
+        self.ttft_ms.append(latency_s * 1e3)
+
+    def record_token(self) -> None:
+        self.generated_tokens += 1
+
+    def record_decode_step(self, busy_slots: int) -> None:
+        now = time.monotonic()
+        if self._last_step_t is not None:
+            # inter-step time while decoding == per-token latency for every
+            # active stream (the definition of TPOT under continuous
+            # batching); long gaps (idle engine) are not TPOT — drop them
+            dt = (now - self._last_step_t) * 1e3
+            if dt < 2_000:
+                self.tpot_ms.append(dt)
+        self._last_step_t = now
+        self.decode_steps += 1
+        self.decode_busy_slots += busy_slots
+
+    def record_finish(self, reason: Optional[str]) -> None:
+        if reason == "cancelled":
+            self.requests_cancelled += 1
+        else:
+            self.requests_finished += 1
+
+    def record_preempt(self) -> None:
+        self.requests_preempted += 1
+
+    # -- cross-thread export --------------------------------------------
+
+    def snapshot(self, engine=None) -> Dict[str, object]:
+        up = time.monotonic() - self._started
+        snap: Dict[str, object] = {
+            "uptime_s": round(up, 1),
+            "requests": {
+                "submitted": self.requests_submitted,
+                "finished": self.requests_finished,
+                "cancelled": self.requests_cancelled,
+                "preempted": self.requests_preempted,
+            },
+            "tokens": {
+                "prompt": self.prompt_tokens,
+                "generated": self.generated_tokens,
+                "generated_per_s": round(self.generated_tokens / up, 2)
+                if up > 0 else 0.0,
+            },
+            "ttft_ms": {k: round(v, 2) for k, v in
+                        _percentiles(list(self.ttft_ms)).items()},
+            "tpot_ms": {k: round(v, 2) for k, v in
+                        _percentiles(list(self.tpot_ms)).items()},
+            "decode": {
+                "steps": self.decode_steps,
+                "batch_occupancy": round(
+                    self.decode_busy_slots / self.decode_steps, 3
+                ) if self.decode_steps else 0.0,
+            },
+        }
+        if engine is not None:
+            snap["engine"] = {
+                "active": engine.num_active,
+                "waiting": len(engine.waiting),
+                "in_flight_fetches": len(engine._pending),
+                "pages_total": engine.pool.num_pages,
+                "pages_free": engine.pool.free_pages,
+                "pages_in_use": engine.pool.num_pages - 1
+                - engine.pool.free_pages,
+                "max_batch": engine.ecfg.max_batch,
+                "attention_backend": engine.cfg.attention_backend,
+            }
+            if engine.prefix_cache is not None:
+                snap["prefix_cache"] = {
+                    "entries": len(engine.prefix_cache),
+                    "hits": engine.prefix_cache.hits,
+                    "misses": engine.prefix_cache.misses,
+                    "tokens_reused": engine.prefix_cache.tokens_reused,
+                }
+        return snap

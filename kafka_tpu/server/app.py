@@ -204,6 +204,8 @@ def _add_routes(app: web.Application) -> None:
     r.add_put("/v1/threads/{thread_id}/config", set_thread_config)
     r.add_get("/v1/models", list_models)
     r.add_get("/health", health)
+    r.add_get("/metrics", metrics)
+    r.add_post("/debug/profile", capture_profile)
     # OPTIONS preflight is answered by cors_middleware before routing
 
 
@@ -460,6 +462,71 @@ async def health(request: web.Request) -> web.Response:
             "total_pages": engine.pool.num_pages,
         }
     return web.json_response(payload)
+
+
+async def metrics(request: web.Request) -> web.Response:
+    """Serving counters (SURVEY §5.1/5.5): TTFT/TPOT percentiles, token
+    throughput, batch occupancy, pages in use, prefix-cache reuse.  These
+    are the numbers bench.py reports — one source of truth."""
+    llm = _state(request)["llm"]
+    engine = getattr(llm, "engine", None)
+    if engine is None:
+        return web.json_response({"error": "no local engine"}, status=404)
+    return web.json_response(engine.metrics.snapshot(engine))
+
+
+_PROFILE_BUSY = False
+_PROFILE_DIR = "/tmp/kafka_tpu_trace"
+
+
+async def capture_profile(request: web.Request) -> web.Response:
+    """Capture a jax.profiler device trace (xplane) for offline analysis.
+
+    Body: {"seconds": 2}.  The trace (written under /tmp/kafka_tpu_trace —
+    server-chosen, not client-chosen) covers whatever the engine executes
+    during the window — point a load at the server first.  Gated behind
+    KAFKA_TPU_PROFILING=1 (trace files can contain workload detail); one
+    capture at a time."""
+    import os
+
+    if os.environ.get("KAFKA_TPU_PROFILING", "0") not in ("1", "true"):
+        return web.json_response(
+            {"error": "profiling disabled (set KAFKA_TPU_PROFILING=1)"},
+            status=403,
+        )
+    global _PROFILE_BUSY
+    if _PROFILE_BUSY:
+        return web.json_response(
+            {"error": "a profile capture is already running"}, status=409
+        )
+    import asyncio
+
+    import jax
+
+    try:
+        body = await request.json()
+    except Exception:
+        body = {}
+    try:
+        seconds = float(body.get("seconds", 2.0))
+    except (TypeError, ValueError):
+        return web.json_response(
+            {"error": "'seconds' must be a number"}, status=400
+        )
+    if not (0.1 <= seconds <= 30.0):
+        return web.json_response(
+            {"error": "'seconds' must be in [0.1, 30]"}, status=400
+        )
+    _PROFILE_BUSY = True
+    try:
+        jax.profiler.start_trace(_PROFILE_DIR)
+        try:
+            await asyncio.sleep(seconds)
+        finally:
+            jax.profiler.stop_trace()
+    finally:
+        _PROFILE_BUSY = False
+    return web.json_response({"trace_dir": _PROFILE_DIR, "seconds": seconds})
 
 
 def run_server(cfg: Optional[ServingConfig] = None) -> None:
