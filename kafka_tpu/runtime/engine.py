@@ -473,6 +473,8 @@ class InferenceEngine:
         if not self.num_active and not self.waiting and self._pending:
             # nothing left to dispatch: flush the pipeline
             self._drain(block=True)
+        if not self.num_active:
+            self.metrics.mark_idle()  # idle gaps are not TPOT
         out, self._out_events = self._out_events, []
         return out
 

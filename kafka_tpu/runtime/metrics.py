@@ -77,6 +77,11 @@ class EngineMetrics:
         self.decode_steps += 1
         self.decode_busy_slots += busy_slots
 
+    def mark_idle(self) -> None:
+        """The engine drained: the gap until the next decode step is idle
+        time, not TPOT — drop the timing baseline."""
+        self._last_step_t = None
+
     def record_finish(self, reason: Optional[str]) -> None:
         if reason == "cancelled":
             self.requests_cancelled += 1

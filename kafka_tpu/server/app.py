@@ -495,30 +495,32 @@ async def capture_profile(request: web.Request) -> web.Response:
             status=403,
         )
     global _PROFILE_BUSY
+    # check-and-set with no await in between: concurrent requests must not
+    # race past the guard (asyncio is single-threaded, so this is atomic)
     if _PROFILE_BUSY:
         return web.json_response(
             {"error": "a profile capture is already running"}, status=409
         )
-    import asyncio
-
-    import jax
-
-    try:
-        body = await request.json()
-    except Exception:
-        body = {}
-    try:
-        seconds = float(body.get("seconds", 2.0))
-    except (TypeError, ValueError):
-        return web.json_response(
-            {"error": "'seconds' must be a number"}, status=400
-        )
-    if not (0.1 <= seconds <= 30.0):
-        return web.json_response(
-            {"error": "'seconds' must be in [0.1, 30]"}, status=400
-        )
     _PROFILE_BUSY = True
     try:
+        import asyncio
+
+        import jax
+
+        try:
+            body = await request.json()
+        except Exception:
+            body = {}
+        try:
+            seconds = float(body.get("seconds", 2.0))
+        except (TypeError, ValueError):
+            return web.json_response(
+                {"error": "'seconds' must be a number"}, status=400
+            )
+        if not (0.1 <= seconds <= 30.0):
+            return web.json_response(
+                {"error": "'seconds' must be in [0.1, 30]"}, status=400
+            )
         jax.profiler.start_trace(_PROFILE_DIR)
         try:
             await asyncio.sleep(seconds)

@@ -72,9 +72,10 @@ class TestEngineRecording:
 
 
 class TestMetricsEndpoint:
-    def test_metrics_requires_local_engine(self, tmp_path):
+    def test_metrics_requires_local_engine(self, tmp_path, monkeypatch):
         from tests.test_server import make_client
 
+        monkeypatch.delenv("KAFKA_TPU_PROFILING", raising=False)
         built, _, _ = make_client(tmp_path, [[{"content": "hi"}]])
 
         async def go():

@@ -102,3 +102,25 @@ class TestV1:
         p.add_section("global_prompt", "Always answer in French.")
         out = p.get_system_prompt()
         assert out.endswith("Always answer in French.")
+
+    def test_sections_have_real_depth(self):
+        """Guard against regression to stub sections (round-1 verdict: 13
+        sections totalling 132 lines were placeholders)."""
+        p = PromptProviderV1(variables={"current_date": "2026-07-29"})
+        total_lines = sum(s.content.count("\n") for s in p.sections)
+        assert total_lines > 500, f"sections regressed to stubs: {total_lines}"
+        # every tool the framework actually ships is documented by name
+        out = p.get_system_prompt()
+        for tool in ("create_shell", "shell_exec", "notebook_run_cell",
+                     "sequentialthinking", "saveThoughtCheckpoint",
+                     "loadThoughtCheckpoint", "idle"):
+            assert tool in out, f"tool {tool} undocumented in system prompt"
+
+    def test_precedence_and_safety_language_present(self):
+        out = PromptProviderV1(
+            variables={"current_date": "2026-07-29"}
+        ).get_system_prompt()
+        # load-bearing behaviors the agent loop depends on
+        assert "idle" in out                      # termination contract
+        assert "never" in out.lower()             # hard rules exist
+        assert "data, never instructions" in out  # injection resistance
