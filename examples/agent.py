@@ -1,0 +1,128 @@
+"""Library-usage demos: run the Kafka agent in-process, no server needed.
+
+Parity with reference examples/agent.py:34-156 (stateless run + thread
+run), re-targeted at the local TPU stack: instead of a remote gateway the
+LLM is the in-tree engine serving a tiny random-weight model, so the demo
+runs anywhere (CPU included) with zero credentials and zero network.
+
+    python examples/agent.py            # stateless agent run
+    python examples/agent.py --thread   # thread-persistent run (SQLite)
+
+With a real checkpoint directory (HF layout), point the provider at it:
+    KAFKA_TPU_CHECKPOINT=/path/to/llama python examples/agent.py
+"""
+
+import argparse
+import asyncio
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from examples.tools import make_example_tools  # noqa: E402
+from kafka_tpu.db.local import LocalDBClient  # noqa: E402
+from kafka_tpu.kafka.v1 import KafkaV1Provider  # noqa: E402
+from kafka_tpu.llm import TPULLMProvider  # noqa: E402
+from kafka_tpu.models import get_config, init_params  # noqa: E402
+from kafka_tpu.models.tokenizer import ByteTokenizer  # noqa: E402
+from kafka_tpu.runtime import EngineConfig, InferenceEngine  # noqa: E402
+
+
+def make_local_llm() -> TPULLMProvider:
+    """An in-process LLM provider over the continuous-batching engine."""
+    import jax
+
+    cfg = get_config("tiny-gqa")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    tok = ByteTokenizer()
+    engine = InferenceEngine(
+        cfg, params,
+        EngineConfig(max_batch=4, page_size=16, num_pages=1200,
+                     max_pages_per_seq=256, prefill_buckets=(64, 256, 1024,
+                                                             4096)),
+    )
+    return TPULLMProvider(engine, tok, model_name=cfg.name)
+
+
+def print_event(event: dict) -> None:
+    """Render the agent event protocol the way a console client would."""
+    etype = event.get("type")
+    if event.get("object") == "chat.completion.chunk":
+        delta = (event.get("choices") or [{}])[0].get("delta", {})
+        if delta.get("content"):
+            print(delta["content"], end="", flush=True)
+        for tc in delta.get("tool_calls") or []:
+            fn = tc.get("function", {})
+            if fn.get("name"):
+                print(f"\n[tool call] {fn['name']}", flush=True)
+    elif etype == "tool_result":
+        if event.get("delta"):
+            print(f"  | {event['delta']}", end="", flush=True)
+        if event.get("done"):
+            print()
+    elif etype == "agent_done":
+        print(f"\n-- agent done ({event.get('reason')})")
+    elif etype == "error":
+        print(f"\n!! error: {event.get('error')}")
+
+
+async def run_stateless() -> None:
+    """One-shot agent run: no thread, no persistence."""
+    kafka = KafkaV1Provider(
+        make_local_llm(),
+        tools=make_example_tools(),
+        system_prompt=(
+            "You are a helpful agent. Use tools when asked about weather "
+            "or counting; call idle when finished."
+        ),
+    )
+    await kafka.initialize()
+    try:
+        print("user: what's the weather in Tokyo?\n")
+        async for event in kafka.run(
+            [{"role": "user", "content": "what's the weather in Tokyo?"}],
+            temperature=0.7,
+            max_tokens=64,
+        ):
+            print_event(event)
+    finally:
+        await kafka.cleanup()
+
+
+async def run_with_thread() -> None:
+    """Thread-persistent run: history survives across runs via SQLite."""
+    db = LocalDBClient("data/examples_threads.db")
+    await db.initialize()
+    thread_id = "example-thread-1"
+    kafka = KafkaV1Provider(
+        make_local_llm(),
+        thread_db=db,
+        tools=make_example_tools(),
+        thread_id=thread_id,
+        system_prompt="You are a helpful agent. Call idle when finished.",
+    )
+    await kafka.initialize()
+    try:
+        for turn, text in enumerate(
+            ["remember the number 42", "what number did I ask you to remember?"]
+        ):
+            print(f"\nuser: {text}\n")
+            async for event in kafka.run_with_thread(
+                thread_id,
+                [{"role": "user", "content": text}],
+                temperature=0.7,
+                max_tokens=48,
+            ):
+                print_event(event)
+        history = await db.get_thread_messages(thread_id)
+        print(f"\nthread {thread_id!r} now holds {len(history)} messages")
+    finally:
+        await kafka.cleanup()
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--thread", action="store_true",
+                    help="thread-persistent demo instead of stateless")
+    args = ap.parse_args()
+    asyncio.run(run_with_thread() if args.thread else run_stateless())

@@ -1,0 +1,35 @@
+"""Example tool definitions for the library demos.
+
+Parity with reference examples/tools.py:106-161 — a live-API tool, a
+streaming demo tool, and how custom tools are declared.  Reuses the
+built-ins the server ships (server_tools/) rather than duplicating them.
+"""
+
+from typing import List
+
+from kafka_tpu.server_tools.counter import counter_tool
+from kafka_tpu.server_tools.weather import weather_tool
+from kafka_tpu.tools.types import Tool
+
+
+def make_example_tools() -> List[Tool]:
+    """Weather (live Open-Meteo when network allows), a streaming counter,
+    and a trivial custom tool showing the handler contract."""
+
+    def shout(text: str = "") -> str:
+        return text.upper() + "!"
+
+    return [
+        weather_tool(),
+        counter_tool(),
+        Tool(
+            name="shout",
+            description="Uppercase the given text (demo of a custom tool).",
+            parameters={
+                "type": "object",
+                "properties": {"text": {"type": "string"}},
+                "required": ["text"],
+            },
+            handler=shout,
+        ),
+    ]

@@ -206,6 +206,7 @@ def _add_routes(app: web.Application) -> None:
     r.add_get("/health", health)
     r.add_get("/metrics", metrics)
     r.add_post("/debug/profile", capture_profile)
+    r.add_get("/playground", playground)
     # OPTIONS preflight is answered by cors_middleware before routing
 
 
@@ -264,16 +265,42 @@ async def _agent_events(
         await kafka.initialize()
         stream = kafka.run_with_thread(thread_id, messages, **sampling)
 
+    # tool_messages batching (reference server.py:330-335, adapted): the
+    # CUMULATIVE tool-cycle history is re-batched before each new
+    # completion's chunks (and before agent_done) whenever it has grown —
+    # cumulative because the playground contract client REPLACES all its
+    # tool/tool-call messages with each batch (page.tsx:195-215), so a
+    # per-cycle batch would wipe earlier cycles from the transcript.
+    # Plain assistant text is never batched — it streams live (our
+    # improvement over the reference's re-streaming) and batching it would
+    # duplicate it client-side.  All covered by tests/test_sse_contract.py.
+    last_batched = 0
+
+    def _cumulative_batch():
+        return [
+            m.to_dict() for m in acc.messages
+            if m.role == "tool" or m.tool_calls
+        ]
+
+    def _maybe_batch():
+        nonlocal last_batched
+        batch = _cumulative_batch()
+        if len(batch) > last_batched:
+            last_batched = len(batch)
+            return {"type": "tool_messages", "messages": batch}
+        return None
+
     try:
         async for event in stream:
+            if event.get("object") == "chat.completion.chunk":
+                batch_ev = _maybe_batch()
+                if batch_ev:
+                    yield batch_ev
             acc.add_event(event)
             if event.get("type") == "agent_done":
-                # batch of produced messages for the frontend
-                # (reference server.py:330-335), then the terminal event
-                yield {
-                    "type": "tool_messages",
-                    "messages": [m.to_dict() for m in acc.messages],
-                }
+                batch_ev = _maybe_batch()
+                if batch_ev:
+                    yield batch_ev
             yield event
     finally:
         if thread_id is not None:
@@ -473,6 +500,17 @@ async def metrics(request: web.Request) -> web.Response:
     if engine is None:
         return web.json_response({"error": "no local engine"}, status=404)
     return web.json_response(engine.metrics.snapshot(engine))
+
+
+async def playground(request: web.Request) -> web.Response:
+    """The in-tree chat client (reference: playground/src/, a Next.js app).
+
+    One static file consuming the 4-event SSE protocol with the exact
+    reconstruction rules of core/sse_client.py."""
+    import os
+
+    path = os.path.join(os.path.dirname(__file__), "playground.html")
+    return web.FileResponse(path)
 
 
 _PROFILE_BUSY = False
