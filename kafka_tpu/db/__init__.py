@@ -1,7 +1,27 @@
-"""Persistence tier: thread/message store (SQLite; Supabase-compatible
-duck type per db/base.py)."""
+"""Persistence tier: thread/message store.
+
+Two clients behind one duck type (db/base.py), mirroring the reference's
+SQLite-dev / Supabase-prod split (src/db/local.py, src/db/supabase.py):
+`LocalDBClient` over SQLite and `RemoteDBClient` over any PostgREST/
+Supabase-dialect deployment.  `make_db_client()` picks by environment.
+"""
+
+import os
+from typing import Optional
 
 from .base import DBClient
 from .local import LocalDBClient
+from .remote import RemoteDBClient
 
-__all__ = ["DBClient", "LocalDBClient"]
+
+def make_db_client(db_path: Optional[str] = None) -> DBClient:
+    """Remote when KAFKA_TPU_REMOTE_DB_URL is set, local SQLite otherwise."""
+    url = os.environ.get("KAFKA_TPU_REMOTE_DB_URL")
+    if url:
+        return RemoteDBClient(
+            url, api_key=os.environ.get("KAFKA_TPU_REMOTE_DB_KEY", "")
+        )
+    return LocalDBClient(db_path)
+
+
+__all__ = ["DBClient", "LocalDBClient", "RemoteDBClient", "make_db_client"]

@@ -1,0 +1,370 @@
+"""Remote thread store: a PostgREST/Supabase-dialect HTTP client.
+
+The reference ships two DB clients: SQLite for dev and a Supabase client
+for production multi-tenant deployments (src/db/supabase.py:41-707).  This
+is the TPU build's remote half — the same duck-type as db/base.py over any
+PostgREST-speaking deployment (Supabase included), with the reference's
+semantics:
+
+* threads / messages CRUD with JSON message payloads in `oai_messages`
+  (reference :67, :202-234), including multi-part content flattening on
+  read (:154-164);
+* the thread-config join across threads → kafka_profiles → profiles →
+  vm_api_keys yielding per-provider virtual keys, global_prompt,
+  memory_dsn and the sandbox claim key (:458-541) — expressed as explicit
+  follow-up queries rather than PostgREST resource embedding, so any
+  plain PostgREST deployment works without FK-naming coupling;
+* VM API key get-or-create: reuse the active key for a thread, otherwise
+  mint one through the `generate_vm_api_key` RPC with a local-uuid
+  fallback (:543-679);
+* playbooks fetch by kafka profile (:681-707).
+
+Auth follows the Supabase convention: `apikey` + `Authorization: Bearer`
+headers carry the service key.  Configure with
+KAFKA_TPU_REMOTE_DB_URL / KAFKA_TPU_REMOTE_DB_KEY (db.make_db_client()
+picks this client up automatically when the URL is set).
+"""
+
+from __future__ import annotations
+
+import datetime
+import logging
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Sequence
+
+import httpx
+
+from .base import DBClient
+
+logger = logging.getLogger("kafka_tpu.db.remote")
+
+
+def _now_iso() -> str:
+    """timestamptz-compatible UTC timestamp (Supabase schema convention;
+    epoch floats would be rejected by PostgREST for timestamp columns)."""
+    return datetime.datetime.now(datetime.timezone.utc).isoformat()
+
+
+def _flatten_content(content: Any) -> Any:
+    """Multi-part message content → text (reference supabase.py:154-164)."""
+    if isinstance(content, list):
+        parts = []
+        for part in content:
+            if isinstance(part, dict) and part.get("type") == "text":
+                parts.append(part.get("text") or "")
+            elif isinstance(part, str):
+                parts.append(part)
+        return "".join(parts)
+    return content
+
+
+class RemoteDBClient(DBClient):
+    """DBClient over a PostgREST endpoint (Supabase-compatible)."""
+
+    def __init__(
+        self,
+        base_url: str,
+        api_key: str = "",
+        *,
+        threads_table: str = "threads",
+        messages_table: str = "oai_messages",
+        timeout: float = 15.0,
+    ):
+        self.base_url = base_url.rstrip("/")
+        self.api_key = api_key
+        self.threads_table = threads_table
+        self.messages_table = messages_table
+        headers = {"Content-Type": "application/json"}
+        if api_key:
+            headers["apikey"] = api_key
+            headers["Authorization"] = f"Bearer {api_key}"
+        self._client = httpx.AsyncClient(
+            base_url=self.base_url, headers=headers, timeout=timeout
+        )
+
+    # -- REST helpers ----------------------------------------------------
+
+    def _table(self, name: str) -> str:
+        return f"/rest/v1/{name}"
+
+    async def _select(
+        self, table: str, filters: Dict[str, Any], select: str = "*",
+        order: Optional[str] = None, limit: Optional[int] = None,
+    ) -> List[Dict[str, Any]]:
+        params: Dict[str, str] = {"select": select}
+        for col, val in filters.items():
+            params[col] = f"eq.{val}"
+        if order:
+            params["order"] = order
+        if limit is not None:
+            params["limit"] = str(limit)
+        r = await self._client.get(self._table(table), params=params)
+        r.raise_for_status()
+        return r.json()
+
+    async def _insert(
+        self, table: str, rows: Sequence[Dict[str, Any]]
+    ) -> List[Dict[str, Any]]:
+        r = await self._client.post(
+            self._table(table),
+            json=list(rows),
+            headers={"Prefer": "return=representation"},
+        )
+        r.raise_for_status()
+        try:
+            return r.json()
+        except ValueError:
+            return []
+
+    async def _update(
+        self, table: str, filters: Dict[str, Any], values: Dict[str, Any]
+    ) -> None:
+        params = {col: f"eq.{val}" for col, val in filters.items()}
+        r = await self._client.patch(
+            self._table(table), params=params, json=values
+        )
+        r.raise_for_status()
+
+    async def _delete(self, table: str, filters: Dict[str, Any]) -> None:
+        params = {col: f"eq.{val}" for col, val in filters.items()}
+        r = await self._client.delete(self._table(table), params=params)
+        r.raise_for_status()
+
+    async def _rpc(self, fn: str, args: Dict[str, Any]) -> Any:
+        r = await self._client.post(f"/rest/v1/rpc/{fn}", json=args)
+        r.raise_for_status()
+        try:
+            return r.json()
+        except ValueError:
+            return None
+
+    # -- lifecycle -------------------------------------------------------
+
+    async def initialize(self) -> None:  # schema is owned by the deployment
+        return None
+
+    async def close(self) -> None:
+        await self._client.aclose()
+
+    # -- threads ---------------------------------------------------------
+
+    async def create_thread(
+        self,
+        thread_id: Optional[str] = None,
+        metadata: Optional[Dict[str, Any]] = None,
+    ) -> str:
+        tid = thread_id or f"thread_{uuid.uuid4().hex[:24]}"
+        if await self.thread_exists(tid):
+            return tid
+        now = _now_iso()
+        try:
+            await self._insert(self.threads_table, [{
+                "id": tid,
+                "metadata": metadata or {},
+                "created_at": now,
+                "updated_at": now,
+            }])
+        except httpx.HTTPStatusError as e:
+            # concurrent duplicate create: unique-key conflict is success
+            # (idempotency the duck type promises, matching LocalDBClient)
+            if e.response.status_code != 409:
+                raise
+        return tid
+
+    @staticmethod
+    def _thread_row(row: Dict[str, Any]) -> Dict[str, Any]:
+        return {
+            "thread_id": row.get("id"),
+            "created_at": row.get("created_at"),
+            "updated_at": row.get("updated_at"),
+            "metadata": row.get("metadata") or {},
+            "sandbox_id": row.get("sandbox_id"),
+        }
+
+    async def thread_exists(self, thread_id: str) -> bool:
+        return bool(
+            await self._select(self.threads_table, {"id": thread_id},
+                               select="id", limit=1)
+        )
+
+    async def get_thread_metadata(
+        self, thread_id: str
+    ) -> Optional[Dict[str, Any]]:
+        rows = await self._select(
+            self.threads_table, {"id": thread_id}, limit=1
+        )
+        return self._thread_row(rows[0]) if rows else None
+
+    async def list_threads(self) -> List[Dict[str, Any]]:
+        rows = await self._select(
+            self.threads_table, {}, order="updated_at.desc"
+        )
+        return [self._thread_row(r) for r in rows]
+
+    async def delete_thread(self, thread_id: str) -> None:
+        await self._delete(self.messages_table, {"thread_id": thread_id})
+        await self._delete(self.threads_table, {"id": thread_id})
+
+    # -- messages --------------------------------------------------------
+
+    async def get_thread_messages(self, thread_id: str) -> List[Dict[str, Any]]:
+        rows = await self._select(
+            self.messages_table, {"thread_id": thread_id},
+            select="message", order="seq.asc",
+        )
+        out = []
+        for r in rows:
+            msg = dict(r.get("message") or {})
+            if "content" in msg:
+                msg["content"] = _flatten_content(msg["content"])
+            out.append(msg)
+        return out
+
+    async def add_message(self, thread_id: str, message: Dict[str, Any]) -> None:
+        await self.add_messages(thread_id, [message])
+
+    async def add_messages(
+        self, thread_id: str, messages: Sequence[Dict[str, Any]]
+    ) -> None:
+        if not messages:
+            return
+        base = int(time.time() * 1e6)
+        now = _now_iso()
+        rows = [
+            {"thread_id": thread_id, "message": dict(m),
+             "seq": base + i, "created_at": now}
+            for i, m in enumerate(messages)
+        ]
+        await self._insert(self.messages_table, rows)
+        await self._update(
+            self.threads_table, {"id": thread_id}, {"updated_at": now}
+        )
+
+    async def delete_thread_messages(self, thread_id: str) -> None:
+        await self._delete(self.messages_table, {"thread_id": thread_id})
+
+    # -- sandbox binding -------------------------------------------------
+
+    async def get_thread_sandbox_id(self, thread_id: str) -> Optional[str]:
+        rows = await self._select(
+            self.threads_table, {"id": thread_id},
+            select="sandbox_id", limit=1,
+        )
+        return rows[0].get("sandbox_id") if rows else None
+
+    async def update_thread_sandbox_id(
+        self, thread_id: str, sandbox_id: Optional[str]
+    ) -> None:
+        await self._update(
+            self.threads_table, {"id": thread_id}, {"sandbox_id": sandbox_id}
+        )
+
+    # -- multi-tenant config (reference supabase.py:458-541) -------------
+
+    async def get_thread_config(
+        self, thread_id: str
+    ) -> Optional[Dict[str, Any]]:
+        rows = await self._select(
+            self.threads_table, {"id": thread_id}, limit=1
+        )
+        if not rows:
+            return None
+        thread = rows[0]
+        kp_id = thread.get("kafka_profile_id")
+        vm_key_id = thread.get("vm_api_key_id")
+
+        kafka_profile: Dict[str, Any] = {}
+        if kp_id:
+            kp = await self._select(
+                "kafka_profiles", {"id": kp_id}, limit=1
+            )
+            kafka_profile = kp[0] if kp else {}
+
+        profile: Dict[str, Any] = {}
+        kp_user = kafka_profile.get("user_id")
+        if kp_user:
+            pr = await self._select("profiles", {"id": kp_user}, limit=1)
+            profile = pr[0] if pr else {}
+
+        vm_api_key = None
+        if vm_key_id:
+            vk = await self._select(
+                "vm_api_keys", {"id": vm_key_id}, select="api_key", limit=1
+            )
+            vm_api_key = vk[0].get("api_key") if vk else None
+
+        playbooks = await self.get_playbooks(kp_id) if kp_id else []
+
+        return {
+            "thread_id": thread.get("id"),
+            "user_id": thread.get("user_id"),
+            "kafka_profile_id": kp_id,
+            "memory_dsn": kafka_profile.get("memory_dsn"),
+            "global_prompt": kafka_profile.get("global_prompt"),
+            "model": kafka_profile.get("model"),
+            "vm_api_key": vm_api_key,
+            "playbooks": playbooks,
+        }
+
+    async def set_thread_config(
+        self, thread_id: str, config: Dict[str, Any]
+    ) -> None:
+        allowed = {
+            k: v for k, v in config.items()
+            if k in ("kafka_profile_id", "vm_api_key_id", "user_id")
+        }
+        if allowed:
+            await self._update(self.threads_table, {"id": thread_id}, allowed)
+
+    async def get_playbooks(
+        self, kafka_profile_id: str
+    ) -> List[Dict[str, Any]]:
+        """Playbooks attached to a kafka profile (reference :681-707)."""
+        try:
+            return await self._select(
+                "playbooks", {"kafka_profile_id": kafka_profile_id},
+                order="created_at.asc",
+            )
+        except httpx.HTTPStatusError:
+            return []  # deployments without the table
+
+    # -- VM API keys (reference supabase.py:543-679) ---------------------
+
+    async def get_or_create_vm_api_key(self, thread_id: str) -> str:
+        rows = await self._select(
+            "vm_api_keys",
+            {"thread_id": thread_id, "status": "active"},
+            limit=1,
+        )
+        if rows:
+            key = rows[0].get("api_key")
+            if key:
+                return key
+        # mint through the deployment's keygen RPC; fall back to a local
+        # uuid key (dev parity with the reference's fallback)
+        try:
+            key = await self._rpc(
+                "generate_vm_api_key", {"p_thread_id": thread_id}
+            )
+            if isinstance(key, dict):
+                key = key.get("api_key")
+            if key:
+                await self._insert("vm_api_keys", [{
+                    "id": str(uuid.uuid4()), "thread_id": thread_id,
+                    "api_key": key, "status": "active",
+                    "created_at": _now_iso(),
+                }])
+                return str(key)
+        except httpx.HTTPError as e:
+            logger.warning("vm key RPC failed (%s); using local key", e)
+        key = f"vm_{uuid.uuid4()}"
+        try:
+            await self._insert("vm_api_keys", [{
+                "id": str(uuid.uuid4()), "thread_id": thread_id,
+                "api_key": key, "status": "active",
+                "created_at": _now_iso(),
+            }])
+        except httpx.HTTPError:
+            pass  # key still usable for this process
+        return key

@@ -11,6 +11,7 @@ from .lazy import LazySandbox
 from .local import LocalSandbox
 from .manager import SandboxFactory, SandboxManager
 from .process import ProcessSandboxFactory
+from .remote import RemoteSandboxFactory
 from .tools import (
     SandboxTool,
     notebook_tools,
@@ -25,6 +26,7 @@ __all__ = [
     "LazySandbox",
     "LocalSandbox",
     "ProcessSandboxFactory",
+    "RemoteSandboxFactory",
     "ProcessWarmPool",
     "Sandbox",
     "SandboxConfig",

@@ -39,7 +39,7 @@ from ..core.types import (
     new_completion_id,
 )
 from ..core.wire import AgentRunRequest, ChatCompletionRequest
-from ..db import DBClient, LocalDBClient
+from ..db import DBClient, LocalDBClient, make_db_client
 from ..kafka import KafkaV1Provider, MessageAccumulator
 from ..llm.base import LLMProvider
 from ..tools import MCPServerConfig, Tool
@@ -121,7 +121,8 @@ async def create_app(
     if llm_provider is None:
         llm_provider = build_tpu_provider(cfg)
     if db is None:
-        db = LocalDBClient(cfg.db_path)
+        # remote (PostgREST/Supabase) when KAFKA_TPU_REMOTE_DB_URL is set
+        db = make_db_client(cfg.db_path)
     await db.initialize()
     if tools is None:
         try:
@@ -290,12 +291,18 @@ async def _agent_events(
             return {"type": "tool_messages", "messages": batch}
         return None
 
+    last_cid = None
     try:
         async for event in stream:
             if event.get("object") == "chat.completion.chunk":
-                batch_ev = _maybe_batch()
-                if batch_ev:
-                    yield batch_ev
+                # the batch can only grow between completions: check on the
+                # first chunk of each new completion, not per token
+                cid = event.get("id")
+                if cid != last_cid:
+                    last_cid = cid
+                    batch_ev = _maybe_batch()
+                    if batch_ev:
+                        yield batch_ev
             acc.add_event(event)
             if event.get("type") == "agent_done":
                 batch_ev = _maybe_batch()

@@ -1,0 +1,269 @@
+"""RemoteDBClient vs a stub PostgREST server (reference Supabase parity).
+
+The stub implements the PostgREST subset the client speaks — eq filters,
+select projection, order/limit, insert-with-representation, patch, delete,
+rpc — over in-memory tables, so every client behavior is exercised against
+real HTTP semantics.
+"""
+
+import asyncio
+
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from kafka_tpu.db.remote import RemoteDBClient, _flatten_content
+
+
+class StubPostgrest:
+    """Minimal PostgREST over in-memory lists of dicts."""
+
+    def __init__(self):
+        self.tables = {
+            "threads": [], "oai_messages": [], "kafka_profiles": [],
+            "profiles": [], "vm_api_keys": [], "playbooks": [],
+        }
+        self.rpc_calls = []
+        self.fail_rpc = False
+
+    def _filtered(self, table, query):
+        rows = list(self.tables[table])
+        for col, val in query.items():
+            if col in ("select", "order", "limit"):
+                continue
+            if val.startswith("eq."):
+                want = val[3:]
+                rows = [r for r in rows if str(r.get(col)) == want]
+        if "order" in query:
+            col, _, direction = query["order"].partition(".")
+            rows.sort(key=lambda r: r.get(col) or 0,
+                      reverse=direction == "desc")
+        if "limit" in query:
+            rows = rows[: int(query["limit"])]
+        return rows
+
+    def app(self) -> web.Application:
+        app = web.Application()
+
+        async def table_get(request):
+            table = request.match_info["table"]
+            rows = self._filtered(table, dict(request.query))
+            select = request.query.get("select", "*")
+            if select != "*":
+                cols = [c.strip() for c in select.split(",")]
+                rows = [{c: r.get(c) for c in cols} for r in rows]
+            return web.json_response(rows)
+
+        async def table_post(request):
+            table = request.match_info["table"]
+            body = await request.json()
+            rows = body if isinstance(body, list) else [body]
+            self.tables[table].extend(rows)
+            return web.json_response(rows, status=201)
+
+        async def table_patch(request):
+            table = request.match_info["table"]
+            values = await request.json()
+            for row in self._filtered(table, dict(request.query)):
+                row.update(values)
+            return web.json_response([])
+
+        async def table_delete(request):
+            table = request.match_info["table"]
+            doomed = self._filtered(table, dict(request.query))
+            self.tables[table] = [
+                r for r in self.tables[table] if r not in doomed
+            ]
+            return web.json_response([])
+
+        async def rpc(request):
+            fn = request.match_info["fn"]
+            args = await request.json()
+            self.rpc_calls.append((fn, args))
+            if self.fail_rpc:
+                return web.json_response({"error": "boom"}, status=500)
+            if fn == "generate_vm_api_key":
+                return web.json_response(
+                    f"vm_rpc_{args.get('p_thread_id')}"
+                )
+            return web.json_response(None)
+
+        app.router.add_get("/rest/v1/{table}", table_get)
+        app.router.add_post("/rest/v1/rpc/{fn}", rpc)
+        app.router.add_post("/rest/v1/{table}", table_post)
+        app.router.add_patch("/rest/v1/{table}", table_patch)
+        app.router.add_delete("/rest/v1/{table}", table_delete)
+        return app
+
+
+def run_with_stub(fn):
+    """Start the stub, build a client pointed at it, run fn(client, stub)."""
+    stub = StubPostgrest()
+
+    async def go():
+        server = TestServer(stub.app())
+        await server.start_server()
+        db = RemoteDBClient(
+            str(server.make_url("")), api_key="svc-key"
+        )
+        try:
+            return await fn(db, stub)
+        finally:
+            await db.close()
+            await server.close()
+
+    return asyncio.run(go())
+
+
+class TestThreadsAndMessages:
+    def test_thread_crud_roundtrip(self):
+        async def fn(db, stub):
+            tid = await db.create_thread("t1", {"k": "v"})
+            assert tid == "t1"
+            assert await db.thread_exists("t1")
+            assert not await db.thread_exists("nope")
+            # idempotent create
+            assert await db.create_thread("t1") == "t1"
+            assert len(stub.tables["threads"]) == 1
+            meta = await db.get_thread_metadata("t1")
+            assert meta["metadata"] == {"k": "v"}
+            listing = await db.list_threads()
+            assert [t["thread_id"] for t in listing] == ["t1"]
+            await db.delete_thread("t1")
+            assert not await db.thread_exists("t1")
+
+        run_with_stub(fn)
+
+    def test_messages_roundtrip_ordered(self):
+        async def fn(db, stub):
+            await db.create_thread("t")
+            await db.add_messages("t", [
+                {"role": "user", "content": "one"},
+                {"role": "assistant", "content": "two"},
+            ])
+            await db.add_message("t", {"role": "user", "content": "three"})
+            msgs = await db.get_thread_messages("t")
+            assert [m["content"] for m in msgs] == ["one", "two", "three"]
+            await db.delete_thread_messages("t")
+            assert await db.get_thread_messages("t") == []
+
+        run_with_stub(fn)
+
+    def test_multipart_content_flattened(self):
+        async def fn(db, stub):
+            await db.create_thread("t")
+            await db.add_message("t", {
+                "role": "user",
+                "content": [
+                    {"type": "text", "text": "hello "},
+                    {"type": "image_url", "image_url": {"url": "x"}},
+                    {"type": "text", "text": "world"},
+                ],
+            })
+            msgs = await db.get_thread_messages("t")
+            assert msgs[0]["content"] == "hello world"
+
+        run_with_stub(fn)
+
+    def test_sandbox_binding(self):
+        async def fn(db, stub):
+            await db.create_thread("t")
+            assert await db.get_thread_sandbox_id("t") is None
+            await db.update_thread_sandbox_id("t", "sb-9")
+            assert await db.get_thread_sandbox_id("t") == "sb-9"
+
+        run_with_stub(fn)
+
+
+class TestConfigJoin:
+    def test_full_join(self):
+        async def fn(db, stub):
+            stub.tables["profiles"].append(
+                {"id": "user-1", "name": "Ada"})
+            stub.tables["kafka_profiles"].append({
+                "id": "kp-1", "user_id": "user-1",
+                "global_prompt": "Be terse.", "memory_dsn": "dsn://x",
+                "model": "llama-3.2-1b",
+            })
+            stub.tables["vm_api_keys"].append({
+                "id": "vk-1", "thread_id": "t", "api_key": "vm_abc",
+                "status": "active", "created_at": 1.0,
+            })
+            stub.tables["playbooks"].append({
+                "id": "pb-1", "kafka_profile_id": "kp-1",
+                "name": "deploy", "created_at": 1.0,
+            })
+            await db.create_thread("t")
+            await db.set_thread_config("t", {
+                "kafka_profile_id": "kp-1", "vm_api_key_id": "vk-1",
+                "user_id": "user-1", "ignored_field": "x",
+            })
+            cfg = await db.get_thread_config("t")
+            assert cfg["global_prompt"] == "Be terse."
+            assert cfg["memory_dsn"] == "dsn://x"
+            assert cfg["model"] == "llama-3.2-1b"
+            assert cfg["vm_api_key"] == "vm_abc"
+            assert cfg["user_id"] == "user-1"
+            assert [p["name"] for p in cfg["playbooks"]] == ["deploy"]
+
+        run_with_stub(fn)
+
+    def test_config_for_unknown_thread_is_none(self):
+        async def fn(db, stub):
+            assert await db.get_thread_config("ghost") is None
+
+        run_with_stub(fn)
+
+    def test_config_with_no_profile_links(self):
+        async def fn(db, stub):
+            await db.create_thread("bare")
+            cfg = await db.get_thread_config("bare")
+            assert cfg["global_prompt"] is None
+            assert cfg["vm_api_key"] is None
+            assert cfg["playbooks"] == []
+
+        run_with_stub(fn)
+
+
+class TestVmApiKeys:
+    def test_existing_active_key_reused(self):
+        async def fn(db, stub):
+            stub.tables["vm_api_keys"].append({
+                "id": "vk", "thread_id": "t", "api_key": "vm_keep",
+                "status": "active",
+            })
+            assert await db.get_or_create_vm_api_key("t") == "vm_keep"
+            assert stub.rpc_calls == []  # no mint when one exists
+
+        run_with_stub(fn)
+
+    def test_minted_via_rpc(self):
+        async def fn(db, stub):
+            key = await db.get_or_create_vm_api_key("t9")
+            assert key == "vm_rpc_t9"
+            assert stub.rpc_calls == [
+                ("generate_vm_api_key", {"p_thread_id": "t9"})
+            ]
+            # persisted for next time
+            assert await db.get_or_create_vm_api_key("t9") == "vm_rpc_t9"
+            assert len(stub.rpc_calls) == 1
+
+        run_with_stub(fn)
+
+    def test_rpc_failure_falls_back_to_local_key(self):
+        async def fn(db, stub):
+            stub.fail_rpc = True
+            key = await db.get_or_create_vm_api_key("t")
+            assert key.startswith("vm_")
+
+        run_with_stub(fn)
+
+
+class TestFlatten:
+    def test_flatten_passthrough(self):
+        assert _flatten_content("plain") == "plain"
+        assert _flatten_content(None) is None
+        assert _flatten_content([
+            {"type": "text", "text": "a"}, "b",
+            {"type": "tool", "x": 1},
+        ]) == "ab"

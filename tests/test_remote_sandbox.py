@@ -1,0 +1,186 @@
+"""RemoteSandboxFactory against a stub control plane + REAL in-VM server.
+
+The "cloud" here is an aiohttp stub implementing the provisioning REST
+surface; the "VM" behind the proxy URL is the real in-tree sandbox tool
+server (sandbox/server.py) running in-process — so create/connect/
+restart/terminate and the SandboxManager 3-case lifecycle run end-to-end
+over genuine HTTP, with only the VM *hardware* faked.
+"""
+
+import asyncio
+import itertools
+
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestServer
+
+from kafka_tpu.db.local import LocalDBClient
+from kafka_tpu.sandbox import RemoteSandboxFactory, SandboxManager
+from kafka_tpu.sandbox.server import create_sandbox_app
+
+
+class StubControlPlane:
+    """Provisioning API whose VMs are in-process sandbox tool servers."""
+
+    def __init__(self):
+        self.sandboxes = {}  # id -> {"state": ..., "server": TestServer}
+        self.counter = itertools.count(1)
+        self.created_with = []
+
+    async def _boot_vm(self, sandbox_id):
+        server = TestServer(create_sandbox_app(sandbox_id))
+        await server.start_server()
+        self.sandboxes[sandbox_id] = {
+            "state": "running", "server": server,
+            # captured while live: a dead VM's stale URL must still resolve
+            # (to a refused connection), like a real proxy URL would
+            "url": str(server.make_url("")),
+        }
+        return server
+
+    def app(self) -> web.Application:
+        app = web.Application()
+
+        async def create(request):
+            body = await request.json()
+            self.created_with.append(body)
+            sid = f"vm-{next(self.counter)}"
+            await self._boot_vm(sid)
+            return web.json_response({"id": sid})
+
+        async def get(request):
+            sid = request.match_info["sid"]
+            sb = self.sandboxes.get(sid)
+            if sb is None:
+                return web.json_response({}, status=404)
+            return web.json_response({"id": sid, "state": sb["state"]})
+
+        async def restart(request):
+            sid = request.match_info["sid"]
+            sb = self.sandboxes.get(sid)
+            if sb is None:
+                return web.json_response({}, status=404)
+            await sb["server"].close()
+            await self._boot_vm(sid)
+            return web.json_response({"id": sid, "state": "running"})
+
+        async def delete(request):
+            sid = request.match_info["sid"]
+            sb = self.sandboxes.pop(sid, None)
+            if sb is not None:
+                await sb["server"].close()
+            return web.json_response({}, status=204)
+
+        app.router.add_post("/sandboxes", create)
+        app.router.add_get("/sandboxes/{sid}", get)
+        app.router.add_post("/sandboxes/{sid}/restart", restart)
+        app.router.add_delete("/sandboxes/{sid}", delete)
+        return app
+
+    def url_of(self, sandbox_id: str) -> str:
+        return self.sandboxes[sandbox_id]["url"]
+
+    async def close(self):
+        for sb in self.sandboxes.values():
+            await sb["server"].close()
+
+
+def run_with_plane(fn):
+    plane = StubControlPlane()
+
+    async def go():
+        api = TestServer(plane.app())
+        await api.start_server()
+
+        class Factory(RemoteSandboxFactory):
+            # test proxy "template": resolve through the stub's port map
+            def _url_for(self, sandbox_id: str) -> str:
+                return plane.url_of(sandbox_id)
+
+        factory = Factory(str(api.make_url("")), proxy_template="unused",
+                          snapshot="snap-1", boot_timeout_s=10.0)
+        try:
+            return await fn(factory, plane)
+        finally:
+            await factory.aclose()
+            await plane.close()
+            await api.close()
+
+    return asyncio.run(go())
+
+
+class TestFactory:
+    def test_create_provisions_and_waits_live(self):
+        async def fn(factory, plane):
+            sandbox = await factory.create("thread-A")
+            assert plane.created_with == [
+                {"snapshot": "snap-1", "thread_id": "thread-A"}
+            ]
+            status = await sandbox.check_health()
+            assert status.get("healthy")
+            await sandbox.aclose()
+
+        run_with_plane(fn)
+
+    def test_connect_unknown_returns_none(self):
+        async def fn(factory, plane):
+            assert await factory.connect("ghost") is None
+
+        run_with_plane(fn)
+
+    def test_restart_recovers_vm(self):
+        async def fn(factory, plane):
+            sandbox = await factory.create("t")
+            sid = sandbox.sandbox_id
+            await sandbox.aclose()
+            # simulate VM death: stop the tool server but keep the record
+            await plane.sandboxes[sid]["server"].close()
+            plane.sandboxes[sid]["state"] = "stopped"
+            fresh = await factory.restart(sid)
+            assert fresh is not None
+            assert (await fresh.check_health()).get("healthy")
+            await fresh.aclose()
+
+        run_with_plane(fn)
+
+    def test_terminate_deletes(self):
+        async def fn(factory, plane):
+            sandbox = await factory.create("t")
+            sid = sandbox.sandbox_id
+            await sandbox.aclose()
+            await factory.terminate(sid)
+            assert sid not in plane.sandboxes
+            # idempotent on unknown ids
+            await factory.terminate("ghost")
+
+        run_with_plane(fn)
+
+
+class TestManagerLifecycle:
+    def test_three_case_lifecycle_over_remote_vms(self, tmp_path):
+        """new -> create; healthy -> reuse; dead -> restart (reference
+        manager.py:316-377), with remote provisioning underneath."""
+        async def fn(factory, plane):
+            db = LocalDBClient(str(tmp_path / "t.db"))
+            await db.initialize()
+            await db.create_thread("th-1")  # binding needs the thread row
+            mgr = SandboxManager(db, factory)
+
+            sb1 = await mgr.ensure_sandbox("th-1")
+            sid = sb1.sandbox_id
+            assert (await sb1.check_health()).get("healthy")
+
+            # case 2: same thread reuses the stored binding
+            sb2 = await mgr.ensure_sandbox("th-1")
+            assert sb2.sandbox_id == sid
+
+            # case 3: kill the VM; manager must restart it
+            await plane.sandboxes[sid]["server"].close()
+            plane.sandboxes[sid]["state"] = "stopped"
+            mgr._ready.pop("th-1", None)  # evict the ready cache
+            sb3 = await mgr.ensure_sandbox("th-1")
+            assert sb3.sandbox_id == sid
+            assert (await sb3.check_health()).get("healthy")
+            await mgr.aclose()
+
+        run_with_plane(fn)
