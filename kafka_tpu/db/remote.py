@@ -155,8 +155,6 @@ class RemoteDBClient(DBClient):
         metadata: Optional[Dict[str, Any]] = None,
     ) -> str:
         tid = thread_id or f"thread_{uuid.uuid4().hex[:24]}"
-        if await self.thread_exists(tid):
-            return tid
         now = _now_iso()
         try:
             await self._insert(self.threads_table, [{
@@ -204,6 +202,10 @@ class RemoteDBClient(DBClient):
 
     async def delete_thread(self, thread_id: str) -> None:
         await self._delete(self.messages_table, {"thread_id": thread_id})
+        # keys must die with the thread (credential hygiene; a recreated
+        # thread id must never inherit the prior tenant's key) — matching
+        # LocalDBClient.delete_thread
+        await self._delete("vm_api_keys", {"thread_id": thread_id})
         await self._delete(self.threads_table, {"id": thread_id})
 
     # -- messages --------------------------------------------------------
@@ -229,12 +231,13 @@ class RemoteDBClient(DBClient):
     ) -> None:
         if not messages:
             return
-        base = int(time.time() * 1e6)
         now = _now_iso()
+        # seq is a server-side bigserial: insertion order is assigned by
+        # the database, not client clocks (concurrent writers / replicas
+        # with skew would otherwise scramble thread replay order)
         rows = [
-            {"thread_id": thread_id, "message": dict(m),
-             "seq": base + i, "created_at": now}
-            for i, m in enumerate(messages)
+            {"thread_id": thread_id, "message": dict(m), "created_at": now}
+            for m in messages
         ]
         await self._insert(self.messages_table, rows)
         await self._update(
@@ -296,7 +299,7 @@ class RemoteDBClient(DBClient):
 
         playbooks = await self.get_playbooks(kp_id) if kp_id else []
 
-        return {
+        out = {
             "thread_id": thread.get("id"),
             "user_id": thread.get("user_id"),
             "kafka_profile_id": kp_id,
@@ -306,16 +309,34 @@ class RemoteDBClient(DBClient):
             "vm_api_key": vm_api_key,
             "playbooks": playbooks,
         }
+        # per-thread overrides set through set_thread_config win over the
+        # joined profile defaults
+        out.update(thread.get("config") or {})
+        return out
 
     async def set_thread_config(
-        self, thread_id: str, config: Dict[str, Any]
+        self, thread_id: str, config: Optional[Dict[str, Any]]
     ) -> None:
-        allowed = {
+        """None clears (base contract); link columns update in place and
+        everything else lands in the thread's `config` jsonb column, which
+        get_thread_config overlays on the joined profile data."""
+        if config is None:
+            await self._update(
+                self.threads_table, {"id": thread_id}, {"config": None}
+            )
+            return
+        values: Dict[str, Any] = {
             k: v for k, v in config.items()
             if k in ("kafka_profile_id", "vm_api_key_id", "user_id")
         }
-        if allowed:
-            await self._update(self.threads_table, {"id": thread_id}, allowed)
+        extra = {
+            k: v for k, v in config.items()
+            if k not in ("kafka_profile_id", "vm_api_key_id", "user_id")
+        }
+        if extra:
+            values["config"] = extra
+        if values:
+            await self._update(self.threads_table, {"id": thread_id}, values)
 
     async def get_playbooks(
         self, kafka_profile_id: str
@@ -343,21 +364,38 @@ class RemoteDBClient(DBClient):
                 return key
         # mint through the deployment's keygen RPC; fall back to a local
         # uuid key (dev parity with the reference's fallback)
+        key = None
         try:
             key = await self._rpc(
                 "generate_vm_api_key", {"p_thread_id": thread_id}
             )
             if isinstance(key, dict):
                 key = key.get("api_key")
-            if key:
+        except httpx.HTTPError as e:
+            logger.warning("vm key RPC failed (%s); using local key", e)
+        if key:
+            # bookkeeping insert is best-effort in its OWN failure domain:
+            # a 409 (concurrent mint / RPC already persisted the row) means
+            # an active key exists — return the authoritative stored one so
+            # claim config and in-VM auth can never diverge
+            try:
                 await self._insert("vm_api_keys", [{
                     "id": str(uuid.uuid4()), "thread_id": thread_id,
                     "api_key": key, "status": "active",
                     "created_at": _now_iso(),
                 }])
-                return str(key)
-        except httpx.HTTPError as e:
-            logger.warning("vm key RPC failed (%s); using local key", e)
+            except httpx.HTTPStatusError as e:
+                if e.response.status_code == 409:
+                    rows = await self._select(
+                        "vm_api_keys",
+                        {"thread_id": thread_id, "status": "active"},
+                        limit=1,
+                    )
+                    if rows and rows[0].get("api_key"):
+                        return str(rows[0]["api_key"])
+            except httpx.HTTPError:
+                pass  # RPC key is server-persisted; still valid
+            return str(key)
         key = f"vm_{uuid.uuid4()}"
         try:
             await self._insert("vm_api_keys", [{

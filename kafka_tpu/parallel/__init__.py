@@ -1,8 +1,18 @@
-"""Parallelism tier: meshes, sharding rules, context parallelism."""
+"""Parallelism tier: meshes, sharding rules, context/pipeline/expert
+parallelism, multi-host init."""
 
+from .distributed import init_distributed
+from .expert import (
+    init_moe_params,
+    moe_mlp_reference,
+    moe_mlp_sharded,
+    shard_moe_params,
+)
 from .mesh import AXIS_ORDER, MeshConfig, make_mesh, single_device_mesh
+from .pipeline import pp_forward, pp_param_specs, shard_params_pp
 from .ring_attention import (
     ring_attention,
+    ring_prefill_sharded,
     ring_attention_sharded,
     ulysses_attention,
     ulysses_attention_sharded,
@@ -17,10 +27,19 @@ from .sharding import (
 
 __all__ = [
     "AXIS_ORDER",
+    "init_distributed",
+    "init_moe_params",
+    "moe_mlp_reference",
+    "moe_mlp_sharded",
+    "shard_moe_params",
+    "pp_forward",
+    "pp_param_specs",
+    "shard_params_pp",
     "MeshConfig",
     "make_mesh",
     "single_device_mesh",
     "ring_attention",
+    "ring_prefill_sharded",
     "ring_attention_sharded",
     "ulysses_attention",
     "ulysses_attention_sharded",

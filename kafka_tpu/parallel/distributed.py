@@ -1,0 +1,83 @@
+"""Multi-host initialization: the jax.distributed entry point.
+
+Single-host meshes need nothing — `make_mesh` over local devices covers a
+whole v5e/v5p slice's chips in one process.  Multi-HOST topologies (more
+chips than one host exposes, or DCN-spanning pods) require every process
+to call `jax.distributed.initialize` before any backend use; after that,
+`jax.devices()` is global and the same MeshConfig code paths work
+unchanged — dp/pp (outer axes) land across hosts on DCN, sp/tp (inner)
+stay on each slice's ICI, per parallel/mesh.py's axis ordering.
+
+The reference has no analog (its multi-node story was HTTPS fan-out,
+SURVEY §5.8); this is the XLA-collectives equivalent of the NCCL/MPI init
+a GPU stack would carry.
+
+Configuration, env-var driven for launcher friendliness:
+
+    KAFKA_TPU_COORDINATOR    host:port of process 0 (e.g. "10.0.0.1:8476")
+    KAFKA_TPU_NUM_PROCESSES  total process count
+    KAFKA_TPU_PROCESS_ID     this process's index (0-based)
+
+On Cloud TPU the three are auto-detected by JAX when omitted —
+`init_distributed()` with no env set on a multi-host TPU VM still does the
+right thing via `jax.distributed.initialize()`'s own discovery.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+logger = logging.getLogger("kafka_tpu.distributed")
+
+_INITIALIZED = False
+
+
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Initialize multi-host JAX if configured; returns True when active.
+
+    No-ops (returns False) when neither arguments nor environment request
+    multi-host — single-process runs must not pay a coordinator timeout.
+    Idempotent: repeated calls after a successful init return True.
+    """
+    global _INITIALIZED
+    if _INITIALIZED:
+        return True
+    coordinator_address = coordinator_address or os.environ.get(
+        "KAFKA_TPU_COORDINATOR"
+    )
+    env_np = os.environ.get("KAFKA_TPU_NUM_PROCESSES")
+    env_pid = os.environ.get("KAFKA_TPU_PROCESS_ID")
+    num_processes = (
+        num_processes if num_processes is not None
+        else int(env_np) if env_np else None
+    )
+    process_id = (
+        process_id if process_id is not None
+        else int(env_pid) if env_pid else None
+    )
+    if coordinator_address is None and num_processes is None:
+        return False  # single-process: nothing to do
+
+    import jax
+
+    logger.info(
+        "initializing jax.distributed (coordinator=%s, processes=%s, id=%s)",
+        coordinator_address, num_processes, process_id,
+    )
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _INITIALIZED = True
+    logger.info(
+        "jax.distributed up: process %d/%d, %d global devices",
+        jax.process_index(), jax.process_count(), len(jax.devices()),
+    )
+    return True

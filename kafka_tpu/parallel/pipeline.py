@@ -1,0 +1,154 @@
+"""Pipeline parallelism: layer stages sharded over the "pp" mesh axis.
+
+The model's parameters are layer-stacked ([L, ...] per tensor,
+models/llama.py) precisely so the leading axis can be cut into pipeline
+stages: rank s of the pp axis holds layers [s*L/pp, (s+1)*L/pp) and
+activations hop rank→rank+1 over `lax.ppermute` (ICI within a slice, DCN
+across slices — the axis order in parallel/mesh.py puts pp outermost for
+exactly that reason).
+
+Scope and honesty: this is *sequential* pipeline execution — each stage
+computes while the others idle, activations ppermute forward, and the last
+stage holds the logits.  That is the correct latency shape for single-token
+decode (stages are inherently sequential for one token) and it delivers
+PP's main serving win: a model whose weights exceed one device's HBM runs
+with 1/pp of the layers per device.  Microbatched prefill overlap (the
+throughput optimization trainers need) is deliberately not implemented —
+it changes nothing about parameter placement and can be layered onto this
+stage structure later.
+
+Composes with TP: give the mesh both axes (pp outer, tp inner) and the
+per-stage weights follow the usual Megatron specs within each stage.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.config import ModelConfig
+from ..models.llama import Params, _attention_block, _mlp_block
+from ..ops.norms import rms_norm
+from ..ops.rope import rope_cos_sin, rope_frequencies
+
+
+def pp_param_specs(cfg: ModelConfig, mesh: Mesh) -> Params:
+    """PartitionSpecs with the stacked layer axis sharded over "pp".
+
+    Embedding/head/final norm are replicated (they live on the first/last
+    stages logically; replication keeps the spec simple and they are a few
+    percent of weights).  Within a stage, heads/hidden shard over "tp"
+    exactly as in sharding.param_specs.
+    """
+    from .sharding import _kv_axis
+
+    kv = _kv_axis(cfg, mesh)
+    specs: Params = {
+        "embed": P(),
+        "final_norm": P(),
+        "layers": {
+            "ln_attn": P("pp", None),
+            "ln_mlp": P("pp", None),
+            "wq": P("pp", None, "tp", None),
+            "wk": P("pp", None, kv, None),
+            "wv": P("pp", None, kv, None),
+            "wo": P("pp", "tp", None, None),
+            "wg": P("pp", None, "tp"),
+            "wu": P("pp", None, "tp"),
+            "wd": P("pp", "tp", None),
+        },
+    }
+    if not cfg.tie_word_embeddings:
+        specs["lm_head"] = P(None, "tp")
+    return specs
+
+
+def shard_params_pp(params: Params, cfg: ModelConfig, mesh: Mesh) -> Params:
+    specs = pp_param_specs(cfg, mesh)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs
+    )
+
+
+def pp_forward(
+    params: Params,
+    cfg: ModelConfig,
+    token_ids: jnp.ndarray,
+    positions: jnp.ndarray,
+    mesh: Mesh,
+) -> jnp.ndarray:
+    """Uncached forward with layers stage-sharded over "pp".
+
+    Returns logits [B, S, V], numerically identical to models.forward on a
+    single device (tested).  Params must be placed by shard_params_pp.
+    """
+    pp = mesh.shape.get("pp", 1)
+    L = cfg.num_layers
+    if L % pp:
+        raise ValueError(f"num_layers {L} not divisible by pp={pp}")
+    per_stage = L // pp
+
+    def per_shard(layer_params, x, cos, sin, pos):
+        # layer_params: this rank's [L/pp, ...] slice; x replicated
+        rank = lax.axis_index("pp")
+
+        def run_stage(h):
+            def body(h, lp):
+                attn_in = rms_norm(h, lp["ln_attn"], cfg.rms_norm_eps)
+                attn_out, _, _ = _attention_block(
+                    attn_in, lp, cfg, cos, sin, pos, None, None, None, None
+                )
+                h = h + attn_out
+                mlp_in = rms_norm(h, lp["ln_mlp"], cfg.rms_norm_eps)
+                return h + _mlp_block(mlp_in, lp), None
+
+            out, _ = lax.scan(body, h, layer_params)
+            return out
+
+        # the replicated input becomes rank-varying the moment it meets the
+        # stage-sharded weights; cast up front so scan/cond carries type-
+        # check (same vma dance as ring_attention)
+        h = lax.pcast(x, ("pp",), to="varying")
+        for s in range(pp):  # sequential stages; only rank s computes
+            h = lax.cond(rank == s, run_stage, lambda v: v, h)
+            if s + 1 < pp:
+                h = lax.ppermute(h, "pp", [(s, s + 1)])
+        # only the final stage holds the result; psum of the masked value
+        # broadcasts it to every rank so the replicated logits head can
+        # run anywhere (and the out_spec is genuinely replicated)
+        h = lax.psum(
+            jnp.where(rank == pp - 1, h, jnp.zeros_like(h)), "pp"
+        )
+        return h
+
+    x = params["embed"][token_ids].astype(cfg.activation_dtype)
+    inv_freq = rope_frequencies(cfg)
+    cos, sin = rope_cos_sin(positions, inv_freq)
+
+    fn = jax.shard_map(
+        per_shard,
+        mesh=mesh,
+        in_specs=(
+            jax.tree.map(lambda _: P("pp"), params["layers"]),
+            P(), P(), P(), P(),
+        ),
+        out_specs=P(),
+    )
+    h = fn(params["layers"], x, cos, sin, positions)
+    h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
+    if cfg.tie_word_embeddings:
+        logits = jnp.einsum(
+            "bsh,vh->bsv", h, params["embed"],
+            preferred_element_type=jnp.float32,
+        )
+    else:
+        logits = jnp.einsum(
+            "bsh,hv->bsv", h, params["lm_head"],
+            preferred_element_type=jnp.float32,
+        )
+    return logits

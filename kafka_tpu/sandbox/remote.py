@@ -115,13 +115,14 @@ class RemoteSandboxFactory(SandboxFactory):
     async def connect(self, sandbox_id: str) -> Optional[Sandbox]:
         try:
             r = await self._client.get(f"/sandboxes/{sandbox_id}")
+            if r.status_code == 404:
+                return None
+            r.raise_for_status()
         except httpx.HTTPError as e:
-            logger.warning("control plane unreachable for %s: %s",
-                           sandbox_id, e)
+            # transient control-plane failure degrades to "not connectable"
+            # so the manager's lifecycle can route to restart/create
+            logger.warning("control plane error for %s: %s", sandbox_id, e)
             return None
-        if r.status_code == 404:
-            return None
-        r.raise_for_status()
         # the GET is an existence probe: a stopped VM's handle comes back
         # unhealthy and the manager's 3-case lifecycle routes it to
         # restart(); a deleted VM returns None above and gets recreated

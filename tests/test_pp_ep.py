@@ -1,0 +1,103 @@
+"""Pipeline and expert parallelism + multi-host init (VERDICT missing #7)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from kafka_tpu.models import ModelConfig, forward, init_params
+from kafka_tpu.parallel import (
+    MeshConfig,
+    init_distributed,
+    init_moe_params,
+    make_mesh,
+    moe_mlp_reference,
+    moe_mlp_sharded,
+    pp_forward,
+    shard_moe_params,
+    shard_params_pp,
+)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = ModelConfig(name="pp-test", vocab_size=128, hidden_size=64,
+                      intermediate_size=128, num_layers=4, num_heads=8,
+                      num_kv_heads=4, head_dim=16, dtype="float32")
+    params = init_params(cfg, jax.random.PRNGKey(31))
+    return cfg, params
+
+
+class TestPipelineParallel:
+    def test_pp_forward_matches_single_device(self, model):
+        cfg, params = model
+        tokens = jnp.asarray(
+            [np.random.RandomState(0).randint(1, 128, 12)], jnp.int32)
+        pos = jnp.arange(12, dtype=jnp.int32)[None, :]
+        ref, _ = forward(params, cfg, tokens, pos)
+
+        mesh = make_mesh(MeshConfig(pp=4, tp=2))
+        sharded = shard_params_pp(params, cfg, mesh)
+        out = pp_forward(sharded, cfg, tokens, pos, mesh)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=1e-4, rtol=1e-4)
+
+    def test_pp_alone_without_tp(self, model):
+        cfg, params = model
+        tokens = jnp.asarray([[5, 9, 23, 54, 3]], jnp.int32)
+        pos = jnp.arange(5, dtype=jnp.int32)[None, :]
+        ref, _ = forward(params, cfg, tokens, pos)
+        mesh = make_mesh(MeshConfig(pp=2))
+        out = pp_forward(shard_params_pp(params, cfg, mesh), cfg,
+                         tokens, pos, mesh)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=1e-4, rtol=1e-4)
+
+    def test_layers_must_divide_stages(self, model):
+        cfg, params = model
+        mesh = make_mesh(MeshConfig(pp=3))
+        with pytest.raises(ValueError, match="divisible by pp"):
+            pp_forward(params, cfg, jnp.zeros((1, 4), jnp.int32),
+                       jnp.zeros((1, 4), jnp.int32), mesh)
+
+    def test_weights_actually_stage_sharded(self, model):
+        """Each pp rank must hold only L/pp layers' weights (the HBM win)."""
+        cfg, params = model
+        mesh = make_mesh(MeshConfig(pp=4, tp=2))
+        sharded = shard_params_pp(params, cfg, mesh)
+        wq = sharded["layers"]["wq"]
+        shard_shapes = {s.data.shape for s in wq.addressable_shards}
+        assert shard_shapes == {(1, 64, 4, 16)}  # 4 layers / 4 stages, tp=2
+
+
+class TestExpertParallel:
+    @pytest.mark.parametrize("top_k", [1, 2])
+    def test_sharded_moe_matches_dense(self, top_k):
+        params = init_moe_params(jax.random.PRNGKey(3), num_experts=8,
+                                 hidden=32, ffn=64)
+        x = jax.random.normal(jax.random.PRNGKey(4), (10, 32), jnp.float32)
+        ref = moe_mlp_reference(x, params, top_k=top_k)
+        mesh = make_mesh(MeshConfig(ep=8))
+        out = moe_mlp_sharded(mesh, x, shard_moe_params(params, mesh),
+                              top_k=top_k)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=1e-5, rtol=1e-5)
+
+    def test_ep_composes_with_tp_axis_present(self):
+        params = init_moe_params(jax.random.PRNGKey(5), num_experts=4,
+                                 hidden=16, ffn=32)
+        x = jax.random.normal(jax.random.PRNGKey(6), (6, 16), jnp.float32)
+        ref = moe_mlp_reference(x, params, top_k=2)
+        mesh = make_mesh(MeshConfig(ep=4, tp=2))
+        out = moe_mlp_sharded(mesh, x, shard_moe_params(params, mesh), top_k=2)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=1e-5, rtol=1e-5)
+
+
+class TestDistributedInit:
+    def test_single_process_noop(self, monkeypatch):
+        for var in ("KAFKA_TPU_COORDINATOR", "KAFKA_TPU_NUM_PROCESSES",
+                    "KAFKA_TPU_PROCESS_ID"):
+            monkeypatch.delenv(var, raising=False)
+        assert init_distributed() is False  # no config -> no coordinator wait

@@ -25,6 +25,7 @@ class StubPostgrest:
         }
         self.rpc_calls = []
         self.fail_rpc = False
+        self._seq = 0  # bigserial for oai_messages (server-assigned)
 
     def _filtered(self, table, query):
         rows = list(self.tables[table])
@@ -58,6 +59,18 @@ class StubPostgrest:
             table = request.match_info["table"]
             body = await request.json()
             rows = body if isinstance(body, list) else [body]
+            for r in rows:
+                # primary-key enforcement like real PostgREST: duplicate
+                # ids conflict with 409
+                if "id" in r and any(
+                    x.get("id") == r["id"] for x in self.tables[table]
+                ):
+                    return web.json_response(
+                        {"message": "duplicate key"}, status=409
+                    )
+                if table == "oai_messages" and "seq" not in r:
+                    self._seq += 1
+                    r["seq"] = self._seq
             self.tables[table].extend(rows)
             return web.json_response(rows, status=201)
 
