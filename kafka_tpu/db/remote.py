@@ -23,6 +23,21 @@ Auth follows the Supabase convention: `apikey` + `Authorization: Bearer`
 headers carry the service key.  Configure with
 KAFKA_TPU_REMOTE_DB_URL / KAFKA_TPU_REMOTE_DB_KEY (db.make_db_client()
 picks this client up automatically when the URL is set).
+
+Schema contract (what the deployment must provide)::
+
+    threads       (id text pk, metadata jsonb, config jsonb,
+                   sandbox_id text, user_id text, kafka_profile_id text,
+                   vm_api_key_id text,
+                   created_at timestamptz, updated_at timestamptz)
+    oai_messages  (seq bigserial pk, thread_id text, message jsonb,
+                   created_at timestamptz)
+    vm_api_keys   (id text pk, thread_id text, api_key text, status text,
+                   created_at timestamptz)
+    kafka_profiles / profiles / playbooks per the reference schema.
+
+`seq` being server-assigned (bigserial) is load-bearing: insertion order
+must not depend on client clocks across replicas.
 """
 
 from __future__ import annotations
@@ -314,29 +329,27 @@ class RemoteDBClient(DBClient):
         out.update(thread.get("config") or {})
         return out
 
+    _LINK_COLUMNS = ("kafka_profile_id", "vm_api_key_id", "user_id")
+
     async def set_thread_config(
         self, thread_id: str, config: Optional[Dict[str, Any]]
     ) -> None:
-        """None clears (base contract); link columns update in place and
-        everything else lands in the thread's `config` jsonb column, which
-        get_thread_config overlays on the joined profile data."""
+        """REPLACE the per-thread config (base contract: None clears).
+
+        Link keys land in their own columns (they join at read time);
+        everything else replaces the thread's `config` jsonb column, which
+        get_thread_config overlays on the joined profile data.  Absent
+        keys clear — a replace, not a merge."""
         if config is None:
-            await self._update(
-                self.threads_table, {"id": thread_id}, {"config": None}
-            )
-            return
+            config = {}
         values: Dict[str, Any] = {
-            k: v for k, v in config.items()
-            if k in ("kafka_profile_id", "vm_api_key_id", "user_id")
+            col: config.get(col) for col in self._LINK_COLUMNS
         }
         extra = {
-            k: v for k, v in config.items()
-            if k not in ("kafka_profile_id", "vm_api_key_id", "user_id")
+            k: v for k, v in config.items() if k not in self._LINK_COLUMNS
         }
-        if extra:
-            values["config"] = extra
-        if values:
-            await self._update(self.threads_table, {"id": thread_id}, values)
+        values["config"] = extra or None
+        await self._update(self.threads_table, {"id": thread_id}, values)
 
     async def get_playbooks(
         self, kafka_profile_id: str

@@ -88,14 +88,28 @@ def pp_forward(
     single device (tested).  Params must be placed by shard_params_pp.
     """
     pp = mesh.shape.get("pp", 1)
+    tp = mesh.shape.get("tp", 1)
     L = cfg.num_layers
     if L % pp:
         raise ValueError(f"num_layers {L} not divisible by pp={pp}")
-    per_stage = L // pp
+    if tp > 1 and (cfg.num_heads % tp or cfg.num_kv_heads % tp):
+        raise ValueError(
+            f"pp x tp compose needs tp={tp} to divide heads "
+            f"({cfg.num_heads}) and kv heads ({cfg.num_kv_heads})"
+        )
 
     def per_shard(layer_params, x, cos, sin, pos):
-        # layer_params: this rank's [L/pp, ...] slice; x replicated
+        # layer_params: this rank's [L/pp, ...] stage slice, heads/hidden
+        # additionally tp-sharded (each device holds 1/(pp*tp) of layer
+        # weights — the HBM point of the composition).  Inside shard_map
+        # the tp collectives are explicit: the row-parallel projections
+        # (wo over heads, wd over ffn) produce partial sums that psum over
+        # "tp"; q/kv head shards stay aligned because both split into
+        # contiguous blocks of the same rank order.
         rank = lax.axis_index("pp")
+
+        def tp_reduce(t):
+            return lax.psum(t, "tp") if tp > 1 else t
 
         def run_stage(h):
             def body(h, lp):
@@ -103,26 +117,29 @@ def pp_forward(
                 attn_out, _, _ = _attention_block(
                     attn_in, lp, cfg, cos, sin, pos, None, None, None, None
                 )
-                h = h + attn_out
+                h = h + tp_reduce(attn_out)
                 mlp_in = rms_norm(h, lp["ln_mlp"], cfg.rms_norm_eps)
-                return h + _mlp_block(mlp_in, lp), None
+                return h + tp_reduce(_mlp_block(mlp_in, lp)), None
 
             out, _ = lax.scan(body, h, layer_params)
             return out
 
         # the replicated input becomes rank-varying the moment it meets the
-        # stage-sharded weights; cast up front so scan/cond carries type-
-        # check (same vma dance as ring_attention)
-        h = lax.pcast(x, ("pp",), to="varying")
+        # stage- and head-sharded weights; cast up front so scan/cond
+        # carries type-check (same vma dance as ring_attention)
+        h = lax.pcast(x, ("pp", "tp"), to="varying")
         for s in range(pp):  # sequential stages; only rank s computes
             h = lax.cond(rank == s, run_stage, lambda v: v, h)
             if s + 1 < pp:
                 h = lax.ppermute(h, "pp", [(s, s + 1)])
-        # only the final stage holds the result; psum of the masked value
-        # broadcasts it to every rank so the replicated logits head can
-        # run anywhere (and the out_spec is genuinely replicated)
+        # only the final stage holds the result (identical across tp after
+        # the per-layer psums); a psum of the value masked down to exactly
+        # ONE (pp, tp) rank broadcasts it everywhere and lets shard_map
+        # prove the replicated out_spec
+        tp_rank = lax.axis_index("tp")
+        keep = (rank == pp - 1) & (tp_rank == 0)
         h = lax.psum(
-            jnp.where(rank == pp - 1, h, jnp.zeros_like(h)), "pp"
+            jnp.where(keep, h, jnp.zeros_like(h)), ("pp", "tp")
         )
         return h
 
@@ -130,13 +147,11 @@ def pp_forward(
     inv_freq = rope_frequencies(cfg)
     cos, sin = rope_cos_sin(positions, inv_freq)
 
+    layer_specs = pp_param_specs(cfg, mesh)["layers"]
     fn = jax.shard_map(
         per_shard,
         mesh=mesh,
-        in_specs=(
-            jax.tree.map(lambda _: P("pp"), params["layers"]),
-            P(), P(), P(), P(),
-        ),
+        in_specs=(layer_specs, P(), P(), P(), P()),
         out_specs=P(),
     )
     h = fn(params["layers"], x, cos, sin, positions)

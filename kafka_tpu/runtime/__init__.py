@@ -1,5 +1,6 @@
 """Serving runtime: paged KV pool, continuous-batching engine."""
 
+from .dp_router import DataParallelEngines
 from .engine import (
     EngineConfig,
     GenRequest,
@@ -9,6 +10,7 @@ from .engine import (
 from .kv_cache import OutOfPagesError, PagePool, SequencePages, TRASH_PAGE
 
 __all__ = [
+    "DataParallelEngines",
     "EngineConfig",
     "GenRequest",
     "InferenceEngine",

@@ -92,9 +92,12 @@ class EngineConfig:
     prefill_buckets: Tuple[int, ...] = (16, 32, 64, 128, 256, 512)
     max_new_tokens_default: int = 512
     # In-flight token fetches tolerated before the host blocks on the oldest.
-    # Sized so fetch_lag * step_time exceeds the device->host round trip —
-    # then every blocking read finds its transfer already complete.
-    fetch_lag: int = 32
+    # Sized so fetch_lag * step_time exceeds the device->host round trip
+    # even when the link's RTT spikes — then every blocking read finds its
+    # transfer already complete.  On fast links the fetch_wait_s age bound
+    # pops entries long before this depth, so a generous value costs
+    # nothing there while keeping tunneled TPUs out of the blocking regime.
+    fetch_lag: int = 96
     # Also pop a fetch once it has been in flight this long (seconds) —
     # bounds token latency when the pipeline fills slower than fetch_lag
     # steps (e.g. a lone interactive request).

@@ -37,7 +37,7 @@ from typing import Optional
 
 import httpx
 
-from .base import Sandbox
+from .base import Sandbox, SandboxError
 from .local import LocalSandbox
 from .manager import SandboxFactory
 
@@ -116,13 +116,17 @@ class RemoteSandboxFactory(SandboxFactory):
         try:
             r = await self._client.get(f"/sandboxes/{sandbox_id}")
             if r.status_code == 404:
-                return None
+                return None  # genuinely gone: the manager recreates
             r.raise_for_status()
         except httpx.HTTPError as e:
-            # transient control-plane failure degrades to "not connectable"
-            # so the manager's lifecycle can route to restart/create
-            logger.warning("control plane error for %s: %s", sandbox_id, e)
-            return None
+            # transient control-plane failure is NOT "gone" — returning
+            # None would make the manager orphan the VM and provision a
+            # fresh one, losing the thread's filesystem state; raise a
+            # typed error so the attempt fails and retries keep the
+            # binding
+            raise SandboxError(
+                f"control plane error for {sandbox_id}: {e}"
+            ) from e
         # the GET is an existence probe: a stopped VM's handle comes back
         # unhealthy and the manager's 3-case lifecycle routes it to
         # restart(); a deleted VM returns None above and gets recreated

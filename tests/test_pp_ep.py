@@ -68,7 +68,9 @@ class TestPipelineParallel:
         sharded = shard_params_pp(params, cfg, mesh)
         wq = sharded["layers"]["wq"]
         shard_shapes = {s.data.shape for s in wq.addressable_shards}
-        assert shard_shapes == {(1, 64, 4, 16)}  # 4 layers / 4 stages, tp=2
+        # 4 layers / 4 stages over pp, 8 heads / 2 over tp:
+        # each device holds 1/(pp*tp) of the stacked weights
+        assert shard_shapes == {(1, 64, 4, 16)}
 
 
 class TestExpertParallel:

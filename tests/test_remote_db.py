@@ -280,3 +280,24 @@ class TestFlatten:
             {"type": "text", "text": "a"}, "b",
             {"type": "tool", "x": 1},
         ]) == "ab"
+
+
+class TestConfigReplaceContract:
+    def test_set_is_replace_not_merge_and_none_clears(self):
+        async def fn(db, stub):
+            await db.create_thread("t")
+            await db.set_thread_config("t", {"model": "m1", "user_id": "u1"})
+            cfg = await db.get_thread_config("t")
+            assert cfg["model"] == "m1" and cfg["user_id"] == "u1"
+            # replace with a dict lacking those keys: both must clear
+            await db.set_thread_config("t", {"global_prompt": "p"})
+            cfg = await db.get_thread_config("t")
+            assert cfg.get("model") is None
+            assert cfg["user_id"] is None
+            assert cfg["global_prompt"] == "p"
+            # None clears everything
+            await db.set_thread_config("t", None)
+            cfg = await db.get_thread_config("t")
+            assert cfg.get("global_prompt") is None
+
+        run_with_stub(fn)
