@@ -131,6 +131,24 @@ def main() -> None:
     wall = time.monotonic() - t0
     decode_tps = tokens / wall
 
+    # ---- concurrent-thread req/s (BASELINE metric 3): 4x oversubscribed
+    # queue of short thread turns through the continuous batcher ----------
+    n_threads = 8 if args.quick else 32
+    for i in range(n_threads):
+        engine.submit(GenRequest(
+            request_id=f"ct-{i}",
+            prompt_ids=prompt()[: args.prompt_len // 2],
+            max_new_tokens=32, prefix_key=f"ct-thread-{i}",
+        ))
+    t0 = time.monotonic()
+    done_ct = 0
+    while engine.has_work:
+        for ev in engine.step():
+            if ev.finished:
+                done_ct += 1
+    ct_wall = time.monotonic() - t0
+    concurrent_req_s = done_ct / ct_wall
+
     # the same counters GET /metrics exports (runtime/metrics.py) — bench
     # and the server report one source of truth
     snap = engine.metrics.snapshot(engine)
@@ -156,6 +174,8 @@ def main() -> None:
                 "generated_tokens": snap["tokens"]["generated"],
                 "prefix_cache": snap.get("prefix_cache"),
             },
+            "concurrent_thread_req_per_s": round(concurrent_req_s, 2),
+            "concurrent_threads": n_threads,
             "decode_batch": args.batch,
             "gen_len": args.gen_len,
             "ttft_all_ms": [round(t, 2) for t in ttfts],

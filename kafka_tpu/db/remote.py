@@ -334,16 +334,23 @@ class RemoteDBClient(DBClient):
     async def set_thread_config(
         self, thread_id: str, config: Optional[Dict[str, Any]]
     ) -> None:
-        """REPLACE the per-thread config (base contract: None clears).
+        """Replace the per-thread config overlay (None clears it).
 
-        Link keys land in their own columns (they join at read time);
-        everything else replaces the thread's `config` jsonb column, which
-        get_thread_config overlays on the joined profile data.  Absent
-        keys clear — a replace, not a merge."""
+        The `config` jsonb column is REPLACED wholesale — absent keys
+        clear, and get_thread_config overlays it on the joined profile
+        data.  The deployment-managed link columns (kafka_profile_id /
+        vm_api_key_id / user_id) are different: they bind the thread to
+        its tenant and sandbox credentials, so they update only when a
+        key is EXPLICITLY present (pass an explicit null to detach) — a
+        config write that merely sets e.g. a model override must never
+        silently sever the thread's profile and VM key."""
         if config is None:
-            config = {}
+            await self._update(
+                self.threads_table, {"id": thread_id}, {"config": None}
+            )
+            return
         values: Dict[str, Any] = {
-            col: config.get(col) for col in self._LINK_COLUMNS
+            col: config[col] for col in self._LINK_COLUMNS if col in config
         }
         extra = {
             k: v for k, v in config.items() if k not in self._LINK_COLUMNS

@@ -59,15 +59,9 @@ class DataParallelEngines:
         self.engines: List[InferenceEngine] = []
         for r in range(dp):
             slice_devices = devices[r * tp : (r + 1) * tp]
-            mesh = (
-                make_mesh(MeshConfig(tp=tp), devices=slice_devices)
-                if tp > 1
-                else None
-            )
-            if mesh is None and tp == 1:
-                # single-device replica: pin by constructing params on the
-                # device via a trivial 1-device mesh
-                mesh = make_mesh(MeshConfig(), devices=slice_devices)
+            # a mesh over exactly this replica's devices pins its params
+            # and KV pool there (the engine places for any provided mesh)
+            mesh = make_mesh(MeshConfig(tp=tp), devices=slice_devices)
             self.engines.append(
                 InferenceEngine(
                     cfg, params, engine_cfg, kv_dtype=kv_dtype, mesh=mesh
@@ -112,13 +106,13 @@ class DataParallelEngines:
 
     def submit(self, req: GenRequest) -> None:
         idx = self._pick(req)
+        self.engines[idx].submit(req)  # may raise: record routes only after
         self._route[req.request_id] = idx
         if req.prefix_key is not None:
             self._affinity[req.prefix_key] = idx
             self._affinity.move_to_end(req.prefix_key)
             while len(self._affinity) > self._affinity_cap:
                 self._affinity.popitem(last=False)
-        self.engines[idx].submit(req)
 
     def cancel(self, request_id: str) -> bool:
         idx = self._route.pop(request_id, None)
@@ -178,9 +172,13 @@ class _AggregateMetrics:
 
     def snapshot(self, engine=None) -> Dict[str, Any]:
         snaps = [e.metrics.snapshot(e) for e in self._engines]
-        agg = dict(snaps[0])
-        agg["replicas"] = snaps
-        agg["dp"] = len(snaps)
+        agg: Dict[str, Any] = {
+            "dp": len(snaps),
+            "replicas": snaps,  # per-replica detail incl. latency hists
+            "uptime_s": snaps[0]["uptime_s"],
+        }
+        # summable counters aggregate; latency percentiles stay per-replica
+        # (summing histograms would misrepresent them)
         agg["requests"] = {
             k: sum(s["requests"][k] for s in snaps)
             for k in snaps[0]["requests"]
@@ -189,5 +187,12 @@ class _AggregateMetrics:
             k: (sum(s["tokens"][k] for s in snaps)
                 if isinstance(snaps[0]["tokens"][k], (int, float)) else 0)
             for k in snaps[0]["tokens"]
+        }
+        agg["engine"] = {
+            "active": sum(s["engine"]["active"] for s in snaps),
+            "waiting": sum(s["engine"]["waiting"] for s in snaps),
+            "pages_total": sum(s["engine"]["pages_total"] for s in snaps),
+            "pages_free": sum(s["engine"]["pages_free"] for s in snaps),
+            "pages_in_use": sum(s["engine"]["pages_in_use"] for s in snaps),
         }
         return agg

@@ -239,7 +239,10 @@ class InferenceEngine:
         ps = self.ecfg.page_size
         self.pool = PagePool(self.ecfg.num_pages, ps)
         k_pool, v_pool = make_kv_pool_arrays(cfg, self.ecfg.num_pages, ps, kv_dtype)
-        if mesh is not None and mesh.size > 1:
+        if mesh is not None:
+            # placement happens for ANY mesh, including a 1-device one —
+            # that is how DP replicas pin themselves to their own device
+            # slice (runtime/dp_router.py)
             from ..parallel.sharding import shard_kv_pool, shard_params
 
             self.params = shard_params(params, cfg, mesh)

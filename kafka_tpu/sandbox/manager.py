@@ -130,7 +130,14 @@ class SandboxManager:
         sandbox_id = await self.db.get_thread_sandbox_id(thread_id)
         if not sandbox_id:
             return None
-        sandbox = await self.factory.connect(sandbox_id)
+        try:
+            sandbox = await self.factory.connect(sandbox_id)
+        except SandboxError as e:
+            # transient control-plane failure on a POLLING path: report
+            # "not ready yet" so LazySandbox keeps retrying until its
+            # deadline — the binding (and the VM) must survive the blip
+            logger.warning("connect for %s not ready: %s", thread_id, e)
+            return None
         if sandbox is None:
             return None
         status = await sandbox.check_health()

@@ -289,13 +289,18 @@ class TestConfigReplaceContract:
             await db.set_thread_config("t", {"model": "m1", "user_id": "u1"})
             cfg = await db.get_thread_config("t")
             assert cfg["model"] == "m1" and cfg["user_id"] == "u1"
-            # replace with a dict lacking those keys: both must clear
+            # overlay replaced wholesale (model clears); the deployment-
+            # managed link column survives a write that omits it
             await db.set_thread_config("t", {"global_prompt": "p"})
             cfg = await db.get_thread_config("t")
             assert cfg.get("model") is None
-            assert cfg["user_id"] is None
+            assert cfg["user_id"] == "u1"
             assert cfg["global_prompt"] == "p"
-            # None clears everything
+            # explicit null detaches a link
+            await db.set_thread_config("t", {"user_id": None})
+            cfg = await db.get_thread_config("t")
+            assert cfg["user_id"] is None
+            # None clears the overlay
             await db.set_thread_config("t", None)
             cfg = await db.get_thread_config("t")
             assert cfg.get("global_prompt") is None
