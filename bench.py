@@ -63,7 +63,10 @@ def main() -> None:
             2, -(-(args.prompt_len + args.gen_len + 16) // 16)
         ),
     )
-    ecfg.num_pages = args.batch * ecfg.max_pages_per_seq + 1
+    # pool sized for active batch AND the prefix caches of the concurrent-
+    # thread phase — an undersized pool measures reclaim churn, not the
+    # engine (~300 MB of KV for the 1B default: deployment-realistic)
+    ecfg.num_pages = 3 * args.batch * ecfg.max_pages_per_seq + 1
     engine = InferenceEngine(cfg, params, ecfg)
 
     rng = __import__("random").Random(0)
