@@ -47,21 +47,25 @@ class DataParallelEngines:
         engine_cfg: EngineConfig,
         dp: int,
         tp: int = 1,
+        sp: int = 1,
         kv_dtype=None,
         devices: Optional[List[jax.Device]] = None,
     ):
         devices = list(devices if devices is not None else jax.devices())
-        need = dp * tp
+        per = tp * sp
+        need = dp * per
         if len(devices) < need:
             raise ValueError(
-                f"dp={dp} x tp={tp} needs {need} devices, have {len(devices)}"
+                f"dp={dp} x sp={sp} x tp={tp} needs {need} devices, "
+                f"have {len(devices)}"
             )
         self.engines: List[InferenceEngine] = []
         for r in range(dp):
-            slice_devices = devices[r * tp : (r + 1) * tp]
+            slice_devices = devices[r * per : (r + 1) * per]
             # a mesh over exactly this replica's devices pins its params
-            # and KV pool there (the engine places for any provided mesh)
-            mesh = make_mesh(MeshConfig(tp=tp), devices=slice_devices)
+            # and KV pool there (the engine places for any provided mesh);
+            # sp>1 replicas run ring-sharded chunked prefill internally
+            mesh = make_mesh(MeshConfig(sp=sp, tp=tp), devices=slice_devices)
             self.engines.append(
                 InferenceEngine(
                     cfg, params, engine_cfg, kv_dtype=kv_dtype, mesh=mesh
@@ -171,22 +175,43 @@ class _AggregateMetrics:
         self._engines = engines
 
     def snapshot(self, engine=None) -> Dict[str, Any]:
+        from .metrics import _copy_samples, _percentiles
+
         snaps = [e.metrics.snapshot(e) for e in self._engines]
         agg: Dict[str, Any] = {
             "dp": len(snaps),
-            "replicas": snaps,  # per-replica detail incl. latency hists
+            "replicas": snaps,  # per-replica detail
             "uptime_s": snaps[0]["uptime_s"],
         }
-        # summable counters aggregate; latency percentiles stay per-replica
-        # (summing histograms would misrepresent them)
+        # summable counters aggregate
         agg["requests"] = {
             k: sum(s["requests"][k] for s in snaps)
             for k in snaps[0]["requests"]
         }
         agg["tokens"] = {
-            k: (sum(s["tokens"][k] for s in snaps)
-                if isinstance(snaps[0]["tokens"][k], (int, float)) else 0)
-            for k in snaps[0]["tokens"]
+            "prompt": sum(s["tokens"]["prompt"] for s in snaps),
+            "generated": sum(s["tokens"]["generated"] for s in snaps),
+            # rates sum across replicas (each is tokens over the same wall
+            # clock), ratios do not — recompute anything derived
+            "generated_per_s": round(
+                sum(s["tokens"]["generated_per_s"] for s in snaps), 2
+            ),
+        }
+        # latency percentiles cannot be combined from per-replica
+        # percentiles — pool the raw samples and recompute
+        ttft = [v for e in self._engines
+                for v in _copy_samples(e.metrics.ttft_ms)]
+        tpot = [v for e in self._engines
+                for v in _copy_samples(e.metrics.tpot_ms)]
+        agg["ttft_ms"] = {k: round(v, 2)
+                          for k, v in _percentiles(ttft).items()}
+        agg["tpot_ms"] = {k: round(v, 2)
+                          for k, v in _percentiles(tpot).items()}
+        steps = sum(s["decode"]["steps"] for s in snaps)
+        busy = sum(e.metrics.decode_busy_slots for e in self._engines)
+        agg["decode"] = {
+            "steps": steps,
+            "batch_occupancy": round(busy / steps, 3) if steps else 0.0,
         }
         agg["engine"] = {
             "active": sum(s["engine"]["active"] for s in snaps),
@@ -195,4 +220,9 @@ class _AggregateMetrics:
             "pages_free": sum(s["engine"]["pages_free"] for s in snaps),
             "pages_in_use": sum(s["engine"]["pages_in_use"] for s in snaps),
         }
+        if all("prefix_cache" in s for s in snaps):
+            agg["prefix_cache"] = {
+                k: sum(s["prefix_cache"][k] for s in snaps)
+                for k in snaps[0]["prefix_cache"]
+            }
         return agg

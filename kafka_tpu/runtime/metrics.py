@@ -19,6 +19,22 @@ import time
 from typing import Deque, Dict, List, Optional
 
 
+def _copy_samples(dq) -> List[float]:
+    """Snapshot a histogram deque that another thread may be appending to.
+
+    CPython deque iteration raises RuntimeError if the owner (the engine
+    thread) appends mid-copy — even at maxlen.  Reads are torn-tolerant by
+    design, so just retry; losing a snapshot entirely is the only failure
+    worth avoiding.
+    """
+    for _ in range(8):
+        try:
+            return list(dq)
+        except RuntimeError:
+            continue
+    return []
+
+
 def _percentiles(samples: List[float], pts=(50, 90, 99)) -> Dict[str, float]:
     if not samples:
         return {f"p{p}": 0.0 for p in pts}
@@ -110,9 +126,9 @@ class EngineMetrics:
                 if up > 0 else 0.0,
             },
             "ttft_ms": {k: round(v, 2) for k, v in
-                        _percentiles(list(self.ttft_ms)).items()},
+                        _percentiles(_copy_samples(self.ttft_ms)).items()},
             "tpot_ms": {k: round(v, 2) for k, v in
-                        _percentiles(list(self.tpot_ms)).items()},
+                        _percentiles(_copy_samples(self.tpot_ms)).items()},
             "decode": {
                 "steps": self.decode_steps,
                 "batch_occupancy": round(

@@ -17,6 +17,10 @@ def main() -> None:
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--db-path", default=None)
     p.add_argument("--tp-size", type=int, default=None)
+    p.add_argument("--sp-size", type=int, default=None,
+                   help="sequence-parallel ring width for long-prompt prefill")
+    p.add_argument("--dp-size", type=int, default=None,
+                   help="data-parallel engine replicas (dp*sp*tp devices)")
     p.add_argument("--max-batch", type=int, default=None)
     p.add_argument("--tiny-model", action="store_true",
                    help="serve a tiny random-weight model (dev/demo)")
@@ -35,6 +39,10 @@ def main() -> None:
         overrides["db_path"] = args.db_path
     if args.tp_size is not None:
         overrides["tp_size"] = args.tp_size
+    if args.sp_size is not None:
+        overrides["sp_size"] = args.sp_size
+    if args.dp_size is not None:
+        overrides["dp_size"] = args.dp_size
     if args.max_batch is not None:
         overrides["max_batch"] = args.max_batch
     if args.tiny_model:

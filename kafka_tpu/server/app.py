@@ -57,13 +57,34 @@ STATE_KEY = web.AppKey("kafka_tpu_state", dict)
 
 
 def build_tpu_provider(cfg: ServingConfig) -> LLMProvider:
-    """Construct tokenizer + engine + provider per the serving config."""
+    """Construct tokenizer + engine + provider per the serving config.
+
+    Parallelism wiring (the reference wired its whole stack in the server
+    lifespan, server.py:89-150 — here the mesh shape is the analog):
+    tp/sp build one SPMD engine over a tp×sp mesh; dp>1 builds dp replica
+    engines over disjoint tp×sp device slices behind the thread-affinity
+    router (runtime/dp_router.py).  Multi-host topologies initialize
+    jax.distributed first (env-driven, no-op single-process).
+
+    Multi-host + dp: replicas are per-process objects (each owns a Python
+    scheduler thread), so each server process builds its replicas over its
+    own *local* chips and an external load balancer spreads traffic across
+    the hosts — dp_size here is replicas per host.  tp/sp SPMD engines, by
+    contrast, span the global device set the way jax.distributed programs
+    do.
+    """
     import jax
 
     from ..llm.tpu_provider import TPULLMProvider
     from ..models import get_config, init_params, load_checkpoint
     from ..models.tokenizer import ByteTokenizer, load_tokenizer
+    from ..parallel.distributed import init_distributed
     from ..runtime import EngineConfig, InferenceEngine
+
+    # before any backend use: multi-host init when KAFKA_TPU_COORDINATOR /
+    # NUM_PROCESSES are set (SURVEY §2.2 "distributed communication
+    # backend"); returns False and costs nothing single-process
+    init_distributed()
 
     if cfg.checkpoint_dir:
         tokenizer = load_tokenizer(cfg.checkpoint_dir)
@@ -81,24 +102,35 @@ def build_tpu_provider(cfg: ServingConfig) -> LLMProvider:
         )
         params = init_params(model_cfg, jax.random.PRNGKey(0))
 
-    mesh = None
-    if cfg.tp_size > 1:
-        from ..parallel import MeshConfig, make_mesh
-
-        mesh = make_mesh(MeshConfig(tp=cfg.tp_size))
-    engine = InferenceEngine(
-        model_cfg,
-        params,
-        EngineConfig(
-            max_batch=cfg.max_batch,
-            page_size=cfg.page_size,
-            num_pages=cfg.num_pages,
-            max_pages_per_seq=cfg.max_pages_per_seq,
-            prefill_buckets=cfg.prefill_buckets,
-            max_new_tokens_default=cfg.max_new_tokens_default,
-        ),
-        mesh=mesh,
+    engine_cfg = EngineConfig(
+        max_batch=cfg.max_batch,
+        page_size=cfg.page_size,
+        num_pages=cfg.num_pages,
+        max_pages_per_seq=cfg.max_pages_per_seq,
+        prefill_buckets=cfg.prefill_buckets,
+        max_new_tokens_default=cfg.max_new_tokens_default,
     )
+    if cfg.dp_size > 1:
+        from ..runtime.dp_router import DataParallelEngines
+
+        # replica engines cannot place params onto another host's
+        # (non-addressable) devices — under multi-host init each process
+        # builds dp replicas over its own chips (see docstring)
+        local = (
+            jax.local_devices() if jax.process_count() > 1 else None
+        )
+        engine = DataParallelEngines(
+            model_cfg, params, engine_cfg,
+            dp=cfg.dp_size, tp=cfg.tp_size, sp=cfg.sp_size,
+            devices=local,
+        )
+    else:
+        mesh = None
+        if cfg.tp_size > 1 or cfg.sp_size > 1:
+            from ..parallel import MeshConfig, make_mesh
+
+            mesh = make_mesh(MeshConfig(sp=cfg.sp_size, tp=cfg.tp_size))
+        engine = InferenceEngine(model_cfg, params, engine_cfg, mesh=mesh)
     return TPULLMProvider(engine, tokenizer, model_name=cfg.model_name)
 
 
@@ -489,12 +521,17 @@ async def health(request: web.Request) -> web.Response:
     }
     engine = getattr(llm, "engine", None)
     if engine is not None:
+        # DataParallelEngines exposes .engines; a single engine is its own
+        # one-element "replica set" so the page math below is uniform
+        replicas = getattr(engine, "engines", [engine])
         payload["engine"] = {
             "active": engine.num_active,
             "waiting": len(engine.waiting),
-            "free_pages": engine.pool.free_pages,
-            "total_pages": engine.pool.num_pages,
+            "free_pages": sum(e.pool.free_pages for e in replicas),
+            "total_pages": sum(e.pool.num_pages for e in replicas),
         }
+        if len(replicas) > 1:
+            payload["engine"]["dp"] = len(replicas)
     return web.json_response(payload)
 
 

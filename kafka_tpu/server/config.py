@@ -25,8 +25,16 @@ class ServingConfig:
     max_pages_per_seq: int = 512
     prefill_buckets: Tuple[int, ...] = (64, 128, 256, 512, 1024, 2048)
     max_new_tokens_default: int = 1024
-    # parallelism: devices used for tensor parallelism (1 = single chip)
+    # parallelism (SURVEY §2.2): the server builds its mesh from these.
+    #   tp — tensor parallel within each engine (attention heads / MLP)
+    #   sp — sequence parallel: ring-sharded chunked prefill for long
+    #        prompts, composed with tp inside the same engine
+    #   dp — data parallel: dp independent engine replicas, each over its
+    #        own tp*sp device slice, with thread-affinity request routing
+    #        (runtime/dp_router.py).  dp*sp*tp devices total.
     tp_size: int = 1
+    sp_size: int = 1
+    dp_size: int = 1
     # server
     host: str = "0.0.0.0"
     port: int = 8000
@@ -44,13 +52,20 @@ class ServingConfig:
             raw = env.get(f"KAFKA_TPU_{name}")
             return cast(raw) if raw is not None else default
 
+        def get_axis(name: str, default: int) -> int:
+            # both spellings work: KAFKA_TPU_DP_SIZE=2 and KAFKA_TPU_DP=2
+            raw = env.get(f"KAFKA_TPU_{name}_SIZE", env.get(f"KAFKA_TPU_{name}"))
+            return int(raw) if raw is not None else default
+
         cfg = cls(
             model_name=get("MODEL", cls.model_name),
             checkpoint_dir=get("CHECKPOINT_DIR", None),
             max_batch=get("MAX_BATCH", cls.max_batch, int),
             num_pages=get("NUM_PAGES", cls.num_pages, int),
             max_pages_per_seq=get("MAX_PAGES_PER_SEQ", cls.max_pages_per_seq, int),
-            tp_size=get("TP_SIZE", cls.tp_size, int),
+            tp_size=get_axis("TP", cls.tp_size),
+            sp_size=get_axis("SP", cls.sp_size),
+            dp_size=get_axis("DP", cls.dp_size),
             host=get("HOST", cls.host),
             port=get("PORT", cls.port, int),
             db_path=get("DB_PATH", cls.db_path),

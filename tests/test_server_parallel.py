@@ -1,0 +1,176 @@
+"""End-to-end server tests for the parallelism wiring.
+
+Round-2 verdict item 1: dp/sp must be reachable *product* surface, not
+library objects — these tests boot the real server stack (create_app →
+build_tpu_provider → DataParallelEngines / sp-mesh engine) from a
+ServingConfig alone on the 8-device virtual CPU mesh (conftest), then
+serve actual completions through HTTP.
+"""
+
+import asyncio
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from kafka_tpu.server import ServingConfig, create_app
+from kafka_tpu.server.app import STATE_KEY
+
+
+def _cfg(tmp_path, **kw):
+    # the full agent system prompt is ~700 tokens (ByteTokenizer), so the
+    # window must hold a real conversation: 128 pages x 16 = 2048 tokens
+    base = dict(
+        tiny_model=True,
+        db_path=str(tmp_path / "threads.db"),
+        max_batch=2,
+        page_size=16,
+        num_pages=320,
+        max_pages_per_seq=128,
+        prefill_buckets=(256,),
+        max_new_tokens_default=8,
+    )
+    base.update(kw)
+    return ServingConfig(**base)
+
+
+async def _boot(cfg) -> TestClient:
+    app = await create_app(cfg=cfg, tools=[], mcp_servers=[])
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client
+
+
+def _engine(client):
+    return client.server.app[STATE_KEY]["llm"].engine
+
+
+class TestDPServing:
+    """KAFKA_TPU_DP=2 x TP=2: replica engines built by the server itself."""
+
+    def test_dp2_tp2_end_to_end(self, tmp_path):
+        async def run():
+            client = await _boot(_cfg(tmp_path, dp_size=2, tp_size=2))
+            try:
+                engine = _engine(client)
+                # the server built the DP router, replicas on disjoint slices
+                assert len(engine.engines) == 2
+                d0 = {d for d in engine.engines[0].mesh.devices.flat}
+                d1 = {d for d in engine.engines[1].mesh.devices.flat}
+                assert len(d0) == 2 and len(d1) == 2 and not (d0 & d1)
+
+                resp = await client.post(
+                    "/v1/chat/completions",
+                    json={
+                        "model": "tiny",
+                        "messages": [{"role": "user", "content": "hi"}],
+                        "stream": False,
+                        "max_tokens": 4,
+                    },
+                )
+                assert resp.status == 200
+                body = await resp.json()
+                assert body["object"] == "chat.completion"
+                assert body["choices"][0]["message"]["role"] == "assistant"
+
+                # /metrics aggregates over replicas
+                m = await (await client.get("/metrics")).json()
+                assert m["dp"] == 2
+                assert len(m["replicas"]) == 2
+                assert m["requests"]["finished"] >= 1
+                assert m["engine"]["pages_total"] == 2 * 320
+                # pooled latency percentiles, not zeroed placeholders
+                assert m["ttft_ms"]["p50"] > 0
+
+                h = await (await client.get("/health")).json()
+                assert h["engine"]["dp"] == 2
+                assert h["engine"]["total_pages"] == 2 * 320
+            finally:
+                await client.close()
+
+        asyncio.run(run())
+
+    def test_thread_affinity_through_server(self, tmp_path):
+        """Two turns on one thread route to the same replica and hit its
+        prefix cache (BASELINE config 2 composed with DP)."""
+
+        async def run():
+            client = await _boot(_cfg(tmp_path, dp_size=2, tp_size=1))
+            try:
+                engine = _engine(client)
+                resp = await client.post("/v1/threads", json={})
+                tid = (await resp.json())["thread_id"]
+                for _ in range(2):
+                    resp = await client.post(
+                        f"/v1/threads/{tid}/chat/completions",
+                        json={
+                            "model": "tiny",
+                            "messages": [{"role": "user", "content": "go"}],
+                            "stream": False,
+                            "max_tokens": 4,
+                        },
+                    )
+                    assert resp.status == 200
+                assert tid in engine._affinity
+                replica = engine._affinity[tid]
+                assert engine.engines[replica].prefix_cache.hits >= 1
+                other = engine.engines[1 - replica]
+                assert other.metrics.requests_finished == 0
+            finally:
+                await client.close()
+
+        asyncio.run(run())
+
+
+class TestSPServing:
+    """sp ring-prefill engine reachable straight from ServingConfig."""
+
+    def test_sp2_tp2_end_to_end(self, tmp_path):
+        async def run():
+            client = await _boot(_cfg(tmp_path, sp_size=2, tp_size=2))
+            try:
+                engine = _engine(client)
+                assert engine.mesh.shape["sp"] == 2
+                assert engine.mesh.shape["tp"] == 2
+                assert engine.cfg.prefill_ring  # ring prefill is active
+                resp = await client.post(
+                    "/v1/chat/completions",
+                    json={
+                        "model": "tiny",
+                        "messages": [
+                            {"role": "user", "content": "tell me a story"}
+                        ],
+                        "stream": False,
+                        "max_tokens": 4,
+                    },
+                )
+                assert resp.status == 200
+                body = await resp.json()
+                assert body["choices"][0]["finish_reason"] == "stop"
+            finally:
+                await client.close()
+
+        asyncio.run(run())
+
+
+class TestParallelConfig:
+    def test_env_spellings(self, monkeypatch):
+        monkeypatch.setenv("KAFKA_TPU_DP", "2")
+        monkeypatch.setenv("KAFKA_TPU_SP_SIZE", "4")
+        monkeypatch.setenv("KAFKA_TPU_TP_SIZE", "2")
+        cfg = ServingConfig.from_env()
+        assert (cfg.dp_size, cfg.sp_size, cfg.tp_size) == (2, 4, 2)
+
+    def test_size_suffix_wins_over_short(self, monkeypatch):
+        monkeypatch.setenv("KAFKA_TPU_DP", "8")
+        monkeypatch.setenv("KAFKA_TPU_DP_SIZE", "2")
+        assert ServingConfig.from_env().dp_size == 2
+
+    def test_too_many_devices_is_a_clear_error(self, tmp_path):
+        async def run():
+            with pytest.raises(ValueError, match="devices"):
+                await create_app(
+                    cfg=_cfg(tmp_path, dp_size=8, tp_size=2),
+                    tools=[], mcp_servers=[],
+                )
+
+        asyncio.run(run())
