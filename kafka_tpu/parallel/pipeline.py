@@ -19,6 +19,16 @@ stage structure later.
 
 Composes with TP: give the mesh both axes (pp outer, tp inner) and the
 per-stage weights follow the usual Megatron specs within each stage.
+
+Two entry points:
+
+* `pp_forward` — uncached forward (numerics reference, offline scoring).
+* `pp_forward_paged` — the *serving* path: same stage structure but every
+  stage reads/writes its local shard of the engine's paged KV pool
+  ([L, SLOTS, Hkv*D] with L sharded over "pp", kv heads over "tp"), so
+  the continuous-batching engine (runtime/engine.py) drives prefill and
+  decode through pipeline stages exactly as it does TP — each device
+  holds 1/pp of the weights AND 1/pp of the KV cache.
 """
 
 from __future__ import annotations
@@ -75,6 +85,132 @@ def shard_params_pp(params: Params, cfg: ModelConfig, mesh: Mesh) -> Params:
     )
 
 
+def _check_pp_divisibility(cfg: ModelConfig, pp: int, tp: int) -> None:
+    if cfg.num_layers % pp:
+        raise ValueError(
+            f"num_layers {cfg.num_layers} not divisible by pp={pp}"
+        )
+    if tp > 1 and (cfg.num_heads % tp or cfg.num_kv_heads % tp):
+        raise ValueError(
+            f"pp x tp compose needs tp={tp} to divide heads "
+            f"({cfg.num_heads}) and kv heads ({cfg.num_kv_heads})"
+        )
+
+
+def kv_pool_spec_pp(cfg: ModelConfig, mesh: Mesh) -> P:
+    """[L, SLOTS, Hkv*D] pool with layers staged over "pp": each device
+    caches only its own stage's layers (and its tp shard of heads) — the
+    KV memory follows the weights, which is what lets a model bigger than
+    one device's HBM actually *serve*."""
+    from .sharding import _kv_axis
+
+    return P("pp", None, _kv_axis(cfg, mesh))
+
+
+def pp_forward_paged(
+    params: Params,
+    cfg: ModelConfig,
+    token_ids: jnp.ndarray,
+    positions: jnp.ndarray,
+    k_pool: jnp.ndarray,
+    v_pool: jnp.ndarray,
+    paged,
+    mesh: Mesh,
+):
+    """Stage-sharded forward against the paged KV pool (the serving path).
+
+    Same index-plan contract as models.forward's paged mode: `paged` is a
+    runtime PagedView whose write_idx/read_idx/kv_valid arrays address the
+    flat slot axis; k_pool/v_pool are [L, SLOTS, Hkv*D] placed per
+    `kv_pool_spec_pp`.  Returns (logits [B, S, V] f32, k_pool', v_pool').
+
+    Stage s computes its layers (reading/writing its local pool shard),
+    the hidden state ppermutes to stage s+1, and the last stage's output
+    is broadcast for the (replicated) logits head.  Attention inside a
+    stage is the XLA gather formulation with heads tp-local and explicit
+    psums after the row-parallel projections — identical math to the
+    engine's TP path, so outputs are token-exact vs a single device.
+    """
+    pp = mesh.shape.get("pp", 1)
+    tp = mesh.shape.get("tp", 1)
+    _check_pp_divisibility(cfg, pp, tp)
+
+    x = params["embed"][token_ids].astype(cfg.activation_dtype)
+    inv_freq = rope_frequencies(cfg)
+    cos, sin = rope_cos_sin(positions, inv_freq)
+
+    def per_shard(layer_params, kp, vp, h, cos, sin, pos,
+                  write_idx, read_idx, kv_positions, kv_valid):
+        rank = lax.axis_index("pp")
+
+        def tp_reduce(t):
+            return lax.psum(t, "tp") if tp > 1 else t
+
+        # Same index-plan contract as the engine's TP path, minus the
+        # pallas/ring fields (page_table=None selects _attention_block's
+        # XLA gather branch — the only backend legal on a pp mesh).
+        from ..models.llama import PagedView
+
+        paged_local = PagedView(write_idx, read_idx, kv_positions, kv_valid)
+
+        def run_stage(operand):
+            h, kp, vp = operand
+
+            def body(hh, scanned):
+                lp, kc, vc = scanned
+                attn_in = rms_norm(hh, lp["ln_attn"], cfg.rms_norm_eps)
+                attn_out, kc, vc = _attention_block(
+                    attn_in, lp, cfg, cos, sin, pos, kc, vc,
+                    None, None, paged_local, None,
+                )
+                hh = hh + tp_reduce(attn_out)
+                mlp_in = rms_norm(hh, lp["ln_mlp"], cfg.rms_norm_eps)
+                hh = hh + tp_reduce(_mlp_block(mlp_in, lp))
+                return hh, (kc, vc)
+
+            h2, (k_new, v_new) = lax.scan(body, h, (layer_params, kp, vp))
+            return h2, k_new, v_new
+
+        h = lax.pcast(h, ("pp", "tp"), to="varying")
+        for s in range(pp):  # sequential stages; only rank s computes
+            h, kp, vp = lax.cond(
+                rank == s, run_stage, lambda op: op, (h, kp, vp)
+            )
+            if s + 1 < pp:
+                h = lax.ppermute(h, "pp", [(s, s + 1)])
+        # broadcast the last stage's hidden state (see pp_forward)
+        tp_rank = lax.axis_index("tp")
+        keep = (rank == pp - 1) & (tp_rank == 0)
+        h = lax.psum(jnp.where(keep, h, jnp.zeros_like(h)), ("pp", "tp"))
+        return h, kp, vp
+
+    layer_specs = pp_param_specs(cfg, mesh)["layers"]
+    pool_spec = kv_pool_spec_pp(cfg, mesh)
+    fn = jax.shard_map(
+        per_shard,
+        mesh=mesh,
+        in_specs=(layer_specs, pool_spec, pool_spec,
+                  P(), P(), P(), P(), P(), P(), P(), P()),
+        out_specs=(P(), pool_spec, pool_spec),
+    )
+    h, k_pool, v_pool = fn(
+        params["layers"], k_pool, v_pool, x, cos, sin, positions,
+        paged.write_idx, paged.read_idx, paged.kv_positions, paged.kv_valid,
+    )
+    h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
+    if cfg.tie_word_embeddings:
+        logits = jnp.einsum(
+            "bsh,vh->bsv", h, params["embed"],
+            preferred_element_type=jnp.float32,
+        )
+    else:
+        logits = jnp.einsum(
+            "bsh,hv->bsv", h, params["lm_head"],
+            preferred_element_type=jnp.float32,
+        )
+    return logits, k_pool, v_pool
+
+
 def pp_forward(
     params: Params,
     cfg: ModelConfig,
@@ -89,14 +225,7 @@ def pp_forward(
     """
     pp = mesh.shape.get("pp", 1)
     tp = mesh.shape.get("tp", 1)
-    L = cfg.num_layers
-    if L % pp:
-        raise ValueError(f"num_layers {L} not divisible by pp={pp}")
-    if tp > 1 and (cfg.num_heads % tp or cfg.num_kv_heads % tp):
-        raise ValueError(
-            f"pp x tp compose needs tp={tp} to divide heads "
-            f"({cfg.num_heads}) and kv heads ({cfg.num_kv_heads})"
-        )
+    _check_pp_divisibility(cfg, pp, tp)
 
     def per_shard(layer_params, x, cos, sin, pos):
         # layer_params: this rank's [L/pp, ...] stage slice, heads/hidden

@@ -201,6 +201,17 @@ class InferenceEngine:
         self.ecfg = engine_cfg or EngineConfig()
         self.mesh = mesh
         sp = mesh.shape.get("sp", 1) if mesh is not None else 1
+        self._pp = mesh.shape.get("pp", 1) if mesh is not None else 1
+        if self._pp > 1:
+            if sp > 1:
+                raise ValueError(
+                    "pp does not compose with sp ring prefill yet: use "
+                    "pp x tp (stage-sharded serving) or sp x tp (ring "
+                    "long-context) meshes"
+                )
+            from ..parallel.pipeline import _check_pp_divisibility
+
+            _check_pp_divisibility(cfg, self._pp, mesh.shape.get("tp", 1))
         if sp > 1:
             bad = [b for b in self.ecfg.prefill_buckets if b % sp]
             if bad:
@@ -243,10 +254,24 @@ class InferenceEngine:
             # placement happens for ANY mesh, including a 1-device one —
             # that is how DP replicas pin themselves to their own device
             # slice (runtime/dp_router.py)
-            from ..parallel.sharding import shard_kv_pool, shard_params
+            if self._pp > 1:
+                # stage-sharded: each device holds 1/(pp*tp) of weights AND
+                # its stage's shard of the KV pool (parallel/pipeline.py)
+                from ..parallel.pipeline import kv_pool_spec_pp, shard_params_pp
 
-            self.params = shard_params(params, cfg, mesh)
-            self.k_pool, self.v_pool = shard_kv_pool(k_pool, v_pool, cfg, mesh)
+                self.params = shard_params_pp(params, cfg, mesh)
+                pool_sh = jax.sharding.NamedSharding(
+                    mesh, kv_pool_spec_pp(cfg, mesh)
+                )
+                self.k_pool = jax.device_put(k_pool, pool_sh)
+                self.v_pool = jax.device_put(v_pool, pool_sh)
+            else:
+                from ..parallel.sharding import shard_kv_pool, shard_params
+
+                self.params = shard_params(params, cfg, mesh)
+                self.k_pool, self.v_pool = shard_kv_pool(
+                    k_pool, v_pool, cfg, mesh
+                )
             self._replicated = jax.sharding.NamedSharding(
                 mesh, jax.sharding.PartitionSpec()
             )
@@ -316,7 +341,7 @@ class InferenceEngine:
     # ------------------------------------------------------------------
 
     def _build_decode_fn(self):
-        cfg, ecfg = self.cfg, self.ecfg
+        cfg, ecfg, mesh, pp = self.cfg, self.ecfg, self.mesh, self._pp
         ps, C, B = ecfg.page_size, ecfg.max_window, ecfg.max_batch
         cache_key = ("decode", cfg, ps, C, B, self.mesh)
         if cache_key in _FN_CACHE:
@@ -339,10 +364,19 @@ class InferenceEngine:
                 page_table=page_table, seq_lens=seq_lens, page_size=ps,
             )
 
-            logits, cache = forward(
-                params, cfg, last_tokens[:, None], positions,
-                kv_cache=KVCache(k_pool, v_pool), paged=paged,
-            )
+            if pp > 1:
+                from ..parallel.pipeline import pp_forward_paged
+
+                logits, k_new, v_new = pp_forward_paged(
+                    params, cfg, last_tokens[:, None], positions,
+                    k_pool, v_pool, paged, mesh,
+                )
+                cache = KVCache(k_new, v_new)
+            else:
+                logits, cache = forward(
+                    params, cfg, last_tokens[:, None], positions,
+                    kv_cache=KVCache(k_pool, v_pool), paged=paged,
+                )
             logits = logits[:, 0]
             keys = jax.vmap(
                 lambda s, p: jax.random.fold_in(jax.random.key(s), p)
@@ -360,7 +394,7 @@ class InferenceEngine:
     def _get_prefill_fn(self, bucket: int):
         if bucket in self._prefill_fns:
             return self._prefill_fns[bucket]
-        cfg, ecfg, mesh = self.cfg, self.ecfg, self.mesh
+        cfg, ecfg, mesh, pp = self.cfg, self.ecfg, self.mesh, self._pp
         ps, C, P = ecfg.page_size, ecfg.max_window, ecfg.max_pages_per_seq
         cache_key = ("prefill", cfg, bucket, ps, C, P, self.mesh)
         if cache_key in _FN_CACHE:
@@ -388,10 +422,19 @@ class InferenceEngine:
                 start=start, chunk_len=chunk_len,
             )
 
-            logits, cache = forward(
-                params, cfg, chunk[None, :], positions,
-                kv_cache=KVCache(k_pool, v_pool), paged=paged, mesh=mesh,
-            )
+            if pp > 1:
+                from ..parallel.pipeline import pp_forward_paged
+
+                logits, k_new, v_new = pp_forward_paged(
+                    params, cfg, chunk[None, :], positions,
+                    k_pool, v_pool, paged, mesh,
+                )
+                cache = KVCache(k_new, v_new)
+            else:
+                logits, cache = forward(
+                    params, cfg, chunk[None, :], positions,
+                    kv_cache=KVCache(k_pool, v_pool), paged=paged, mesh=mesh,
+                )
             last = jnp.clip(chunk_len - 1, 0, S - 1)
             final_logits = logits[0, last][None, :]  # [1, V]
             sp = SamplingParams(

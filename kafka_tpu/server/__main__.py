@@ -19,6 +19,8 @@ def main() -> None:
     p.add_argument("--tp-size", type=int, default=None)
     p.add_argument("--sp-size", type=int, default=None,
                    help="sequence-parallel ring width for long-prompt prefill")
+    p.add_argument("--pp-size", type=int, default=None,
+                   help="pipeline stages for models exceeding one slice's HBM")
     p.add_argument("--dp-size", type=int, default=None,
                    help="data-parallel engine replicas (dp*sp*tp devices)")
     p.add_argument("--max-batch", type=int, default=None)
@@ -41,6 +43,8 @@ def main() -> None:
         overrides["tp_size"] = args.tp_size
     if args.sp_size is not None:
         overrides["sp_size"] = args.sp_size
+    if args.pp_size is not None:
+        overrides["pp_size"] = args.pp_size
     if args.dp_size is not None:
         overrides["dp_size"] = args.dp_size
     if args.max_batch is not None:

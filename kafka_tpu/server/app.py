@@ -111,6 +111,12 @@ def build_tpu_provider(cfg: ServingConfig) -> LLMProvider:
         max_new_tokens_default=cfg.max_new_tokens_default,
     )
     if cfg.dp_size > 1:
+        if cfg.pp_size > 1:
+            raise ValueError(
+                "dp_size and pp_size cannot compose: DP replicates whole "
+                "engines while PP exists to fit a model that does NOT fit "
+                "a replica — pick one"
+            )
         from ..runtime.dp_router import DataParallelEngines
 
         # replica engines cannot place params onto another host's
@@ -126,10 +132,12 @@ def build_tpu_provider(cfg: ServingConfig) -> LLMProvider:
         )
     else:
         mesh = None
-        if cfg.tp_size > 1 or cfg.sp_size > 1:
+        if cfg.tp_size > 1 or cfg.sp_size > 1 or cfg.pp_size > 1:
             from ..parallel import MeshConfig, make_mesh
 
-            mesh = make_mesh(MeshConfig(sp=cfg.sp_size, tp=cfg.tp_size))
+            mesh = make_mesh(MeshConfig(
+                pp=cfg.pp_size, sp=cfg.sp_size, tp=cfg.tp_size
+            ))
         engine = InferenceEngine(model_cfg, params, engine_cfg, mesh=mesh)
     return TPULLMProvider(engine, tokenizer, model_name=cfg.model_name)
 

@@ -29,11 +29,15 @@ class ServingConfig:
     #   tp — tensor parallel within each engine (attention heads / MLP)
     #   sp — sequence parallel: ring-sharded chunked prefill for long
     #        prompts, composed with tp inside the same engine
+    #   pp — pipeline parallel: layer stages sharded across devices for
+    #        models exceeding one slice's HBM (parallel/pipeline.py);
+    #        composes with tp, not with sp or dp
     #   dp — data parallel: dp independent engine replicas, each over its
     #        own tp*sp device slice, with thread-affinity request routing
-    #        (runtime/dp_router.py).  dp*sp*tp devices total.
+    #        (runtime/dp_router.py).  dp*pp*sp*tp devices total.
     tp_size: int = 1
     sp_size: int = 1
+    pp_size: int = 1
     dp_size: int = 1
     # server
     host: str = "0.0.0.0"
@@ -65,6 +69,7 @@ class ServingConfig:
             max_pages_per_seq=get("MAX_PAGES_PER_SEQ", cls.max_pages_per_seq, int),
             tp_size=get_axis("TP", cls.tp_size),
             sp_size=get_axis("SP", cls.sp_size),
+            pp_size=get_axis("PP", cls.pp_size),
             dp_size=get_axis("DP", cls.dp_size),
             host=get("HOST", cls.host),
             port=get("PORT", cls.port, int),

@@ -152,6 +152,44 @@ class TestSPServing:
         asyncio.run(run())
 
 
+class TestPPServing:
+    """pp stage-sharded engine reachable straight from ServingConfig."""
+
+    def test_pp2_tp2_end_to_end(self, tmp_path):
+        async def run():
+            client = await _boot(_cfg(tmp_path, pp_size=2, tp_size=2))
+            try:
+                engine = _engine(client)
+                assert engine.mesh.shape["pp"] == 2
+                assert engine._pp == 2
+                resp = await client.post(
+                    "/v1/chat/completions",
+                    json={
+                        "model": "tiny",
+                        "messages": [{"role": "user", "content": "hi"}],
+                        "stream": False,
+                        "max_tokens": 4,
+                    },
+                )
+                assert resp.status == 200
+                body = await resp.json()
+                assert body["choices"][0]["finish_reason"] == "stop"
+            finally:
+                await client.close()
+
+        asyncio.run(run())
+
+    def test_dp_pp_compose_rejected(self, tmp_path):
+        async def run():
+            with pytest.raises(ValueError, match="cannot compose"):
+                await create_app(
+                    cfg=_cfg(tmp_path, dp_size=2, pp_size=2),
+                    tools=[], mcp_servers=[],
+                )
+
+        asyncio.run(run())
+
+
 class TestParallelConfig:
     def test_env_spellings(self, monkeypatch):
         monkeypatch.setenv("KAFKA_TPU_DP", "2")
