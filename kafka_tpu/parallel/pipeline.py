@@ -107,6 +107,25 @@ def kv_pool_spec_pp(cfg: ModelConfig, mesh: Mesh) -> P:
     return P("pp", None, _kv_axis(cfg, mesh))
 
 
+def _embed_and_rope(params: Params, cfg: ModelConfig, token_ids, positions):
+    x = params["embed"][token_ids].astype(cfg.activation_dtype)
+    cos, sin = rope_cos_sin(positions, rope_frequencies(cfg))
+    return x, cos, sin
+
+
+def _logits_head(params: Params, cfg: ModelConfig, h: jnp.ndarray):
+    h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
+    if cfg.tie_word_embeddings:
+        return jnp.einsum(
+            "bsh,vh->bsv", h, params["embed"],
+            preferred_element_type=jnp.float32,
+        )
+    return jnp.einsum(
+        "bsh,hv->bsv", h, params["lm_head"],
+        preferred_element_type=jnp.float32,
+    )
+
+
 def pp_forward_paged(
     params: Params,
     cfg: ModelConfig,
@@ -135,9 +154,7 @@ def pp_forward_paged(
     tp = mesh.shape.get("tp", 1)
     _check_pp_divisibility(cfg, pp, tp)
 
-    x = params["embed"][token_ids].astype(cfg.activation_dtype)
-    inv_freq = rope_frequencies(cfg)
-    cos, sin = rope_cos_sin(positions, inv_freq)
+    x, cos, sin = _embed_and_rope(params, cfg, token_ids, positions)
 
     def per_shard(layer_params, kp, vp, h, cos, sin, pos,
                   write_idx, read_idx, kv_positions, kv_valid):
@@ -197,18 +214,7 @@ def pp_forward_paged(
         params["layers"], k_pool, v_pool, x, cos, sin, positions,
         paged.write_idx, paged.read_idx, paged.kv_positions, paged.kv_valid,
     )
-    h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
-    if cfg.tie_word_embeddings:
-        logits = jnp.einsum(
-            "bsh,vh->bsv", h, params["embed"],
-            preferred_element_type=jnp.float32,
-        )
-    else:
-        logits = jnp.einsum(
-            "bsh,hv->bsv", h, params["lm_head"],
-            preferred_element_type=jnp.float32,
-        )
-    return logits, k_pool, v_pool
+    return _logits_head(params, cfg, h), k_pool, v_pool
 
 
 def pp_forward(
@@ -272,9 +278,7 @@ def pp_forward(
         )
         return h
 
-    x = params["embed"][token_ids].astype(cfg.activation_dtype)
-    inv_freq = rope_frequencies(cfg)
-    cos, sin = rope_cos_sin(positions, inv_freq)
+    x, cos, sin = _embed_and_rope(params, cfg, token_ids, positions)
 
     layer_specs = pp_param_specs(cfg, mesh)["layers"]
     fn = jax.shard_map(
@@ -284,15 +288,4 @@ def pp_forward(
         out_specs=P(),
     )
     h = fn(params["layers"], x, cos, sin, positions)
-    h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
-    if cfg.tie_word_embeddings:
-        logits = jnp.einsum(
-            "bsh,vh->bsv", h, params["embed"],
-            preferred_element_type=jnp.float32,
-        )
-    else:
-        logits = jnp.einsum(
-            "bsh,hv->bsv", h, params["lm_head"],
-            preferred_element_type=jnp.float32,
-        )
-    return logits
+    return _logits_head(params, cfg, h)

@@ -207,6 +207,16 @@ class _AggregateMetrics:
                           for k, v in _percentiles(ttft).items()}
         agg["tpot_ms"] = {k: round(v, 2)
                           for k, v in _percentiles(tpot).items()}
+        bursts = [v for e in self._engines
+                  for v in _copy_samples(e.metrics.burst_tokens)]
+        gaps = [v for e in self._engines
+                for v in _copy_samples(e.metrics.burst_gap_ms)]
+        agg["emission"] = {
+            "burst_tokens": {k: round(v, 2)
+                             for k, v in _percentiles(bursts).items()},
+            "burst_gap_ms": {k: round(v, 2)
+                             for k, v in _percentiles(gaps).items()},
+        }
         steps = sum(s["decode"]["steps"] for s in snaps)
         busy = sum(e.metrics.decode_busy_slots for e in self._engines)
         agg["decode"] = {

@@ -100,7 +100,9 @@ class EngineConfig:
     fetch_lag: int = 96
     # Also pop a fetch once it has been in flight this long (seconds) —
     # bounds token latency when the pipeline fills slower than fetch_lag
-    # steps (e.g. a lone interactive request).
+    # steps.  With <=2 active streams the engine tightens this bound to
+    # ~1.25x the measured device->host RTT (see _emit_wait) so a lone
+    # interactive stream gets smooth per-token cadence, not 150ms bursts.
     fetch_wait_s: float = 0.15
     # Decode attention backend: "auto" resolves to the Pallas paged kernel
     # on single-device TPU (when shapes meet its lane-alignment contract)
@@ -168,7 +170,7 @@ class TokenEvent:
     finish_reason: Optional[str] = None
 
 
-@dataclasses.dataclass
+@dataclasses.dataclass(eq=False)  # identity semantics (list.remove / `is`)
 class _Fetch:
     """One in-flight sampled-token transfer awaiting host processing.
 
@@ -300,6 +302,10 @@ class InferenceEngine:
         self._d_temps = self._d_top_ks = self._d_top_ps = self._d_seeds = None
         self._ctl_dirty = True
         self._pending: List[_Fetch] = []
+        # In-flight constrained micro-batch fetch (at most one): constrained
+        # lanes redispatch only after it matures, so their masks always see
+        # complete output_ids while unconstrained lanes stay pipelined.
+        self._constrained_fetch: Optional[_Fetch] = None
         self._out_events: List[TokenEvent] = []
         self.prefix_cache: Optional[PrefixCache] = (
             PrefixCache(self.pool, self.ecfg.prefix_cache_entries)
@@ -307,6 +313,32 @@ class InferenceEngine:
             else None
         )
         self.metrics = EngineMetrics()
+        self._rtt_est = self._measure_rtt()
+
+    def _measure_rtt(self) -> float:
+        """Time a device→host fetch to seed the adaptive emit cadence.
+
+        Tunneled TPUs sit ~100ms away; local links are ~free.  Fresh
+        device_put arrays are probed (jax caches a materialized host value,
+        so re-fetching the same array would measure nothing).  The estimate
+        is kept honest by an EWMA over real blocking fetches in
+        _process_entry.
+        """
+        samples = []
+        for _ in range(2):
+            probe = np.zeros(self.ecfg.max_batch, np.int32)
+            arr = (
+                jax.device_put(probe, self._replicated)
+                if self._replicated is not None
+                else jax.device_put(probe)
+            )
+            t0 = time.monotonic()
+            np.asarray(arr)
+            samples.append(time.monotonic() - t0)
+        # ground-truth-ish link latency: no compute in the probe, so traffic
+        # EWMA updates are clamped around it (see _process_entry)
+        self._rtt_probe = min(samples)
+        return self._rtt_probe
 
     @staticmethod
     def _resolve_backend(cfg: ModelConfig, ecfg: EngineConfig, mesh) -> str:
@@ -561,22 +593,61 @@ class InferenceEngine:
         transfer completion, and popping on it would reintroduce the
         blocking round trip per step.
         """
+        emitted = 0
+        wait = self._emit_wait()
         while self._pending:
             if not block:
-                aged = (
-                    time.monotonic() - self._pending[0].t0
-                    >= self.ecfg.fetch_wait_s
-                )
+                aged = time.monotonic() - self._pending[0].t0 >= wait
                 if len(self._pending) <= self.ecfg.fetch_lag and not aged:
                     break
-            entry = self._pending.pop(0)
-            toks = np.asarray(entry.arr)
-            vals = toks.reshape(-1)
-            for i, req in enumerate(entry.items):
-                if req is None or req.state == FINISHED:
-                    continue
-                self._process_token(req, int(vals[i if len(vals) > 1 else 0]),
-                                    entry.final[i])
+            emitted += self._process_entry(self._pending.pop(0))
+        if emitted:
+            self.metrics.record_emit_burst(emitted)
+
+    def _emit_wait(self) -> float:
+        """Age at which a fetch is popped without depth pressure.
+
+        With few active streams the pipeline never reaches fetch_lag depth,
+        so this age bound IS the token cadence the user sees; cap it near
+        the measured device→host RTT so a lone interactive stream gets
+        smooth ~RTT-latency tokens instead of fetch_wait_s-sized bursts
+        (popping at ≥RTT age means the transfer has already landed, so the
+        dispatch thread still never blocks).  Busy batches keep the
+        configured bound — depth-pops dominate there anyway.
+        """
+        if self.num_active <= 2:
+            return min(self.ecfg.fetch_wait_s, max(1.25 * self._rtt_est, 0.002))
+        return self.ecfg.fetch_wait_s
+
+    def _process_entry(self, entry: _Fetch) -> int:
+        """Materialize one fetch (blocks if the transfer hasn't landed).
+        Returns the number of tokens processed."""
+        t0 = time.monotonic()
+        vals = np.asarray(entry.arr).reshape(-1)
+        now = time.monotonic()
+        if now - t0 > 0.001:
+            # The transfer hadn't landed when we popped.  dispatch→landed
+            # (now - entry.t0) bounds the link RTT from above but also
+            # includes device compute backlog, so an unclamped EWMA ratchets
+            # upward under load and the adaptive emit wait re-creates the
+            # bursts it exists to remove.  Shrink freely on fast evidence;
+            # grow slowly and never past 2x the compute-free init probe.
+            sample = now - entry.t0
+            if sample < self._rtt_est:
+                self._rtt_est = 0.75 * self._rtt_est + 0.25 * sample
+            else:
+                self._rtt_est = min(
+                    0.9 * self._rtt_est + 0.1 * sample,
+                    max(2.0 * self._rtt_probe, 0.001),
+                )
+        n = 0
+        for i, req in enumerate(entry.items):
+            if req is None or req.state == FINISHED:
+                continue
+            n += 1
+            self._process_token(req, int(vals[i if len(vals) > 1 else 0]),
+                                entry.final[i])
+        return n
 
     def _process_token(self, req: GenRequest, token: int,
                        final_reason: Optional[str]) -> None:
@@ -671,6 +742,14 @@ class InferenceEngine:
                 self.prefix_cache is not None
                 and self.prefix_cache.reclaim(needed)
             ):
+                # Waiting requests must not pin pool pages: drop the prefix
+                # retains taken above, else a blocked head could deadlock a
+                # preempted victim ahead of it under extreme page pressure
+                # (the cache keeps its own retains; _attach_prefix simply
+                # re-acquires on the next attempt).
+                if req.seq is not None:
+                    self.pool.free_sequence(req.seq)
+                    req.seq = None
                 break  # wait for pages to free up
             self.waiting.pop(0)
             try:
@@ -747,11 +826,19 @@ class InferenceEngine:
         req.dispatched += 1
         final = self._limit_reason_after_dispatch(req)
         tok.copy_to_host_async()
-        self._pending.append(
-            _Fetch(arr=tok, items=[req], final=[final], t0=time.monotonic())
-        )
+        entry = _Fetch(arr=tok, items=[req], final=[final], t0=time.monotonic())
+        self._pending.append(entry)
         if final is not None:
             self._to_draining(req)
+        if req.logits_mask_fn is not None:
+            # Constrained: the first decode mask needs this token in
+            # output_ids.  Only this request's scalar fetch blocks; the
+            # rest of the batch pipeline is untouched.  Safe out of FIFO
+            # order: an admitted request has no other in-flight entries.
+            self._pending.remove(entry)
+            n = self._process_entry(entry)
+            if n:
+                self.metrics.record_emit_burst(n)
 
     def _limit_reason_after_dispatch(self, req: GenRequest) -> Optional[str]:
         """After a dispatch, has the request hit a host-known limit?
@@ -798,34 +885,105 @@ class InferenceEngine:
         active_slots = [s for s in self.slots if s is not None]
         if not active_slots:
             return
-        if any(s.logits_mask_fn is not None for s in active_slots):
-            # constrained decoding: the next mask depends on every token
-            # emitted so far, so the pipeline must be drained (complete
-            # output_ids) before the mask is built — the constrained batch
-            # runs synchronously.
-            self._drain(block=True)
-            active_slots = [s for s in self.slots if s is not None]
-            if not active_slots:
-                return
         if self._ctl_dirty:
             self._refresh_ctl()
-        allowed = self._build_allowed_mask()
+        if all(s.logits_mask_fn is None for s in active_slots):
+            # common case: the whole batch is unconstrained and pipelined
+            self._dispatch_group(list(self.slots), self._d_active, None,
+                                 full=True)
+            self.metrics.record_decode_step(len(active_slots))
+            return
+        # Mixed/constrained batch.  A constrained lane's next mask depends on
+        # every token it has emitted so far, so its decode cannot be
+        # pipelined — but that is no reason to stall anyone else (one agent
+        # doing a forced tool call must not degrade co-scheduled streams).
+        # The unconstrained lanes dispatch every scheduler step exactly as in
+        # the common case; the constrained lanes run as their own micro-batch
+        # at fetch cadence: dispatch once, wait for the token fetch to mature
+        # through the normal aging rules, then build the next mask from the
+        # now-complete output_ids and redispatch.
+        uncon = [
+            s if (s is not None and s.logits_mask_fn is None) else None
+            for s in self.slots
+        ]
+        n_uncon = sum(1 for m in uncon if m is not None)
+        if n_uncon:
+            d_act = self._dev(np.array([m is not None for m in uncon]))
+            self._dispatch_group(uncon, d_act, None, full=False)
+        if self._constrained_inflight():
+            # The constrained fetch matures at ~RTT age (the transfer has
+            # landed; popping is then effectively free), NOT at the general
+            # fetch_wait_s bound — gating on the latter would throttle
+            # constrained lanes to 1/fetch_wait_s tok/s in busy batches.
+            # RTT is also the floor: the next mask cannot be built before
+            # the previous token reaches the host.  With no unconstrained
+            # lanes nobody is stalled by blocking, so fetch immediately.
+            entry = self._constrained_fetch
+            aged = (
+                time.monotonic() - entry.t0
+                >= max(1.25 * self._rtt_est, 0.002)
+            )
+            if aged or not n_uncon:
+                self._pending.remove(entry)
+                n = self._process_entry(entry)
+                if n:
+                    self.metrics.record_emit_burst(n)
+                self._constrained_fetch = None
+        n_con = 0
+        if not self._constrained_inflight():
+            con = [
+                s if (s is not None and s.logits_mask_fn is not None) else None
+                for s in self.slots
+            ]
+            n_con = sum(1 for m in con if m is not None)
+            if n_con:
+                allowed = self._build_allowed_mask()
+                d_act = self._dev(np.array([m is not None for m in con]))
+                self._constrained_fetch = self._dispatch_group(
+                    con, d_act, allowed, full=False
+                )
+        if n_uncon or n_con:
+            # one scheduler iteration = one TPOT sample / occupancy record,
+            # however many dispatch groups it took (group dispatches land
+            # microseconds apart and are not per-token latency)
+            self.metrics.record_decode_step(n_uncon + n_con)
 
+    def _constrained_inflight(self) -> bool:
+        """Is the constrained micro-batch still waiting on its last fetch?"""
+        e = self._constrained_fetch
+        if e is None:
+            return False
+        if any(p is e for p in self._pending):
+            return True
+        self._constrained_fetch = None  # matured (or force-drained)
+        return False
+
+    def _dispatch_group(
+        self,
+        members: List[Optional[GenRequest]],
+        d_active: jnp.ndarray,
+        allowed: Optional[np.ndarray],
+        full: bool,
+    ) -> _Fetch:
+        """Dispatch one decode for the lanes in `members` (slot-aligned;
+        None = not in this group).  Lanes outside the group are masked
+        inactive for this call: their KV writes go to the trash page, their
+        seq_lens don't advance, and their device last-token lanes keep their
+        previous value via the where-merge below.
+        """
         self.k_pool, self.v_pool, toks, self._d_seq_lens = self._decode_fn(
             self.params, self.k_pool, self.v_pool,
             self._d_table, self._d_last, self._d_seq_lens,
-            self._d_active, self._d_temps, self._d_top_ks,
+            d_active, self._d_temps, self._d_top_ks,
             self._d_top_ps, self._d_seeds,
             None if allowed is None else self._dev(allowed),
         )
-        self._d_last = toks
+        self._d_last = toks if full else jnp.where(d_active, toks, self._d_last)
         toks.copy_to_host_async()
         self._step_count += 1
-        self.metrics.record_decode_step(len(active_slots))
-
         items: List[Optional[GenRequest]] = []
         final: List[Optional[str]] = []
-        for req in self.slots:
+        for req in members:
             if req is None:
                 items.append(None)
                 final.append(None)
@@ -834,12 +992,12 @@ class InferenceEngine:
             req.dispatched += 1
             items.append(req)
             final.append(self._limit_reason_after_dispatch(req))
-        self._pending.append(
-            _Fetch(arr=toks, items=items, final=final, t0=time.monotonic())
-        )
-        for req, fin in zip(list(self.slots), final):
+        entry = _Fetch(arr=toks, items=items, final=final, t0=time.monotonic())
+        self._pending.append(entry)
+        for req, fin in zip(members, final):
             if req is not None and fin is not None:
                 self._to_draining(req)
+        return entry
 
     def _ensure_pages(self, req: GenRequest) -> bool:
         """Grow req's pages for one more token.  Returns True if req was

@@ -65,6 +65,13 @@ class EngineMetrics:
     def __post_init__(self) -> None:
         self.ttft_ms: Deque[float] = collections.deque(maxlen=self.window)
         self.tpot_ms: Deque[float] = collections.deque(maxlen=self.window)
+        # token-emission cadence as the client sees it: how many tokens
+        # arrive together when the fetch pipeline pops (burst size) and how
+        # far apart those arrivals are (gap) — the honest view of stream
+        # smoothness that step-interval TPOT cannot give under pipelining
+        self.burst_tokens: Deque[float] = collections.deque(maxlen=self.window)
+        self.burst_gap_ms: Deque[float] = collections.deque(maxlen=self.window)
+        self._last_burst_t: Optional[float] = None
         self._last_step_t: Optional[float] = None
         self._started = time.monotonic()
 
@@ -95,8 +102,18 @@ class EngineMetrics:
 
     def mark_idle(self) -> None:
         """The engine drained: the gap until the next decode step is idle
-        time, not TPOT — drop the timing baseline."""
+        time, not TPOT (nor a burst gap) — drop both timing baselines."""
         self._last_step_t = None
+        self._last_burst_t = None
+
+    def record_emit_burst(self, n_tokens: int) -> None:
+        now = time.monotonic()
+        self.burst_tokens.append(float(n_tokens))
+        if self._last_burst_t is not None:
+            gap = (now - self._last_burst_t) * 1e3
+            if gap < 2_000:
+                self.burst_gap_ms.append(gap)
+        self._last_burst_t = now
 
     def record_finish(self, reason: Optional[str]) -> None:
         if reason == "cancelled":
@@ -135,6 +152,16 @@ class EngineMetrics:
                     self.decode_busy_slots / self.decode_steps, 3
                 ) if self.decode_steps else 0.0,
             },
+            "emission": {
+                "burst_tokens": {
+                    k: round(v, 2) for k, v in
+                    _percentiles(_copy_samples(self.burst_tokens)).items()
+                },
+                "burst_gap_ms": {
+                    k: round(v, 2) for k, v in
+                    _percentiles(_copy_samples(self.burst_gap_ms)).items()
+                },
+            },
         }
         if engine is not None:
             snap["engine"] = {
@@ -147,6 +174,7 @@ class EngineMetrics:
                 - engine.pool.free_pages,
                 "max_batch": engine.ecfg.max_batch,
                 "attention_backend": engine.cfg.attention_backend,
+                "rtt_est_ms": round(engine._rtt_est * 1e3, 3),
             }
             if engine.prefix_cache is not None:
                 snap["prefix_cache"] = {

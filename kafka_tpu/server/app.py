@@ -26,6 +26,7 @@ agent_done, SURVEY §5.8), so the reference playground works unmodified.
 
 from __future__ import annotations
 
+import json
 import logging
 from typing import Any, AsyncIterator, Dict, List, Optional
 
@@ -315,7 +316,7 @@ async def _agent_events(
     # Plain assistant text is never batched — it streams live (our
     # improvement over the reference's re-streaming) and batching it would
     # duplicate it client-side.  All covered by tests/test_sse_contract.py.
-    last_batched = 0
+    last_batched = None
 
     def _cumulative_batch():
         return [
@@ -324,10 +325,15 @@ async def _agent_events(
         ]
 
     def _maybe_batch():
+        # Re-emit whenever the canonical batch CONTENT changed, not just its
+        # count — server-side sanitization can rewrite a message in place
+        # (e.g. truncation differing from the streamed deltas), and the
+        # client must end up holding the durable canonical form.
         nonlocal last_batched
         batch = _cumulative_batch()
-        if len(batch) > last_batched:
-            last_batched = len(batch)
+        fingerprint = hash(json.dumps(batch, sort_keys=True, default=str))
+        if batch and fingerprint != last_batched:
+            last_batched = fingerprint
             return {"type": "tool_messages", "messages": batch}
         return None
 
