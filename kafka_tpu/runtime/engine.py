@@ -604,6 +604,11 @@ class InferenceEngine:
         if emitted:
             self.metrics.record_emit_burst(emitted)
 
+    def _rtt_age_bound(self) -> float:
+        """Age at which an in-flight fetch's transfer has presumably landed
+        (popping then is effectively free for the dispatch thread)."""
+        return max(1.25 * self._rtt_est, 0.002)
+
     def _emit_wait(self) -> float:
         """Age at which a fetch is popped without depth pressure.
 
@@ -616,8 +621,20 @@ class InferenceEngine:
         configured bound — depth-pops dominate there anyway.
         """
         if self.num_active <= 2:
-            return min(self.ecfg.fetch_wait_s, max(1.25 * self._rtt_est, 0.002))
+            return min(self.ecfg.fetch_wait_s, self._rtt_age_bound())
         return self.ecfg.fetch_wait_s
+
+    def _pop_entry_now(self, entry: _Fetch) -> None:
+        """Take one entry out of the FIFO and process it immediately.
+
+        Safe out of FIFO order only when the entry's requests have no older
+        in-flight entries (true for a just-admitted prefill and for the
+        constrained micro-batch, whose lanes appear in no other entries).
+        """
+        self._pending.remove(entry)
+        n = self._process_entry(entry)
+        if n:
+            self.metrics.record_emit_burst(n)
 
     def _process_entry(self, entry: _Fetch) -> int:
         """Materialize one fetch (blocks if the transfer hasn't landed).
@@ -833,12 +850,8 @@ class InferenceEngine:
         if req.logits_mask_fn is not None:
             # Constrained: the first decode mask needs this token in
             # output_ids.  Only this request's scalar fetch blocks; the
-            # rest of the batch pipeline is untouched.  Safe out of FIFO
-            # order: an admitted request has no other in-flight entries.
-            self._pending.remove(entry)
-            n = self._process_entry(entry)
-            if n:
-                self.metrics.record_emit_burst(n)
+            # rest of the batch pipeline is untouched.
+            self._pop_entry_now(entry)
 
     def _limit_reason_after_dispatch(self, req: GenRequest) -> Optional[str]:
         """After a dispatch, has the request hit a host-known limit?
@@ -919,15 +932,9 @@ class InferenceEngine:
             # the previous token reaches the host.  With no unconstrained
             # lanes nobody is stalled by blocking, so fetch immediately.
             entry = self._constrained_fetch
-            aged = (
-                time.monotonic() - entry.t0
-                >= max(1.25 * self._rtt_est, 0.002)
-            )
+            aged = time.monotonic() - entry.t0 >= self._rtt_age_bound()
             if aged or not n_uncon:
-                self._pending.remove(entry)
-                n = self._process_entry(entry)
-                if n:
-                    self.metrics.record_emit_burst(n)
+                self._pop_entry_now(entry)
                 self._constrained_fetch = None
         n_con = 0
         if not self._constrained_inflight():

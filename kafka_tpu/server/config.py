@@ -49,6 +49,35 @@ class ServingConfig:
     tiny_model: bool = False
 
     @classmethod
+    def profile_32k(cls, **overrides) -> "ServingConfig":
+        """BASELINE config 5's serving shape: 32k-context Llama-3-70B on a
+        tp x sp mesh (v5p-64-class slice).
+
+        Window math: page_size 16 x max_pages_per_seq 2048 = 32768-token
+        attention window.  The pool holds num_pages = 4 full windows + 1
+        trash page so a handful of long threads coexist (KV for 70B at 32k
+        is ~20 GB/seq in bf16 across the slice — the pool, like the
+        weights, is sharded over tp so each device holds 1/tp of it).
+        Prefill buckets run to 4096 and every bucket divides sp=4: the
+        ring shards each chunk across the sp axis (engine constructor
+        contract), and chunked prefill walks the prompt 4096 tokens at a
+        time.  dp/pp stay 1 — long-context serving spends the mesh on
+        tp x sp (SURVEY §2.2, ring CP for prefill beyond one chip's HBM).
+        """
+        cfg = cls(
+            model_name="llama-3-70b",
+            tp_size=16,
+            sp_size=4,
+            max_batch=4,
+            page_size=16,
+            max_pages_per_seq=2048,
+            num_pages=4 * 2048 + 1,
+            prefill_buckets=(256, 1024, 2048, 4096),
+            max_new_tokens_default=2048,
+        )
+        return dataclasses.replace(cfg, **overrides)
+
+    @classmethod
     def from_env(cls, **overrides) -> "ServingConfig":
         env = os.environ
 

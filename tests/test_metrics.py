@@ -94,8 +94,10 @@ class TestEngineRecording:
         # 40-token burst at the end (fetch_wait_s=10s, fetch_lag=96); with
         # it the typical pop is a single token across many emission events
         # (an occasional multi-token pop after a host hiccup is fine)
-        # non-adaptive behavior would be exactly two bursts: [1, 39]
-        assert len(eng.metrics.burst_tokens) >= 6
+        # non-adaptive behavior would be exactly two bursts: [1, 39].
+        # Loose bounds: on a loaded host an aged-but-unlanded fetch blocks,
+        # during which more entries age and pop together as a larger burst.
+        assert len(eng.metrics.burst_tokens) >= 3
         assert max(eng.metrics.burst_tokens) <= 30
         assert snap["emission"]["burst_gap_ms"]["p50"] < 100
 
