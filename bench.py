@@ -78,12 +78,14 @@ def decode_phase(engine, cfg, batch: int, prompt_len: int, gen_len: int,
     return tokens / wall, steps / wall
 
 
-def hbm_traffic_per_step(cfg, pbytes: int, batch: int, ctx_len: int) -> int:
+def hbm_traffic_per_step(engine, pbytes: int, batch: int,
+                         ctx_len: int) -> int:
     """Estimated HBM bytes one decode step moves: every weight byte read
     once (batch small enough that weights, not activations, dominate) plus
     the KV context read + one-token write per active sequence."""
+    cfg = engine.cfg
     kv_row = 2 * cfg.num_layers * cfg.num_kv_heads * cfg.head_dim  # k+v
-    kv_dtype_bytes = 2  # bf16 pool
+    kv_dtype_bytes = engine.k_pool.dtype.itemsize  # follows model dtype
     kv_read = batch * ctx_len * kv_row * kv_dtype_bytes
     kv_write = batch * kv_row * kv_dtype_bytes
     return pbytes + kv_read + kv_write
@@ -207,7 +209,7 @@ def main() -> None:
         engine, cfg, args.batch, args.prompt_len, args.gen_len, rng
     )
     ctx = args.prompt_len + args.gen_len // 2  # mean context during decode
-    step_bytes = hbm_traffic_per_step(cfg, pbytes, args.batch, ctx)
+    step_bytes = hbm_traffic_per_step(engine, pbytes, args.batch, ctx)
     hbm_gb_s = step_bytes * steps_per_s / 1e9
     # nominal HBM bandwidth by chip family; fall back to v5e-class
     HBM_BW = {"TPU v4": 1228.0, "TPU v5e": 819.0, "TPU v5 lite": 819.0,
@@ -235,7 +237,7 @@ def main() -> None:
         seng.generate(prompt(), max_new_tokens=2)
         log(f"batch {b} compile: {time.monotonic() - t0:.1f}s")
         tps, sps = decode_phase(seng, cfg, b, args.prompt_len, 128, rng)
-        sb = hbm_traffic_per_step(cfg, pbytes, b, args.prompt_len + 64)
+        sb = hbm_traffic_per_step(seng, pbytes, b, args.prompt_len + 64)
         sweep[str(b)] = {
             "decode_tok_s": round(tps, 1),
             "steps_per_s": round(sps, 1),
@@ -313,7 +315,8 @@ def main() -> None:
             "concurrent_thread_req_per_s": round(concurrent_req_s, 2),
             "concurrent_threads": n_threads,
             "concurrent_note": (
-                "32 short thread turns, 4x oversubscribed over batch 8 on "
+                f"{n_threads} short thread turns, oversubscribed over "
+                f"batch {args.batch} on "
                 "ONE chip; BASELINE config 3's 256-thread target assumes "
                 "v5e-8 (8 chips x dp) — per-chip this is the comparable "
                 "shape. Varies ~10% with tunnel RTT jitter."

@@ -47,6 +47,10 @@ class ModelConfig:
     # engine when its mesh has sp > 1; forward(..., mesh=...) must receive
     # the mesh.
     prefill_ring: bool = False
+    # context-parallel strategy when prefill_ring is on: "ring" rotates KV
+    # shards over ICI neighbors; "ulysses" all_to_alls to head-sharded
+    # layout (parallel/ring_attention.py — needs heads/tp % sp == 0)
+    cp_strategy: str = "ring"
 
     @property
     def q_per_kv(self) -> int:
@@ -163,7 +167,15 @@ def config_from_hf_json(path: str) -> ModelConfig:
     with open(path) as f:
         hf = json.load(f)
     rs = hf.get("rope_scaling") or {}
+    # honor the checkpoint's own precision ("dtype" since transformers
+    # 4.56+, "torch_dtype" before); fp16 checkpoints run as bf16 (same
+    # width, TPU-native — fp16 has no MXU path)
+    dtype = {"float32": "float32", "bfloat16": "bfloat16",
+             "float16": "bfloat16"}.get(
+        hf.get("dtype", hf.get("torch_dtype")), "bfloat16"
+    )
     return ModelConfig(
+        dtype=dtype,
         name=os.path.basename(os.path.dirname(os.path.abspath(path))),
         vocab_size=hf["vocab_size"],
         hidden_size=hf["hidden_size"],

@@ -189,7 +189,10 @@ def _attention_block(
             # (ctx_valid excludes the chunk's freshly written positions —
             # those would otherwise be counted twice) is read locally from
             # the pool by every sp rank (heads stay tp-sharded).
-            from ..parallel.ring_attention import ring_prefill_sharded
+            from ..parallel.ring_attention import (
+                ring_prefill_sharded,
+                ulysses_prefill_sharded,
+            )
 
             if mesh is None:
                 raise RuntimeError(
@@ -198,7 +201,9 @@ def _attention_block(
             k_win = k_cache[paged.read_idx].reshape(b, -1, hkv, d)
             v_win = v_cache[paged.read_idx].reshape(b, -1, hkv, d)
             ctx_valid = paged.kv_valid & (paged.kv_positions < positions[:, :1])
-            out = ring_prefill_sharded(
+            cp = (ulysses_prefill_sharded if cfg.cp_strategy == "ulysses"
+                  else ring_prefill_sharded)
+            out = cp(
                 mesh, q, k, v, positions,
                 k_win, v_win, paged.kv_positions, ctx_valid,
             )

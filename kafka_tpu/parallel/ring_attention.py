@@ -158,6 +158,44 @@ def ring_attention_sharded(
     return fn(q, k, v, q_positions, kv_positions)
 
 
+def _prefill_sharded(
+    per_shard,
+    mesh: Mesh,
+    q: jnp.ndarray,
+    k_chunk: jnp.ndarray,
+    v_chunk: jnp.ndarray,
+    q_positions: jnp.ndarray,
+    k_ctx: jnp.ndarray,
+    v_ctx: jnp.ndarray,
+    ctx_positions: jnp.ndarray,
+    ctx_valid: jnp.ndarray,
+    axis_name: str,
+) -> jnp.ndarray:
+    """Shared CP layout contract for both prefill strategies: the chunk
+    tensors are sequence-sharded over sp, the paged context is replicated
+    over sp, and heads additionally shard over the mesh's tp axis when it
+    divides BOTH head counts (the same rule as sharding.py's
+    kv_pool_spec) — so on a tp x sp mesh each device holds 1/(tp*sp) of
+    the chunk and 1/tp of the context window."""
+    tp = mesh.shape.get("tp", 1)
+    hq, hkv = q.shape[2], k_chunk.shape[2]
+    head_ax = "tp" if (tp > 1 and hkv % tp == 0 and hq % tp == 0) else None
+    spec_a = P(None, axis_name, head_ax, None)
+    spec_p = P(None, axis_name)
+    rep_a = P(None, None, head_ax, None)
+    rep_p = P(None, None)
+
+    fn = jax.shard_map(
+        per_shard,
+        mesh=mesh,
+        in_specs=(spec_a, spec_a, spec_a, spec_p,
+                  rep_a, rep_a, rep_p, rep_p),
+        out_specs=spec_a,
+    )
+    return fn(q, k_chunk, v_chunk, q_positions,
+              k_ctx, v_ctx, ctx_positions, ctx_valid)
+
+
 def ring_prefill_sharded(
     mesh: Mesh,
     q: jnp.ndarray,            # [B, S, Hq, D] — the chunk's queries
@@ -172,38 +210,109 @@ def ring_prefill_sharded(
 ) -> jnp.ndarray:
     """Chunked-prefill attention with the chunk ring-sharded over sp.
 
-    The chunk's q/kv are sequence-sharded across the sp axis and rotate in
-    a ring; the already-materialized paged context is read locally by every
-    sp rank.  Heads additionally stay sharded over the mesh's tp axis when
-    it divides them (the same rule as sharding.py's kv_pool_spec) — so on a
-    tp x sp mesh each device holds 1/(tp*sp) of the chunk and 1/tp of the
-    context window, which is the whole point of the composition for 32k
-    windows.  S must divide by the sp size (the engine guarantees this by
-    choosing prefill buckets divisible by sp).
+    The chunk's q/kv rotate in a ring; the already-materialized paged
+    context is read locally by every sp rank (layout per _prefill_sharded).
+    S must divide by the sp size (the engine guarantees this by choosing
+    prefill buckets divisible by sp).
     """
-    tp = mesh.shape.get("tp", 1)
-    hq, hkv = q.shape[2], k_chunk.shape[2]
-    head_ax = "tp" if (tp > 1 and hkv % tp == 0 and hq % tp == 0) else None
-    spec_a = P(None, axis_name, head_ax, None)
-    spec_p = P(None, axis_name)
-    rep_a = P(None, None, head_ax, None)
-    rep_p = P(None, None)
-
     def per_shard(q_, kc_, vc_, qp_, kx_, vx_, cp_, cv_):
         return ring_attention(
             q_, kc_, vc_, qp_, qp_, axis_name=axis_name,
             k_ctx=kx_, v_ctx=vx_, ctx_positions=cp_, ctx_valid=cv_,
         )
 
-    fn = jax.shard_map(
-        per_shard,
-        mesh=mesh,
-        in_specs=(spec_a, spec_a, spec_a, spec_p,
-                  rep_a, rep_a, rep_p, rep_p),
-        out_specs=spec_a,
+    return _prefill_sharded(
+        per_shard, mesh, q, k_chunk, v_chunk, q_positions,
+        k_ctx, v_ctx, ctx_positions, ctx_valid, axis_name,
     )
-    return fn(q, k_chunk, v_chunk, q_positions,
-              k_ctx, v_ctx, ctx_positions, ctx_valid)
+
+
+def _a2a_seq_to_heads(x, axis_name):  # [B,S_loc,H,D] -> [B,S_glob,H_loc,D]
+    return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                          tiled=True)
+
+
+def _a2a_heads_to_seq(x, axis_name):  # inverse
+    return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)
+
+
+def ulysses_prefill(
+    q: jnp.ndarray,            # [B, S_loc, Hq, D] — chunk queries (seq shard)
+    k_chunk: jnp.ndarray,      # [B, S_loc, Hkv, D]
+    v_chunk: jnp.ndarray,
+    q_positions: jnp.ndarray,  # [B, S_loc] absolute
+    k_ctx: jnp.ndarray,        # [B, C, Hkv, D] — paged window, replicated
+    v_ctx: jnp.ndarray,
+    ctx_positions: jnp.ndarray,  # [B, C]
+    ctx_valid: jnp.ndarray,      # [B, C]
+    axis_name: str = "sp",
+) -> jnp.ndarray:
+    """Per-shard Ulysses chunked-prefill attention (call inside shard_map).
+
+    The alternative CP strategy to `ring_attention`'s context form: instead
+    of rotating KV shards, one all_to_all re-shards the chunk from
+    sequence-sharded to head-sharded, each rank runs ordinary attention for
+    its head subset over [paged context + full chunk], and a second
+    all_to_all restores sequence sharding.  The replicated context is
+    sliced to the rank's heads (it is already materialized in the pool, so
+    it never rides a collective).  Requires H % sp == 0 (heads here are the
+    per-tp-shard count when composed with TP).  GQA: kv heads repeat to Hq
+    before the swap — simple and always-valid; a kv-head-aware layout could
+    cut all_to_all traffic by n_rep.
+    """
+    n_rep = q.shape[2] // k_chunk.shape[2]
+    k_chunk = repeat_kv(k_chunk, n_rep)
+    v_chunk = repeat_kv(v_chunk, n_rep)
+    sp = lax.psum(1, axis_name)
+    rank = lax.axis_index(axis_name)
+    h_loc = q.shape[2] // sp
+
+    qh = _a2a_seq_to_heads(q, axis_name)
+    kh = _a2a_seq_to_heads(k_chunk, axis_name)
+    vh = _a2a_seq_to_heads(v_chunk, axis_name)
+    pos_full = lax.all_gather(q_positions, axis_name, axis=1, tiled=True)
+    k_ctx_loc = lax.dynamic_slice_in_dim(
+        repeat_kv(k_ctx, n_rep), rank * h_loc, h_loc, axis=2
+    )
+    v_ctx_loc = lax.dynamic_slice_in_dim(
+        repeat_kv(v_ctx, n_rep), rank * h_loc, h_loc, axis=2
+    )
+    k_all = jnp.concatenate([k_ctx_loc, kh], axis=1)
+    v_all = jnp.concatenate([v_ctx_loc, vh], axis=1)
+    kv_pos = jnp.concatenate([ctx_positions, pos_full], axis=1)
+    kv_valid = jnp.concatenate(
+        [ctx_valid, jnp.ones(pos_full.shape, bool)], axis=1
+    )
+    scale = q.shape[-1] ** -0.5
+    s = _block_scores(qh, k_all, pos_full, kv_pos, scale, kv_valid=kv_valid)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum(
+        "bhqk,bkhd->bqhd", p, v_all.astype(jnp.float32)
+    ).astype(q.dtype)
+    return _a2a_heads_to_seq(out, axis_name)
+
+
+def ulysses_prefill_sharded(
+    mesh: Mesh,
+    q: jnp.ndarray,
+    k_chunk: jnp.ndarray,
+    v_chunk: jnp.ndarray,
+    q_positions: jnp.ndarray,
+    k_ctx: jnp.ndarray,
+    v_ctx: jnp.ndarray,
+    ctx_positions: jnp.ndarray,
+    ctx_valid: jnp.ndarray,
+    axis_name: str = "sp",
+) -> jnp.ndarray:
+    """shard_map wrapper over ulysses_prefill (layout per _prefill_sharded:
+    identical contract to ring_prefill_sharded, so the engine swaps
+    strategies without relayout)."""
+    return _prefill_sharded(
+        functools.partial(ulysses_prefill, axis_name=axis_name),
+        mesh, q, k_chunk, v_chunk, q_positions,
+        k_ctx, v_ctx, ctx_positions, ctx_valid, axis_name,
+    )
 
 
 def ulysses_attention(
@@ -225,19 +334,15 @@ def ulysses_attention(
     k = repeat_kv(k, n_rep)
     v = repeat_kv(v, n_rep)
 
-    def scatter(x):  # [B, S_loc, H, D] -> [B, S_glob, H_loc, D]
-        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
-
-    def gather(x):  # inverse
-        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
-
-    qh, kh, vh = scatter(q), scatter(k), scatter(v)
+    qh = _a2a_seq_to_heads(q, axis_name)
+    kh = _a2a_seq_to_heads(k, axis_name)
+    vh = _a2a_seq_to_heads(v, axis_name)
     pos_full = lax.all_gather(q_positions, axis_name, axis=1, tiled=True)
     scale = qh.shape[-1] ** -0.5
     s = _block_scores(qh, kh, pos_full, pos_full, scale)
     p = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", p, vh.astype(jnp.float32)).astype(q.dtype)
-    return gather(out)
+    return _a2a_heads_to_seq(out, axis_name)
 
 
 def ulysses_attention_sharded(

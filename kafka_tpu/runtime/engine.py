@@ -110,6 +110,10 @@ class EngineConfig:
     attention_backend: str = "auto"
     # Thread-keyed prefix cache capacity (entries); 0 disables.
     prefix_cache_entries: int = 64
+    # Context-parallel strategy for sp>1 chunked prefill: "ring" (KV shards
+    # rotate over ICI — bandwidth-optimal, any head count) or "ulysses"
+    # (all_to_all to head-sharded layout — needs heads/tp % sp == 0).
+    cp_strategy: str = "ring"
 
     @property
     def max_window(self) -> int:
@@ -221,6 +225,30 @@ class InferenceEngine:
                     f"prefill buckets {bad} not divisible by sp={sp}: the "
                     "ring shards each chunk across the sp axis"
                 )
+            if self.ecfg.cp_strategy not in ("ring", "ulysses"):
+                raise ValueError(
+                    f"unknown cp_strategy {self.ecfg.cp_strategy!r}: "
+                    "expected 'ring' or 'ulysses'"
+                )
+            if self.ecfg.cp_strategy == "ulysses":
+                # mirror ulysses_prefill_sharded's head_ax rule: heads are
+                # tp-sharded only when tp divides BOTH head counts, else
+                # each shard holds all heads
+                tp = mesh.shape.get("tp", 1)
+                tp_sharded = (
+                    tp > 1
+                    and cfg.num_heads % tp == 0
+                    and cfg.num_kv_heads % tp == 0
+                )
+                per_shard_heads = (
+                    cfg.num_heads // tp if tp_sharded else cfg.num_heads
+                )
+                if per_shard_heads % sp:
+                    raise ValueError(
+                        f"ulysses needs the per-shard head count "
+                        f"({per_shard_heads}) divisible by sp={sp}; use "
+                        "cp_strategy='ring'"
+                    )
         if (
             self.ecfg.attention_backend == "pallas"
             and mesh is not None
@@ -234,6 +262,7 @@ class InferenceEngine:
         self.cfg = cfg.replace(
             attention_backend=self._resolve_backend(cfg, self.ecfg, mesh),
             prefill_ring=sp > 1,
+            cp_strategy=self.ecfg.cp_strategy,
         )
         if self.cfg.attention_backend == "pallas":
             # flash prefill tiles chunks into q_block=64 rows (ops/pallas/

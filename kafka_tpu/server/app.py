@@ -110,6 +110,7 @@ def build_tpu_provider(cfg: ServingConfig) -> LLMProvider:
         max_pages_per_seq=cfg.max_pages_per_seq,
         prefill_buckets=cfg.prefill_buckets,
         max_new_tokens_default=cfg.max_new_tokens_default,
+        cp_strategy=cfg.cp_strategy,
     )
     if cfg.dp_size > 1:
         if cfg.pp_size > 1:

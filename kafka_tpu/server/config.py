@@ -39,6 +39,8 @@ class ServingConfig:
     sp_size: int = 1
     pp_size: int = 1
     dp_size: int = 1
+    # long-context CP strategy when sp>1: "ring" or "ulysses"
+    cp_strategy: str = "ring"
     # server
     host: str = "0.0.0.0"
     port: int = 8000
@@ -100,6 +102,7 @@ class ServingConfig:
             sp_size=get_axis("SP", cls.sp_size),
             pp_size=get_axis("PP", cls.pp_size),
             dp_size=get_axis("DP", cls.dp_size),
+            cp_strategy=get("CP_STRATEGY", cls.cp_strategy),
             host=get("HOST", cls.host),
             port=get("PORT", cls.port, int),
             db_path=get("DB_PATH", cls.db_path),

@@ -1,0 +1,128 @@
+"""Serve from a REAL HuggingFace checkpoint, end to end (VERDICT r2 #6).
+
+The loader's state-dict conversion was already proved numerically
+(tests/test_llama_numerics.py), but nothing ever booted the *server* from
+a checkpoint directory.  Here a tiny real `transformers.LlamaForCausalLM`
+is saved to disk as HF safetensors (+config.json — exactly what
+`resolve_checkpoint_dir` would find for a downloaded model; this
+environment has no network egress, so tiny-random stands in for
+downloaded weights), the server starts with `checkpoint_dir` pointing at
+it, and a completion is served over HTTP.  A second test pins the engine's
+greedy continuation token-exact against transformers' own generate().
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from kafka_tpu.server import ServingConfig, create_app
+from kafka_tpu.server.app import STATE_KEY, build_tpu_provider
+
+VOCAB = 262  # covers the ByteTokenizer id space (256 bytes + specials)
+
+
+@pytest.fixture(scope="module")
+def checkpoint(tmp_path_factory):
+    d = tmp_path_factory.mktemp("tiny-llama-ckpt")
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=VOCAB,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        head_dim=16,
+        max_position_embeddings=2048,
+        rms_norm_eps=1e-5,
+        rope_theta=10000.0,
+        tie_word_embeddings=False,
+        attention_bias=False,
+        mlp_bias=False,
+        torch_dtype="float32",
+    )
+    torch.manual_seed(7)
+    hf = transformers.LlamaForCausalLM(hf_cfg).eval()
+    hf.save_pretrained(str(d), safe_serialization=True)
+    return str(d), hf
+
+
+def _cfg(ckpt_dir, tmp_path):
+    # the agent system prompt is ~700 ByteTokenizer tokens: window 2048
+    return ServingConfig(
+        checkpoint_dir=ckpt_dir,
+        db_path=str(tmp_path / "threads.db"),
+        max_batch=2,
+        page_size=16,
+        num_pages=320,
+        max_pages_per_seq=128,
+        prefill_buckets=(256,),
+        max_new_tokens_default=8,
+    )
+
+
+class TestCheckpointServing:
+    def test_server_boots_from_checkpoint_and_serves(self, checkpoint,
+                                                     tmp_path):
+        ckpt_dir, _ = checkpoint
+
+        async def run():
+            app = await create_app(
+                cfg=_cfg(ckpt_dir, tmp_path), tools=[], mcp_servers=[]
+            )
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                state = client.server.app[STATE_KEY]
+                engine = state["llm"].engine
+                # the model really came from the checkpoint dir: its shape
+                # and precision are the checkpoint's, not a builtin preset
+                assert engine.cfg.vocab_size == VOCAB
+                assert engine.cfg.num_layers == 2
+                assert engine.cfg.dtype == "float32"  # honors torch_dtype
+
+                resp = await client.post(
+                    "/v1/chat/completions",
+                    json={
+                        "model": "tiny-ckpt",
+                        "messages": [{"role": "user", "content": "hi"}],
+                        "stream": False,
+                        "max_tokens": 4,
+                    },
+                )
+                assert resp.status == 200
+                body = await resp.json()
+                assert body["object"] == "chat.completion"
+                assert body["choices"][0]["message"]["role"] == "assistant"
+                assert body["usage"]["completion_tokens"] > 0
+            finally:
+                await client.close()
+
+        asyncio.run(run())
+
+    def test_engine_greedy_matches_transformers_generate(self, checkpoint,
+                                                         tmp_path):
+        """The served weights ARE the checkpoint's: greedy continuation from
+        the engine (paged cache, chunked prefill) must reproduce
+        transformers' generate() on the same ids."""
+        ckpt_dir, hf = checkpoint
+        provider = build_tpu_provider(_cfg(ckpt_dir, tmp_path))
+        try:
+            prompt = list(np.random.RandomState(11).randint(1, VOCAB, 33))
+            req = provider.engine.generate(
+                prompt, max_new_tokens=8, temperature=0.0
+            )
+            with torch.no_grad():
+                out = hf.generate(
+                    torch.tensor([prompt]), max_new_tokens=8,
+                    do_sample=False,
+                )
+            expect = out[0, len(prompt):].tolist()
+            assert req.output_ids == expect
+        finally:
+            provider.worker.stop()
