@@ -52,6 +52,12 @@ CREATE TABLE IF NOT EXISTS vm_api_keys (
     api_key TEXT NOT NULL,
     created_at REAL NOT NULL
 );
+CREATE TABLE IF NOT EXISTS profiles (
+    profile_id TEXT PRIMARY KEY,
+    name TEXT NOT NULL,
+    config TEXT NOT NULL DEFAULT '{}',
+    created_at REAL NOT NULL
+);
 """
 
 
@@ -246,6 +252,50 @@ class LocalDBClient(DBClient):
             (None if config is None else json.dumps(config), time.time(),
              thread_id),
         )
+
+    # -- profiles ------------------------------------------------------
+    # The reference models multi-tenant profiles in Supabase (threads →
+    # kafka_profiles → profiles joins, supabase.py:458-541); locally a
+    # profile is a named config template a thread copies at creation.
+
+    async def create_profile(
+        self,
+        name: str,
+        config: Optional[Dict[str, Any]] = None,
+        profile_id: Optional[str] = None,
+    ) -> Dict[str, Any]:
+        pid = profile_id or f"profile_{uuid.uuid4().hex[:16]}"
+        now = time.time()
+        await self._run(
+            "INSERT OR REPLACE INTO profiles "
+            "(profile_id, name, config, created_at) VALUES (?,?,?,?)",
+            (pid, name, json.dumps(config or {}), now),
+        )
+        return {"profile_id": pid, "name": name, "config": config or {},
+                "created_at": now}
+
+    async def list_profiles(self) -> List[Dict[str, Any]]:
+        rows = await self._run(
+            "SELECT profile_id, name, config, created_at FROM profiles "
+            "ORDER BY created_at", (), "all",
+        )
+        return [
+            {"profile_id": r["profile_id"], "name": r["name"],
+             "config": json.loads(r["config"]),
+             "created_at": r["created_at"]}
+            for r in (rows or [])
+        ]
+
+    async def get_profile(self, profile_id: str) -> Optional[Dict[str, Any]]:
+        row = await self._run(
+            "SELECT profile_id, name, config, created_at FROM profiles "
+            "WHERE profile_id=?", (profile_id,), "one",
+        )
+        if row is None:
+            return None
+        return {"profile_id": row["profile_id"], "name": row["name"],
+                "config": json.loads(row["config"]),
+                "created_at": row["created_at"]}
 
     async def get_or_create_vm_api_key(self, thread_id: str) -> str:
         row = await self._run(

@@ -26,6 +26,7 @@ agent_done, SURVEY §5.8), so the reference playground works unmodified.
 
 from __future__ import annotations
 
+import hmac
 import json
 import logging
 from typing import Any, AsyncIterator, Dict, List, Optional
@@ -189,7 +190,10 @@ async def create_app(
     )
     await kafka.initialize()
 
-    app = web.Application(middlewares=[cors_middleware(cfg.cors_origins)])
+    app = web.Application(middlewares=[
+        cors_middleware(cfg.cors_origins),
+        auth_middleware(cfg.api_token),
+    ])
     app[STATE_KEY] = {
         "cfg": cfg,
         "db": db,
@@ -232,6 +236,33 @@ def cors_middleware(origins: str):
     return mw
 
 
+def auth_middleware(api_token: Optional[str]):
+    """Optional bearer-token auth (ServingConfig.api_token).
+
+    When a token is configured, every /v1/*, /metrics and /debug route
+    requires `Authorization: Bearer <token>`; /health and /playground stay
+    open (the playground page itself prompts for the token and sends it on
+    its API calls — reference playground/src/components/auth-provider.tsx
+    gates the same surface behind Supabase auth).  No token configured =
+    open server, the reference's local-dev default.
+    """
+    open_paths = ("/health", "/playground")
+
+    @web.middleware
+    async def mw(request: web.Request, handler):
+        if api_token and request.path not in open_paths:
+            supplied = request.headers.get("Authorization", "")
+            if not hmac.compare_digest(supplied, f"Bearer {api_token}"):
+                return web.json_response(
+                    {"error": {"message": "invalid or missing bearer token",
+                               "type": "authentication_error"}},
+                    status=401,
+                )
+        return await handler(request)
+
+    return mw
+
+
 def _add_routes(app: web.Application) -> None:
     r = app.router
     r.add_post("/v1/chat/completions", chat_completions)
@@ -245,6 +276,8 @@ def _add_routes(app: web.Application) -> None:
     r.add_delete("/v1/threads/{thread_id}", delete_thread)
     r.add_delete("/v1/threads/{thread_id}/messages", delete_thread_messages)
     r.add_put("/v1/threads/{thread_id}/config", set_thread_config)
+    r.add_get("/v1/profiles", list_profiles)
+    r.add_post("/v1/profiles", create_profile)
     r.add_get("/v1/models", list_models)
     r.add_get("/health", health)
     r.add_get("/metrics", metrics)
@@ -461,11 +494,65 @@ async def create_thread(request: web.Request) -> web.Response:
             body = await request.json()
         except Exception:
             body = {}
+    # profile inheritance (reference: threads join kafka_profiles for
+    # global_prompt/model config, supabase.py:458-541): a thread created
+    # with profile_id copies that profile's config as its own.  Validated
+    # BEFORE creating the thread — a 400 must not leave an orphan row.
+    pid = body.get("profile_id")
+    profile = None
+    if pid:
+        get_profile = getattr(db, "get_profile", None)
+        profile = await get_profile(pid) if get_profile else None
+        if profile is None:
+            raise web.HTTPBadRequest(
+                text=json.dumps({"error": f"unknown profile {pid!r}"}),
+                content_type="application/json",
+            )
     tid = await db.create_thread(
         thread_id=body.get("thread_id"), metadata=body.get("metadata")
     )
+    if profile is not None:
+        await db.set_thread_config(
+            tid, {**profile["config"], "profile_id": pid}
+        )
     meta = await db.get_thread_metadata(tid)
     return web.json_response(meta, status=201)
+
+
+async def list_profiles(request: web.Request) -> web.Response:
+    db = _state(request)["db"]
+    fn = getattr(db, "list_profiles", None)
+    if fn is None:
+        raise web.HTTPNotImplemented(
+            text='{"error": "profiles unsupported by this DB backend"}',
+            content_type="application/json",
+        )
+    return web.json_response({"profiles": await fn()})
+
+
+async def create_profile(request: web.Request) -> web.Response:
+    db = _state(request)["db"]
+    fn = getattr(db, "create_profile", None)
+    if fn is None:
+        raise web.HTTPNotImplemented(
+            text='{"error": "profiles unsupported by this DB backend"}',
+            content_type="application/json",
+        )
+    try:
+        body = await request.json()
+    except Exception:
+        raise web.HTTPBadRequest(
+            text='{"error": "invalid JSON body"}',
+            content_type="application/json",
+        )
+    name = body.get("name")
+    if not name:
+        raise web.HTTPBadRequest(
+            text='{"error": "profile name required"}',
+            content_type="application/json",
+        )
+    profile = await fn(name, config=body.get("config") or {})
+    return web.json_response(profile, status=201)
 
 
 async def list_threads(request: web.Request) -> web.Response:

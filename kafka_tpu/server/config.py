@@ -44,6 +44,9 @@ class ServingConfig:
     # server
     host: str = "0.0.0.0"
     port: int = 8000
+    # optional bearer-token auth for /v1/* + /metrics (playground parity
+    # with the reference's authed deployment; None = open, the dev default)
+    api_token: Optional[str] = None
     db_path: str = "data/threads.db"
     local_sandbox_url: Optional[str] = None
     cors_origins: str = "*"
@@ -105,6 +108,7 @@ class ServingConfig:
             cp_strategy=get("CP_STRATEGY", cls.cp_strategy),
             host=get("HOST", cls.host),
             port=get("PORT", cls.port, int),
+            api_token=get("API_TOKEN", None),
             db_path=get("DB_PATH", cls.db_path),
             local_sandbox_url=get("SANDBOX_URL", None),
             tiny_model=get("TINY_MODEL", "0") in ("1", "true", "True"),

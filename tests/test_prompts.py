@@ -108,13 +108,30 @@ class TestV1:
         sections totalling 132 lines were placeholders)."""
         p = PromptProviderV1(variables={"current_date": "2026-07-29"})
         total_lines = sum(s.content.count("\n") for s in p.sections)
-        assert total_lines > 500, f"sections regressed to stubs: {total_lines}"
+        assert total_lines > 700, f"sections regressed to stubs: {total_lines}"
         # every tool the framework actually ships is documented by name
         out = p.get_system_prompt()
         for tool in ("create_shell", "shell_exec", "notebook_run_cell",
                      "sequentialthinking", "saveThoughtCheckpoint",
                      "loadThoughtCheckpoint", "idle"):
             assert tool in out, f"tool {tool} undocumented in system prompt"
+
+    def test_documented_argument_names_match_real_schemas(self):
+        """The prompt's per-tool contract blocks must use the tools' REAL
+        parameter names (a prompt teaching snake_case for a camelCase tool
+        silently degrades every forced tool call)."""
+        from kafka_tpu.sandbox.tools import notebook_tools, shell_tools
+        from kafka_tpu.server_tools.planner import PlannerTools
+
+        out = PromptProviderV1(
+            variables={"current_date": "2026-07-29"}
+        ).get_system_prompt()
+        tools = (shell_tools() + notebook_tools() + PlannerTools().tools())
+        for tool in tools:
+            for arg in tool.parameters.get("properties", {}):
+                assert arg in out, (
+                    f"{tool.name} argument {arg!r} undocumented in prompt"
+                )
 
     def test_precedence_and_safety_language_present(self):
         out = PromptProviderV1(
