@@ -73,6 +73,7 @@ class EngineMetrics:
         self.burst_gap_ms: Deque[float] = collections.deque(maxlen=self.window)
         self._last_burst_t: Optional[float] = None
         self._last_step_t: Optional[float] = None
+        self._last_step_steps: int = 1
         self._started = time.monotonic()
 
     # -- engine-thread recording ----------------------------------------
@@ -87,18 +88,23 @@ class EngineMetrics:
     def record_token(self) -> None:
         self.generated_tokens += 1
 
-    def record_decode_step(self, busy_slots: int) -> None:
+    def record_decode_step(self, busy_slots: int, steps: int = 1) -> None:
+        """steps>1 = a fused multi-step dispatch.  The gap between this
+        call and the previous one spans the PREVIOUS dispatch's tokens
+        (back-to-back dispatches overlap that dispatch's device execution),
+        so the TPOT sample divides by the steps recorded last time."""
         now = time.monotonic()
         if self._last_step_t is not None:
             # inter-step time while decoding == per-token latency for every
             # active stream (the definition of TPOT under continuous
             # batching); long gaps (idle engine) are not TPOT — drop them
-            dt = (now - self._last_step_t) * 1e3
+            dt = (now - self._last_step_t) * 1e3 / self._last_step_steps
             if dt < 2_000:
                 self.tpot_ms.append(dt)
         self._last_step_t = now
-        self.decode_steps += 1
-        self.decode_busy_slots += busy_slots
+        self._last_step_steps = steps
+        self.decode_steps += steps
+        self.decode_busy_slots += busy_slots * steps
 
     def mark_idle(self) -> None:
         """The engine drained: the gap until the next decode step is idle

@@ -142,6 +142,36 @@ def build_tpu_provider(cfg: ServingConfig) -> LLMProvider:
                 pp=cfg.pp_size, sp=cfg.sp_size, tp=cfg.tp_size
             ))
         engine = InferenceEngine(model_cfg, params, engine_cfg, mesh=mesh)
+    if cfg.warmup:
+        # Compile the serving programs NOW (engine is not yet driven by the
+        # worker thread, so direct generate() is safe); the first real
+        # request then pays serving latency, not the XLA compile.  Metrics
+        # reset afterwards so /metrics percentiles reflect serving only.
+        import time as _time
+
+        from ..runtime import GenRequest
+        from ..runtime.metrics import EngineMetrics
+
+        t0 = _time.monotonic()
+        ids = tokenizer.encode("warmup")[:8] or [1, 2, 3]
+        engines = getattr(engine, "engines", [engine])
+        # enough concurrent warmup requests per replica to also compile the
+        # fused multi-step decode program (engages at >=3 active lanes);
+        # submitted straight to each replica (no prefix_key: warmup must
+        # not seed the prefix cache or the DP affinity map)
+        per_engine = (
+            3 if engine_cfg.multi_step > 1 and cfg.max_batch >= 3 else 1
+        )
+        for n, e in enumerate(engines):
+            for i in range(per_engine):
+                e.submit(GenRequest(
+                    request_id=f"__warmup_{n}_{i}", prompt_ids=list(ids),
+                    max_new_tokens=engine_cfg.multi_step + 2,
+                ))
+        engine.run_to_completion()
+        for e in engines:
+            e.metrics = EngineMetrics()
+        logger.info("warmup compile done in %.1fs", _time.monotonic() - t0)
     return TPULLMProvider(engine, tokenizer, model_name=cfg.model_name)
 
 

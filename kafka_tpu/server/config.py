@@ -52,6 +52,9 @@ class ServingConfig:
     cors_origins: str = "*"
     # test/dev: tiny random model instead of a real checkpoint
     tiny_model: bool = False
+    # compile the serving programs at boot (one tiny generation per engine)
+    # so the first real request doesn't pay the 20-40s XLA compile
+    warmup: bool = True
 
     @classmethod
     def profile_32k(cls, **overrides) -> "ServingConfig":
@@ -112,5 +115,6 @@ class ServingConfig:
             db_path=get("DB_PATH", cls.db_path),
             local_sandbox_url=get("SANDBOX_URL", None),
             tiny_model=get("TINY_MODEL", "0") in ("1", "true", "True"),
+            warmup=get("WARMUP", "1") not in ("0", "false", "False"),
         )
         return dataclasses.replace(cfg, **overrides)

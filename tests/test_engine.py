@@ -240,3 +240,76 @@ class TestReviewRegressions:
         eng = make_engine(cfg, params)
         eng.generate([1, 2, 3], max_new_tokens=3)
         assert eng._requests == {}
+
+
+class TestMultiStepDecode:
+    """Fused k-step decode dispatches (EngineConfig.multi_step): engage
+    only for busy stable batches and stay token-identical to single-step
+    scheduling (position-keyed RNG makes fusion invisible to outputs)."""
+
+    def _run_batch(self, cfg, params, multi_step, n_req=4, seeds=(0, 1, 2, 3)):
+        eng = make_engine(cfg, params, max_batch=4, num_pages=96,
+                          max_pages_per_seq=12, multi_step=multi_step)
+        dispatched_multi = []
+        orig = eng._dispatch_multi
+        eng._dispatch_multi = lambda k: (dispatched_multi.append(k), orig(k))[1]
+        reqs = []
+        for i in range(n_req):
+            r = GenRequest(
+                request_id=f"ms-{i}", prompt_ids=[2 + i, 9, 23, 54, 7],
+                max_new_tokens=24,
+                temperature=0.0 if i % 2 == 0 else 0.9, seed=seeds[i],
+            )
+            eng.submit(r)
+            reqs.append(r)
+        eng.run_to_completion()
+        return [r.output_ids for r in reqs], dispatched_multi
+
+    def test_multi_step_token_exact_vs_single_step(self, model):
+        cfg, params = model
+        multi, ks = self._run_batch(cfg, params, multi_step=8)
+        single, ks1 = self._run_batch(cfg, params, multi_step=1)
+        assert multi == single
+        assert ks and max(ks) >= 4, f"multi-step never engaged: {ks}"
+        assert ks1 == []
+
+    def test_stop_token_mid_burst_truncates(self, model):
+        cfg, params = model
+        # find each request's natural stop candidate from the single-step
+        # run, then re-run WITH stop tokens under multi-step: the burst may
+        # overshoot the stop on device, but emission must truncate exactly
+        single, _ = self._run_batch(cfg, params, multi_step=1)
+        stops = [out[5] for out in single]
+
+        def with_stops(multi_step):
+            eng = make_engine(cfg, params, max_batch=4, num_pages=96,
+                              max_pages_per_seq=12, multi_step=multi_step)
+            reqs = []
+            for i in range(4):
+                r = GenRequest(
+                    request_id=f"st-{i}", prompt_ids=[2 + i, 9, 23, 54, 7],
+                    max_new_tokens=24,
+                    temperature=0.0 if i % 2 == 0 else 0.9, seed=i,
+                    stop_token_ids=(stops[i],),
+                )
+                eng.submit(r)
+                reqs.append(r)
+            eng.run_to_completion()
+            return [(r.output_ids, r.finish_reason) for r in reqs]
+
+        assert with_stops(8) == with_stops(1)
+
+    def test_multi_step_stays_off_when_waiting(self, model):
+        """Queued requests need per-step admission chances: multi-step must
+        not engage while anyone waits for a slot."""
+        cfg, params = model
+        eng = make_engine(cfg, params, max_batch=4, num_pages=96,
+                          max_pages_per_seq=12, multi_step=8)
+        ks = []
+        orig = eng._dispatch_multi
+        eng._dispatch_multi = lambda k: (ks.append(eng.waiting and k), orig(k))[1]
+        for i in range(6):  # 6 requests > 4 slots -> queue pressure
+            eng.submit(GenRequest(request_id=f"q-{i}",
+                                  prompt_ids=[3 + i, 9, 23], max_new_tokens=16))
+        eng.run_to_completion()
+        assert all(not flag for flag in ks)

@@ -212,3 +212,31 @@ class TestParallelConfig:
                 )
 
         asyncio.run(run())
+
+
+class TestWarmup:
+    def test_boot_warmup_precompiles_and_resets_metrics(self, tmp_path):
+        async def run():
+            client = await _boot(_cfg(tmp_path))  # warmup defaults on
+            try:
+                engine = _engine(client)
+                # the decode program and a prefill bucket compiled at boot
+                assert engine._prefill_fns, "warmup compiled no prefill"
+                # ...and the warmup generation does not pollute metrics
+                m = await (await client.get("/metrics")).json()
+                assert m["requests"]["submitted"] == 0
+                assert m["requests"]["finished"] == 0
+            finally:
+                await client.close()
+
+        asyncio.run(run())
+
+    def test_warmup_disabled_by_config(self, tmp_path):
+        async def run():
+            client = await _boot(_cfg(tmp_path, warmup=False))
+            try:
+                assert not _engine(client)._prefill_fns
+            finally:
+                await client.close()
+
+        asyncio.run(run())
