@@ -76,7 +76,9 @@ from .prefix_cache import PrefixCache
 
 logger = logging.getLogger("kafka_tpu.engine")
 
-WAITING, ACTIVE, DRAINING, FINISHED = "waiting", "active", "draining", "finished"
+WAITING, PREFILLING, ACTIVE, DRAINING, FINISHED = (
+    "waiting", "prefilling", "active", "draining", "finished"
+)
 
 # Compiled step functions are cached per (model cfg, engine shape) so that
 # multiple engine instances (tests, restarts) reuse compilations.
@@ -161,6 +163,10 @@ class GenRequest:
     prefill_ids: List[int] = dataclasses.field(default_factory=list)
     # constrained decoding: fn(output_ids) -> allowed token id list or None
     logits_mask_fn: Optional[Callable[[List[int]], Optional[List[int]]]] = None
+    # device-resident constrained mask for the in-progress prefill (built
+    # once at prefill start; the mask depends only on output_ids, constant
+    # across chunks)
+    prefill_allowed: Optional[Any] = None
     # KV prefix reuse: requests sharing a key (thread id) share cached
     # prompt-prefix pages and re-prefill only the suffix (BASELINE config 2)
     prefix_key: Optional[str] = None
@@ -620,10 +626,21 @@ class InferenceEngine:
         return self.num_active > 0 or bool(self.waiting) or bool(self._pending)
 
     def step(self) -> List[TokenEvent]:
-        """One scheduler iteration: drain matured fetches, admit, decode."""
+        """One scheduler iteration: drain fetches, admit, advance one
+        prefill chunk per prefilling request, decode every active lane.
+
+        Prefill is interleaved, not inlined: a long prompt advances one
+        chunk per iteration while the decode batch keeps stepping, so a
+        2k-token (or 32k-token) admission never stalls co-scheduled streams
+        for its whole prefill — their inter-token gap is bounded by ~one
+        chunk's compute.
+        """
         self._drain(block=False)
         self._admit()
-        if self.num_active:
+        for req in [s for s in self.slots
+                    if s is not None and s.state == PREFILLING]:
+            self._advance_prefill(req)
+        if any(s is not None and s.state == ACTIVE for s in self.slots):
             self._dispatch_decode()
             self._drain(block=False)
         if not self.num_active and not self.waiting and self._pending:
@@ -849,9 +866,9 @@ class InferenceEngine:
                 break  # wait for pages to free up
             self.waiting.pop(0)
             try:
-                self._prefill_request(req, slot)
+                self._start_prefill(req, slot)
             except OutOfPagesError:
-                # couldn't grow mid-prefill; roll back and retry later
+                # couldn't reserve the prompt's pages; roll back, retry later
                 if req.seq:
                     self.pool.free_sequence(req.seq)
                 req.state = WAITING
@@ -859,53 +876,65 @@ class InferenceEngine:
                 self.waiting.insert(0, req)
                 break
 
-    def _prefill_request(self, req: GenRequest, slot: int) -> None:
-        ecfg = self.ecfg
-        req.seq = req.seq or SequencePages(seq_id=req.request_id)
-        start = req.seq.length  # >0 after a prefix-cache hit (_attach_prefix)
-        prompt = np.asarray(req.prefill_ids, np.int32)
-        total = len(prompt)
-        self.pool.ensure_capacity(req.seq, total + 1)
+    def _start_prefill(self, req: GenRequest, slot: int) -> None:
+        """Reserve pages + the batch slot; chunks run via _advance_prefill.
 
+        The lane is masked out of decode (state PREFILLING) until the last
+        chunk lands; decode for other lanes proceeds between chunks.
+        """
+        req.seq = req.seq or SequencePages(seq_id=req.request_id)
+        self.pool.ensure_capacity(req.seq, len(req.prefill_ids) + 1)
         # constrained decoding: the mask depends only on output_ids, which
         # is constant across prefill chunks — build it once
-        allowed = None
+        req.prefill_allowed = None
         if req.logits_mask_fn is not None:
             allowed_ids = req.logits_mask_fn(req.output_ids)
             if allowed_ids is not None:
                 row = np.zeros((1, self.cfg.vocab_size), bool)
                 row[0, np.asarray(allowed_ids, np.int64)] = True
-                allowed = self._dev(row)
-
-        tok = None
-        while start < total:
-            remaining = total - start
-            bucket = next(
-                (b for b in ecfg.prefill_buckets if b >= remaining),
-                ecfg.prefill_buckets[-1],
-            )
-            chunk_len = min(remaining, bucket)
-            chunk = np.zeros(bucket, np.int32)
-            chunk[:chunk_len] = prompt[start : start + chunk_len]
-            page_row = np.full(ecfg.max_pages_per_seq, TRASH_PAGE, np.int32)
-            page_row[: len(req.seq.pages)] = req.seq.pages
-            fn = self._get_prefill_fn(bucket)
-            self.k_pool, self.v_pool, tok = fn(
-                self.params, self.k_pool, self.v_pool,
-                self._dev(page_row), self._dev(chunk),
-                self._dev(np.int32(start)), self._dev(np.int32(chunk_len)),
-                self._dev(np.float32(req.temperature)),
-                self._dev(np.int32(req.top_k)),
-                self._dev(np.float32(req.top_p)),
-                self._dev(np.asarray([req.seed], np.uint32)),
-                allowed,
-            )
-            start += chunk_len
-            req.seq.length = start
-
-        req.state = ACTIVE
+                req.prefill_allowed = self._dev(row)
+        req.state = PREFILLING
         req.slot = slot
         self.slots[slot] = req
+        self._ctl_dirty = True  # decode must mask this lane immediately
+
+    def _advance_prefill(self, req: GenRequest) -> None:
+        """Dispatch ONE prefill chunk; the final chunk activates the lane."""
+        ecfg = self.ecfg
+        start = req.seq.length  # >0 after a prefix-cache hit (_attach_prefix)
+        prompt = req.prefill_ids
+        total = len(prompt)
+        remaining = total - start
+        bucket = next(
+            (b for b in ecfg.prefill_buckets if b >= remaining),
+            ecfg.prefill_buckets[-1],
+        )
+        chunk_len = min(remaining, bucket)
+        chunk = np.zeros(bucket, np.int32)
+        chunk[:chunk_len] = prompt[start : start + chunk_len]
+        page_row = np.full(ecfg.max_pages_per_seq, TRASH_PAGE, np.int32)
+        page_row[: len(req.seq.pages)] = req.seq.pages
+        fn = self._get_prefill_fn(bucket)
+        self.k_pool, self.v_pool, tok = fn(
+            self.params, self.k_pool, self.v_pool,
+            self._dev(page_row), self._dev(chunk),
+            self._dev(np.int32(start)), self._dev(np.int32(chunk_len)),
+            self._dev(np.float32(req.temperature)),
+            self._dev(np.int32(req.top_k)),
+            self._dev(np.float32(req.top_p)),
+            self._dev(np.asarray([req.seed], np.uint32)),
+            req.prefill_allowed,
+        )
+        req.seq.length = start + chunk_len
+        if req.seq.length < total:
+            return  # more chunks to go; decode proceeds meanwhile
+        self._finish_prefill(req, tok)
+
+    def _finish_prefill(self, req: GenRequest, tok) -> None:
+        """Last chunk dispatched: the lane joins the decode batch."""
+        slot = req.slot
+        req.prefill_allowed = None
+        req.state = ACTIVE
         self._ctl_dirty = True
         if req.resumed:
             # Re-entry after preemption: the pending last token is already in
@@ -975,7 +1004,12 @@ class InferenceEngine:
             if self._ensure_pages(req):
                 continue
 
-        active_slots = [s for s in self.slots if s is not None]
+        # PREFILLING lanes are masked out of decode entirely (they are
+        # mid-chunk; their seq state must not be touched by decode
+        # bookkeeping)
+        active_slots = [
+            s for s in self.slots if s is not None and s.state == ACTIVE
+        ]
         if not active_slots:
             return
         k = self._pick_multi_step(active_slots)
@@ -984,9 +1018,13 @@ class InferenceEngine:
             return
         if self._ctl_dirty:
             self._refresh_ctl()
+        full_batch = [
+            s if (s is not None and s.state == ACTIVE) else None
+            for s in self.slots
+        ]
         if all(s.logits_mask_fn is None for s in active_slots):
-            # common case: the whole batch is unconstrained and pipelined
-            self._dispatch_group(list(self.slots), self._d_active, None,
+            # common case: every decodable lane is unconstrained + pipelined
+            self._dispatch_group(full_batch, self._d_active, None,
                                  full=True)
             self.metrics.record_decode_step(len(active_slots))
             return
@@ -1000,7 +1038,8 @@ class InferenceEngine:
         # through the normal aging rules, then build the next mask from the
         # now-complete output_ids and redispatch.
         uncon = [
-            s if (s is not None and s.logits_mask_fn is None) else None
+            s if (s is not None and s.state == ACTIVE
+                  and s.logits_mask_fn is None) else None
             for s in self.slots
         ]
         n_uncon = sum(1 for m in uncon if m is not None)
@@ -1023,7 +1062,8 @@ class InferenceEngine:
         n_con = 0
         if not self._constrained_inflight():
             con = [
-                s if (s is not None and s.logits_mask_fn is not None) else None
+                s if (s is not None and s.state == ACTIVE
+                      and s.logits_mask_fn is not None) else None
                 for s in self.slots
             ]
             n_con = sum(1 for m in con if m is not None)
@@ -1057,6 +1097,10 @@ class InferenceEngine:
             or self.waiting
             or len(active_slots) < 3
             or any(s.logits_mask_fn is not None for s in active_slots)
+            # a prefilling lane advances one chunk per scheduler iteration:
+            # k-token bursts would slow its prefill (and TTFT) by k
+            or any(s is not None and s.state == PREFILLING
+                   for s in self.slots)
         ):
             return 1
         # ONE fused depth only: every distinct k is a separate ~30s XLA
@@ -1098,30 +1142,10 @@ class InferenceEngine:
         )
         self._d_last = last
         self._d_seq_lens = lens
-        toks_seq.copy_to_host_async()
-        self._step_count += k
-        items: List[Optional[GenRequest]] = []
-        last_final: List[Optional[str]] = []
-        for req in self.slots:
-            if req is None:
-                items.append(None)
-                last_final.append(None)
-                continue
-            req.seq.length += k
-            req.dispatched += k
-            items.append(req)
-            # k <= every lane's remaining budget/window, so limits can only
-            # trigger on the burst's final row
-            last_final.append(self._limit_reason_after_dispatch(req))
-        finals = [[None] * len(items) for _ in range(k - 1)] + [last_final]
-        self._pending.append(_Fetch(arr=toks_seq, items=items, final=finals,
-                                    t0=time.monotonic(), steps=k))
+        entry = self._book_dispatch(toks_seq, list(self.slots), steps=k)
         self.metrics.record_decode_step(
-            sum(1 for m in items if m is not None), steps=k
+            sum(1 for m in entry.items if m is not None), steps=k
         )
-        for req, fin in zip(list(self.slots), last_final):
-            if req is not None and fin is not None:
-                self._to_draining(req)
 
     def _constrained_inflight(self) -> bool:
         """Is the constrained micro-batch still waiting on its last fetch?"""
@@ -1154,23 +1178,39 @@ class InferenceEngine:
             None if allowed is None else self._dev(allowed),
         )
         self._d_last = toks if full else jnp.where(d_active, toks, self._d_last)
+        return self._book_dispatch(toks, members, steps=1)
+
+    def _book_dispatch(
+        self,
+        toks: jnp.ndarray,
+        members: List[Optional[GenRequest]],
+        steps: int,
+    ) -> _Fetch:
+        """Shared post-dispatch accounting for single and fused dispatches:
+        advance each member's seq/dispatched counters by `steps`, enqueue
+        the async fetch, and start draining lanes that hit a host-known
+        limit.  `steps` is chosen so limits can only trigger on the final
+        row (see _pick_multi_step); stop tokens may still land on any row
+        and are reconciled when the fetch matures.
+        """
         toks.copy_to_host_async()
-        self._step_count += 1
+        self._step_count += steps
         items: List[Optional[GenRequest]] = []
-        final: List[Optional[str]] = []
+        last_final: List[Optional[str]] = []
         for req in members:
             if req is None:
                 items.append(None)
-                final.append(None)
+                last_final.append(None)
                 continue
-            req.seq.length += 1  # the last_token's kv was just written
-            req.dispatched += 1
+            req.seq.length += steps  # the dispatched tokens' kv slots
+            req.dispatched += steps
             items.append(req)
-            final.append(self._limit_reason_after_dispatch(req))
-        entry = _Fetch(arr=toks, items=items, final=[final],
-                       t0=time.monotonic())
+            last_final.append(self._limit_reason_after_dispatch(req))
+        finals = [[None] * len(items) for _ in range(steps - 1)] + [last_final]
+        entry = _Fetch(arr=toks, items=items, final=finals,
+                       t0=time.monotonic(), steps=steps)
         self._pending.append(entry)
-        for req, fin in zip(members, final):
+        for req, fin in zip(members, last_final):
             if req is not None and fin is not None:
                 self._to_draining(req)
         return entry
@@ -1230,7 +1270,9 @@ class InferenceEngine:
             [s.seq.length if s is not None and s.seq else 0 for s in slots],
             np.int32,
         ))
-        self._d_active = self._dev(np.array([s is not None for s in slots], bool))
+        self._d_active = self._dev(np.array(
+            [s is not None and s.state == ACTIVE for s in slots], bool
+        ))
         self._d_temps = self._dev(np.array(
             [s.temperature if s else 0.0 for s in slots], np.float32))
         self._d_top_ks = self._dev(np.array(
@@ -1304,7 +1346,10 @@ class InferenceEngine:
         # final output token stays out: its KV was never written (it is the
         # pending decode input) — the resume prefill's sampled token is
         # discarded and decode continues from output_ids[-1] (see `resumed`).
+        # A victim caught mid-prefill has no outputs yet: it restarts as a
+        # plain fresh prefill (resumed=False — there is no pending token).
         victim.prefill_ids = victim.prompt_ids + victim.output_ids[:-1]
         victim.state = WAITING
-        victim.resumed = True
+        victim.resumed = bool(victim.output_ids)
+        victim.prefill_allowed = None
         self.waiting.insert(0, victim)
