@@ -58,7 +58,10 @@ def decode_phase(engine, cfg, batch: int, prompt_len: int, gen_len: int,
             request_id=f"bench-b{batch}-{i}",
             prompt_ids=make_prompt(rng, prompt_len, cfg.vocab_size),
             max_new_tokens=gen_len))
-    while engine.num_active < batch:  # admit everyone (prefill)
+    # admit everyone AND finish their (interleaved) prefills: num_active
+    # counts PREFILLING lanes too, so gate on decode-ready state
+    while sum(1 for s in engine.slots
+              if s is not None and s.state == "active") < batch:
         engine.step()
     # Flush in-flight fetches and discard their buffered events so the
     # clock covers only tokens whose dispatch AND drain fall inside the
@@ -148,9 +151,13 @@ def main() -> None:
     def prompt(n=None):
         return make_prompt(rng, n or args.prompt_len, cfg.vocab_size)
 
-    # ---- warmup: compile prefill bucket + decode programs ----------------
+    # ---- warmup: compile prefill buckets + decode programs ---------------
+    # every prompt length the bench uses gets its bucket compiled here —
+    # a bucket compiling inside a measured phase once cost the concurrent-
+    # thread metric a silent 15s (r02/r03 measured ~2 req/s; real ~25)
     t0 = time.monotonic()
     engine.generate(prompt(), max_new_tokens=4)
+    engine.generate(prompt(args.prompt_len // 2), max_new_tokens=2)
     if args.batch >= 3 and ecfg.multi_step > 1:
         # the fused multi-step decode program compiles on its first busy
         # batch — trigger that here, not inside the measured decode phase

@@ -405,11 +405,23 @@ class InferenceEngine:
         return "pallas" if ok else "xla"
 
     def _dev(self, x) -> jnp.ndarray:
-        """Host -> device, replicated across the mesh when one is active."""
+        """Host -> device, replicated across the mesh when one is active.
+        For device-RESIDENT state (control arrays reused across steps)."""
         arr = jnp.asarray(x)
         if self._replicated is not None:
             arr = jax.device_put(arr, self._replicated)
         return arr
+
+    def _arg(self, x):
+        """Prepare a host value used once as a jit argument.
+
+        Single device: pass the numpy value through — jit transfers it as
+        part of the call, which is one tunnel command instead of a
+        standalone device_put per argument (~6ms each on tunneled links;
+        a prefill chunk passes seven).  Mesh engines still place
+        explicitly so every argument is replicated across devices.
+        """
+        return self._dev(x) if self._replicated is not None else x
 
     # ------------------------------------------------------------------
     # jitted device programs
@@ -917,12 +929,12 @@ class InferenceEngine:
         fn = self._get_prefill_fn(bucket)
         self.k_pool, self.v_pool, tok = fn(
             self.params, self.k_pool, self.v_pool,
-            self._dev(page_row), self._dev(chunk),
-            self._dev(np.int32(start)), self._dev(np.int32(chunk_len)),
-            self._dev(np.float32(req.temperature)),
-            self._dev(np.int32(req.top_k)),
-            self._dev(np.float32(req.top_p)),
-            self._dev(np.asarray([req.seed], np.uint32)),
+            self._arg(page_row), self._arg(chunk),
+            self._arg(np.int32(start)), self._arg(np.int32(chunk_len)),
+            self._arg(np.float32(req.temperature)),
+            self._arg(np.int32(req.top_k)),
+            self._arg(np.float32(req.top_p)),
+            self._arg(np.asarray([req.seed], np.uint32)),
             req.prefill_allowed,
         )
         req.seq.length = start + chunk_len
@@ -1044,7 +1056,7 @@ class InferenceEngine:
         ]
         n_uncon = sum(1 for m in uncon if m is not None)
         if n_uncon:
-            d_act = self._dev(np.array([m is not None for m in uncon]))
+            d_act = self._arg(np.array([m is not None for m in uncon]))
             self._dispatch_group(uncon, d_act, None, full=False)
         if self._constrained_inflight():
             # The constrained fetch matures at ~RTT age (the transfer has
@@ -1069,7 +1081,7 @@ class InferenceEngine:
             n_con = sum(1 for m in con if m is not None)
             if n_con:
                 allowed = self._build_allowed_mask()
-                d_act = self._dev(np.array([m is not None for m in con]))
+                d_act = self._arg(np.array([m is not None for m in con]))
                 self._constrained_fetch = self._dispatch_group(
                     con, d_act, allowed, full=False
                 )
@@ -1175,7 +1187,7 @@ class InferenceEngine:
             self._d_table, self._d_last, self._d_seq_lens,
             d_active, self._d_temps, self._d_top_ks,
             self._d_top_ps, self._d_seeds,
-            None if allowed is None else self._dev(allowed),
+            None if allowed is None else self._arg(allowed),
         )
         self._d_last = toks if full else jnp.where(d_active, toks, self._d_last)
         return self._book_dispatch(toks, members, steps=1)
@@ -1289,13 +1301,18 @@ class InferenceEngine:
         Fast path first: in the common unconstrained case nothing is
         allocated on the per-token hot path.
         """
-        if not any(s is not None and s.logits_mask_fn is not None for s in self.slots):
+        if not any(
+            s is not None and s.state == ACTIVE
+            and s.logits_mask_fn is not None
+            for s in self.slots
+        ):
             return None
         V = self.cfg.vocab_size
         rows = []
         any_mask = False
         for s in self.slots:
-            if s is not None and s.logits_mask_fn is not None:
+            if (s is not None and s.state == ACTIVE
+                    and s.logits_mask_fn is not None):
                 allowed = s.logits_mask_fn(s.output_ids)
                 if allowed is not None:
                     row = np.zeros(V, bool)
