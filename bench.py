@@ -197,6 +197,14 @@ def main() -> None:
                           max_new_tokens=1, prefix_key="bench-thread")
     cache_engine.submit(seed_req)
     cache_engine.run_to_completion()
+    # a hit prefills only the suffix -> the smallest bucket; compile it
+    # OUTSIDE the measured loop (compile-in-window was exactly the r02/r03
+    # concurrent-thread pollution)
+    warm_hit = GenRequest(request_id="warm-hit",
+                          prompt_ids=base + prompt(suffix),
+                          max_new_tokens=1, prefix_key="bench-thread")
+    cache_engine.submit(warm_hit)
+    cache_engine.run_to_completion()
     cold_ttfts, hit_ttfts = [], []
     reused0 = cache_engine.prefix_cache.tokens_reused
     n_pairs = 3 if args.quick else 5

@@ -1056,7 +1056,8 @@ class InferenceEngine:
         ]
         n_uncon = sum(1 for m in uncon if m is not None)
         if n_uncon:
-            d_act = self._arg(np.array([m is not None for m in uncon]))
+            # device copy (not _arg): the where-merge of _d_last reuses it
+            d_act = self._dev(np.array([m is not None for m in uncon]))
             self._dispatch_group(uncon, d_act, None, full=False)
         if self._constrained_inflight():
             # The constrained fetch matures at ~RTT age (the transfer has
@@ -1081,7 +1082,7 @@ class InferenceEngine:
             n_con = sum(1 for m in con if m is not None)
             if n_con:
                 allowed = self._build_allowed_mask()
-                d_act = self._arg(np.array([m is not None for m in con]))
+                d_act = self._dev(np.array([m is not None for m in con]))
                 self._constrained_fetch = self._dispatch_group(
                     con, d_act, allowed, full=False
                 )

@@ -88,6 +88,17 @@ def build_tpu_provider(cfg: ServingConfig) -> LLMProvider:
     # backend"); returns False and costs nothing single-process
     init_distributed()
 
+    if cfg.compile_cache_dir:
+        # persistent XLA compile cache: a warm reboot loads every serving
+        # program from disk instead of recompiling (~30s per bucket)
+        import os as _os
+
+        cache_dir = _os.path.expanduser(cfg.compile_cache_dir)
+        _os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+
     if cfg.checkpoint_dir:
         tokenizer = load_tokenizer(cfg.checkpoint_dir)
         model_cfg, params = load_checkpoint(cfg.checkpoint_dir)
@@ -153,19 +164,34 @@ def build_tpu_provider(cfg: ServingConfig) -> LLMProvider:
         from ..runtime.metrics import EngineMetrics
 
         t0 = _time.monotonic()
-        ids = tokenizer.encode("warmup")[:8] or [1, 2, 3]
         engines = getattr(engine, "engines", [engine])
-        # enough concurrent warmup requests per replica to also compile the
-        # fused multi-step decode program (engages at >=3 active lanes);
-        # submitted straight to each replica (no prefix_key: warmup must
-        # not seed the prefix cache or the DP affinity map)
+        # Every prefill bucket compiles now — a real conversation grows
+        # through the bucket ladder, and each uncompiled bucket would cost
+        # its first request a ~30s stall.  One prompt per bucket (sized to
+        # land in it), plus enough concurrent requests per replica to also
+        # compile the fused multi-step decode program (engages at >=3
+        # active lanes).  Submitted straight to each replica, with no
+        # prefix_key: warmup must not seed the prefix cache or the DP
+        # affinity map.
+        window = engine_cfg.max_window
+        bucket_lens = sorted({
+            min(b, window - engine_cfg.multi_step - 4)
+            for b in engine_cfg.prefill_buckets
+        })
         per_engine = (
             3 if engine_cfg.multi_step > 1 and cfg.max_batch >= 3 else 1
         )
         for n, e in enumerate(engines):
+            for j, blen in enumerate(bucket_lens):
+                e.submit(GenRequest(
+                    request_id=f"__warmup_b{n}_{j}",
+                    prompt_ids=[3] * max(1, blen), max_new_tokens=1,
+                ))
+                e.run_to_completion()  # one at a time: bounded pool use
             for i in range(per_engine):
                 e.submit(GenRequest(
-                    request_id=f"__warmup_{n}_{i}", prompt_ids=list(ids),
+                    request_id=f"__warmup_{n}_{i}",
+                    prompt_ids=[3] * min(8, window // 4),
                     max_new_tokens=engine_cfg.multi_step + 2,
                 ))
         engine.run_to_completion()

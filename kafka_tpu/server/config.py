@@ -55,6 +55,9 @@ class ServingConfig:
     # compile the serving programs at boot (one tiny generation per engine)
     # so the first real request doesn't pay the 20-40s XLA compile
     warmup: bool = True
+    # persistent XLA compilation cache: warm reboots reuse compiled
+    # programs from disk instead of recompiling every bucket ("" disables)
+    compile_cache_dir: str = "~/.cache/kafka_tpu/xla"
 
     @classmethod
     def profile_32k(cls, **overrides) -> "ServingConfig":
@@ -116,5 +119,6 @@ class ServingConfig:
             local_sandbox_url=get("SANDBOX_URL", None),
             tiny_model=get("TINY_MODEL", "0") in ("1", "true", "True"),
             warmup=get("WARMUP", "1") not in ("0", "false", "False"),
+            compile_cache_dir=get("COMPILE_CACHE", cls.compile_cache_dir),
         )
         return dataclasses.replace(cfg, **overrides)

@@ -1,0 +1,95 @@
+"""REAL multi-process jax.distributed coverage (SURVEY §2.2 "distributed
+communication backend").
+
+`init_distributed` was previously exercised only as a single-process
+no-op; here two OS processes form a 2-host topology over CPU (Gloo
+collectives stand in for DCN), build a global dp x tp mesh spanning both
+processes, and run a psum through shard_map — the exact mechanics a
+multi-host TPU pod uses, minus the silicon.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+
+_WORKER = textwrap.dedent("""
+    import os, sys
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, %(repo)r)
+    from kafka_tpu.parallel.distributed import init_distributed
+
+    assert init_distributed(), "env-driven init did not activate"
+    assert jax.process_count() == 2
+    assert len(jax.devices()) == 8          # global view: 2 procs x 4
+    assert len(jax.local_devices()) == 4    # local view
+
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()).reshape(2, 4), ("dp", "tp"))
+
+    def f(x):
+        return jax.lax.psum(x, "tp")
+
+    g = jax.jit(jax.shard_map(f, mesh=mesh,
+                              in_specs=P("dp", "tp"), out_specs=P("dp", "tp")))
+    x = jax.device_put(
+        jnp.arange(8.0).reshape(2, 4),
+        NamedSharding(mesh, P("dp", "tp")),
+    )
+    out = g(x)
+    # each row's psum over tp: row 0 -> 6, row 1 -> 22; verify the shards
+    # THIS process can address (global fetch is illegal across processes)
+    expect = {0: 6.0, 1: 22.0}
+    for shard in out.addressable_shards:
+        row = shard.index[0].start or 0
+        np.testing.assert_allclose(np.asarray(shard.data), expect[row])
+    print("MULTIHOST_OK", jax.process_index(), flush=True)
+""")
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_distributed_mesh():
+    port = _free_port()  # per-run coordinator port: no cross-run collisions
+    procs = []
+    try:
+        for pid in range(2):
+            env = dict(os.environ)
+            env.update(
+                KAFKA_TPU_COORDINATOR=f"localhost:{port}",
+                KAFKA_TPU_NUM_PROCESSES="2",
+                KAFKA_TPU_PROCESS_ID=str(pid),
+            )
+            # the workers must not inherit this process's already-
+            # initialized jax via sitecustomize; they configure their own
+            env.pop("PYTHONPATH", None)
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c",
+                 _WORKER % {"repo": os.path.dirname(os.path.dirname(
+                     os.path.abspath(__file__)))}],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            ))
+        outs = []
+        for p in procs:
+            out, err = p.communicate(timeout=220)
+            assert p.returncode == 0, err.decode()[-2000:]
+            outs.append(out.decode())
+        assert "MULTIHOST_OK 0" in outs[0] + outs[1]
+        assert "MULTIHOST_OK 1" in outs[0] + outs[1]
+    finally:
+        for p in procs:  # never leak a worker pinning the rendezvous port
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=10)
