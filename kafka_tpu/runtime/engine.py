@@ -119,8 +119,10 @@ class EngineConfig:
     # Decode steps fused into one device dispatch (lax.scan) when the batch
     # is busy and stable — amortizes per-dispatch host/tunnel overhead,
     # which measures ~1ms/step on tunneled links vs a ~5.7ms device step.
-    # Engages only with >=3 active streams, nobody waiting, and no
-    # constrained lanes (scheduling cannot change mid-burst); 1 disables.
+    # Engages with >=3 active streams, no constrained lanes, and no lane
+    # mid-prefill; a waiting queue with every slot busy keeps fusion ON
+    # (admission waits at most k-1 steps, ~35ms — see _pick_multi_step).
+    # 1 disables.
     multi_step: int = 8
 
     @property
@@ -1096,24 +1098,31 @@ class InferenceEngine:
         """How many decode steps to fuse into the next dispatch.
 
         Multi-step trades scheduling granularity for amortized dispatch
-        overhead, so it engages only when granularity is worthless: nobody
-        waiting for a slot, no constrained lanes (masks need per-token host
-        turnaround), and enough active streams that per-token emission
-        cadence is burst-dominated anyway.  k is capped so no lane can hit
-        a budget/window limit mid-burst (stop tokens may still land
-        mid-burst; the speculative-decode reconciliation already truncates
-        those).  Power-of-two buckets bound the compile variants.
+        overhead, so it engages only when granularity is cheap: no
+        constrained lanes (masks need per-token host turnaround), no lane
+        mid-prefill (chunks advance once per iteration; bursts would slow
+        TTFT by k), and enough active streams that per-token emission
+        cadence is burst-dominated anyway.  A non-empty waiting queue does
+        NOT disengage fusion: with every slot busy, admission can only
+        happen at an iteration boundary regardless, so fusing costs a
+        waiting request at most k-1 steps (~35ms) of extra queueing while
+        the whole batch keeps its amortized-dispatch throughput — under
+        sustained load (BASELINE config 3's regime) someone is ALWAYS
+        waiting, which is exactly when throughput matters most.  k is
+        capped so no lane can hit a budget/window limit mid-burst (stop
+        tokens may still land mid-burst; the speculative-decode
+        reconciliation already truncates those).
         """
         ecfg = self.ecfg
         if (
             ecfg.multi_step <= 1
-            or self.waiting
             or len(active_slots) < 3
             or any(s.logits_mask_fn is not None for s in active_slots)
-            # a prefilling lane advances one chunk per scheduler iteration:
-            # k-token bursts would slow its prefill (and TTFT) by k
             or any(s is not None and s.state == PREFILLING
                    for s in self.slots)
+            # a free slot + waiting queue means admission is page-blocked;
+            # stay fine-grained so relief (retire/reclaim) happens sooner
+            or (self.waiting and self._free_slot() is not None)
         ):
             return 1
         # ONE fused depth only: every distinct k is a separate ~30s XLA
