@@ -223,6 +223,7 @@ class InferenceEngine:
         self.ecfg = engine_cfg or EngineConfig()
         self.mesh = mesh
         sp = mesh.shape.get("sp", 1) if mesh is not None else 1
+        self._sp = sp
         self._pp = mesh.shape.get("pp", 1) if mesh is not None else 1
         if self._pp > 1:
             if sp > 1:
@@ -486,6 +487,66 @@ class InferenceEngine:
         _FN_CACHE[cache_key] = jitted
         return jitted
 
+    def _get_batched_prefill_fn(self, bucket: int, width: int):
+        """Prefill chunks for `width` sequences in ONE dispatch.
+
+        Same index-plan semantics as the single-sequence program but with a
+        leading lane axis: per-lane page rows, starts, and chunk lengths
+        (inactive lanes write the trash page and sample garbage that the
+        scheduler discards).  Used when several admissions share a bucket —
+        one host dispatch instead of one per sequence, and the chunk
+        matmuls batch.  The B>1 shape keeps the XLA attention formulation
+        (the flash kernel's contract is single-sequence).
+        """
+        cfg, ecfg, mesh = self.cfg, self.ecfg, self.mesh
+        ps, C = ecfg.page_size, ecfg.max_window
+        cache_key = ("bprefill", cfg, bucket, width, ps, C,
+                     ecfg.max_pages_per_seq, self.mesh)
+        if cache_key in _FN_CACHE:
+            return _FN_CACHE[cache_key]
+
+        def fn(params, k_pool, v_pool, page_rows, chunks, starts,
+               chunk_lens, temps, top_ks, top_ps, seeds, lane_active):
+            S, W = bucket, width
+            local = jnp.arange(S)[None, :]
+            pos = starts[:, None] + local  # [W, S]
+            in_chunk = (local < chunk_lens[:, None]) & lane_active[:, None]
+            page_idx = jnp.take_along_axis(page_rows, pos // ps, axis=1)
+            write_idx = jnp.where(
+                in_chunk, page_idx * ps + pos % ps, local % ps
+            )
+            read_idx = (
+                page_rows[:, :, None] * ps + jnp.arange(ps)[None, None, :]
+            ).reshape(W, C)
+            kv_positions = jnp.broadcast_to(jnp.arange(C)[None, :], (W, C))
+            kv_valid = (
+                kv_positions < (starts + chunk_lens)[:, None]
+            ) & lane_active[:, None]
+            paged = PagedView(
+                write_idx, read_idx, kv_positions, kv_valid,
+                page_table=page_rows, page_size=ps,
+            )
+            logits, cache = forward(
+                params, cfg, chunks, pos,
+                kv_cache=KVCache(k_pool, v_pool), paged=paged, mesh=mesh,
+            )
+            last = jnp.clip(chunk_lens - 1, 0, S - 1)
+            final_logits = jnp.take_along_axis(
+                logits, last[:, None, None], axis=1
+            )[:, 0]  # [W, V]
+            keys = jax.vmap(
+                lambda s, p: jax.random.fold_in(jax.random.key(s), p)
+            )(seeds, starts + chunk_lens - 1)
+            toks = sample_tokens_per_slot(
+                final_logits, SamplingParams(temps, top_ks, top_ps), keys,
+                None,
+            )
+            return cache.k, cache.v, toks
+
+        jitted = jax.jit(fn, donate_argnums=(1, 2))
+        _FN_CACHE[cache_key] = jitted
+        return jitted
+
     def _get_multi_decode_fn(self, steps: int):
         """k fused decode steps in one dispatch (lax.scan over the step
         body).  Sampling stays per-(seed, position) via the in-carry
@@ -651,9 +712,7 @@ class InferenceEngine:
         """
         self._drain(block=False)
         self._admit()
-        for req in [s for s in self.slots
-                    if s is not None and s.state == PREFILLING]:
-            self._advance_prefill(req)
+        self._advance_prefills()
         if any(s is not None and s.state == ACTIVE for s in self.slots):
             self._dispatch_decode()
             self._drain(block=False)
@@ -912,6 +971,120 @@ class InferenceEngine:
         self.slots[slot] = req
         self._ctl_dirty = True  # decode must mask this lane immediately
 
+    def _prefill_bucket_for(self, req: GenRequest) -> int:
+        remaining = len(req.prefill_ids) - req.seq.length
+        return next(
+            (b for b in self.ecfg.prefill_buckets if b >= remaining),
+            self.ecfg.prefill_buckets[-1],
+        )
+
+    def _advance_prefills(self) -> None:
+        """Advance every prefilling lane one chunk this iteration.
+
+        Lanes whose next chunk shares a bucket advance TOGETHER through the
+        batched prefill program (one dispatch instead of one per sequence —
+        admission storms of short thread turns are exactly this shape);
+        constrained lanes and sp/pp meshes take the single-sequence path.
+        """
+        prefilling = [
+            s for s in self.slots if s is not None and s.state == PREFILLING
+        ]
+        if not prefilling:
+            return
+        W = min(4, self.ecfg.max_batch)
+        groups: Dict[int, List[GenRequest]] = {}
+        singles: List[GenRequest] = []
+        for req in prefilling:
+            bucket = self._prefill_bucket_for(req)
+            if (
+                W >= 2
+                # constrained lanes need the single path end to end: its
+                # final chunk pops the sampled token synchronously so the
+                # first decode mask sees complete output_ids
+                and req.logits_mask_fn is None
+                and self._sp == 1
+                and self._pp == 1
+                # on pallas backends the single-sequence path runs the
+                # flash prefill kernel; forfeit it only for small chunks
+                # where dispatch overhead dominates the attention work
+                and (self.cfg.attention_backend != "pallas" or bucket <= 128)
+            ):
+                groups.setdefault(bucket, []).append(req)
+            else:
+                singles.append(req)
+        for bucket, reqs in groups.items():
+            while len(reqs) >= 2:
+                take, reqs = reqs[:W], reqs[W:]
+                self._advance_prefill_batch(bucket, take, W)
+            singles.extend(reqs)
+        for req in singles:
+            self._advance_prefill(req)
+
+    def _advance_prefill_batch(
+        self, bucket: int, reqs: List[GenRequest], W: int
+    ) -> None:
+        """One fused chunk dispatch for 2..W same-bucket lanes."""
+        ecfg = self.ecfg
+        page_rows = np.full((W, ecfg.max_pages_per_seq), TRASH_PAGE, np.int32)
+        chunks = np.zeros((W, bucket), np.int32)
+        starts = np.zeros(W, np.int32)
+        chunk_lens = np.zeros(W, np.int32)
+        temps = np.zeros(W, np.float32)
+        top_ks = np.zeros(W, np.int32)
+        top_ps = np.ones(W, np.float32)
+        seeds = np.zeros(W, np.uint32)
+        lane_active = np.zeros(W, bool)
+        for i, req in enumerate(reqs):
+            start = req.seq.length
+            prompt = req.prefill_ids
+            clen = min(len(prompt) - start, bucket)
+            chunks[i, :clen] = prompt[start:start + clen]
+            page_rows[i, : len(req.seq.pages)] = req.seq.pages
+            starts[i] = start
+            chunk_lens[i] = clen
+            temps[i] = req.temperature
+            top_ks[i] = req.top_k
+            top_ps[i] = req.top_p
+            seeds[i] = req.seed
+            lane_active[i] = True
+        fn = self._get_batched_prefill_fn(bucket, W)
+        self.k_pool, self.v_pool, toks = fn(
+            self.params, self.k_pool, self.v_pool,
+            self._arg(page_rows), self._arg(chunks), self._arg(starts),
+            self._arg(chunk_lens), self._arg(temps), self._arg(top_ks),
+            self._arg(top_ps), self._arg(seeds), self._arg(lane_active),
+        )
+        items: List[Optional[GenRequest]] = [None] * W
+        finals_row: List[Optional[str]] = [None] * W
+        for i, req in enumerate(reqs):
+            req.seq.length += int(chunk_lens[i])
+            if req.seq.length < len(req.prefill_ids):
+                continue  # more chunks to go
+            req.prefill_allowed = None
+            req.state = ACTIVE
+            self._ctl_dirty = True
+            if req.resumed:
+                # pending token already known host-side (see _finish_prefill)
+                req.resumed = False
+                self._d_last = self._d_last.at[req.slot].set(
+                    req.output_ids[-1]
+                )
+                continue
+            self._d_last = self._d_last.at[req.slot].set(toks[i])
+            req.dispatched += 1
+            fin = self._limit_reason_after_dispatch(req)
+            items[i] = req
+            finals_row[i] = fin
+        if any(m is not None for m in items):
+            toks.copy_to_host_async()
+            self._pending.append(_Fetch(
+                arr=toks, items=items, final=[finals_row],
+                t0=time.monotonic(),
+            ))
+            for req, fin in zip(items, finals_row):
+                if req is not None and fin is not None:
+                    self._to_draining(req)
+
     def _advance_prefill(self, req: GenRequest) -> None:
         """Dispatch ONE prefill chunk; the final chunk activates the lane."""
         ecfg = self.ecfg
@@ -919,10 +1092,7 @@ class InferenceEngine:
         prompt = req.prefill_ids
         total = len(prompt)
         remaining = total - start
-        bucket = next(
-            (b for b in ecfg.prefill_buckets if b >= remaining),
-            ecfg.prefill_buckets[-1],
-        )
+        bucket = self._prefill_bucket_for(req)
         chunk_len = min(remaining, bucket)
         chunk = np.zeros(bucket, np.int32)
         chunk[:chunk_len] = prompt[start : start + chunk_len]

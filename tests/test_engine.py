@@ -367,3 +367,85 @@ class TestInterleavedPrefill:
         prompt = list(np.random.RandomState(12).randint(1, 128, size=45))
         req = eng.generate(prompt, max_new_tokens=6)
         assert_greedy_consistent(cfg, params, prompt, req.output_ids)
+
+
+class TestBatchedPrefill:
+    """Same-bucket prefills fuse into one dispatch; outputs must be
+    token-identical to solo runs (position-keyed sampling; f32 tests)."""
+
+    def test_batched_admission_token_exact(self, model):
+        cfg, params = model
+        mk = lambda: make_engine(cfg, params, max_batch=4, num_pages=96,
+                                 max_pages_per_seq=12)
+        # solo baselines
+        solo = []
+        ref_eng = mk()
+        for i in range(4):
+            r = ref_eng.generate([5 + i, 9, 23, 54, 7, 2, 11, 3],
+                                 max_new_tokens=12,
+                                 temperature=0.0 if i % 2 == 0 else 1.1,
+                                 seed=i)
+            solo.append(r.output_ids)
+        # batched admission: all 4 submitted before stepping -> the 4
+        # same-bucket first chunks ride ONE dispatch
+        eng = mk()
+        batched_calls = []
+        orig = eng._advance_prefill_batch
+        eng._advance_prefill_batch = (
+            lambda b, rs, w: (batched_calls.append(len(rs)), orig(b, rs, w))[1])
+        reqs = []
+        for i in range(4):
+            r = GenRequest(request_id=f"bp-{i}",
+                           prompt_ids=[5 + i, 9, 23, 54, 7, 2, 11, 3],
+                           max_new_tokens=12,
+                           temperature=0.0 if i % 2 == 0 else 1.1, seed=i)
+            eng.submit(r)
+            reqs.append(r)
+        eng.run_to_completion()
+        assert batched_calls and max(batched_calls) >= 2, batched_calls
+        assert [r.output_ids for r in reqs] == solo
+
+    def test_constrained_lane_never_fuses(self, model):
+        """A constrained request admitted alongside same-bucket peers must
+        take the single-sequence path (its final chunk pops the sampled
+        token synchronously so the first decode mask sees complete
+        output_ids) — fusing it reorders token visibility and breaks the
+        mask contract."""
+        cfg, params = model
+
+        def run(with_peers):
+            eng = make_engine(cfg, params, max_batch=4, num_pages=96,
+                              max_pages_per_seq=12)
+            mask = lambda out: None if not out else [out[0] + 1, out[0] + 2]
+            c = GenRequest(request_id="c", prompt_ids=[5, 9, 23, 54],
+                           max_new_tokens=6, logits_mask_fn=mask)
+            eng.submit(c)
+            if with_peers:
+                for i in range(3):
+                    eng.submit(GenRequest(request_id=f"p{i}",
+                                          prompt_ids=[6 + i, 9, 23, 54],
+                                          max_new_tokens=6))
+            eng.run_to_completion()
+            return c.output_ids
+
+        solo = run(with_peers=False)
+        assert run(with_peers=True) == solo
+
+    def test_mixed_bucket_admissions_split_correctly(self, model):
+        """Different prompt lengths land in different buckets: each group
+        fuses, singletons go solo, everything stays correct."""
+        cfg, params = model
+        eng = make_engine(cfg, params, max_batch=4, num_pages=96,
+                          max_pages_per_seq=12, prefill_buckets=(8, 32))
+        lens = [6, 7, 20, 25]  # two in bucket 8, two in bucket 32
+        reqs = []
+        for i, n in enumerate(lens):
+            r = GenRequest(
+                request_id=f"mix-{i}",
+                prompt_ids=list(np.random.RandomState(i).randint(1, 128, n)),
+                max_new_tokens=6)
+            eng.submit(r)
+            reqs.append(r)
+        eng.run_to_completion()
+        for r in reqs:
+            assert_greedy_consistent(cfg, params, r.prompt_ids, r.output_ids)
