@@ -158,6 +158,14 @@ def main() -> None:
     t0 = time.monotonic()
     engine.generate(prompt(), max_new_tokens=4)
     engine.generate(prompt(args.prompt_len // 2), max_new_tokens=2)
+    if args.batch >= 2:
+        # concurrent same-bucket admissions take the BATCHED prefill
+        # program; compile it for the concurrent-thread phase's bucket
+        for i in range(2):
+            engine.submit(GenRequest(
+                request_id=f"warm-bp-{i}",
+                prompt_ids=prompt(args.prompt_len // 2), max_new_tokens=2))
+        engine.run_to_completion()
     if args.batch >= 3 and ecfg.multi_step > 1:
         # the fused multi-step decode program compiles on its first busy
         # batch — trigger that here, not inside the measured decode phase
