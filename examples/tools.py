@@ -5,7 +5,11 @@ streaming demo tool, and how custom tools are declared.  Reuses the
 built-ins the server ships (server_tools/) rather than duplicating them.
 """
 
+import os
+import sys
 from typing import List
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from kafka_tpu.server_tools.counter import counter_tool
 from kafka_tpu.server_tools.weather import weather_tool
