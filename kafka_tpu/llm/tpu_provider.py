@@ -34,6 +34,7 @@ from ..core.types import (
     ContextLengthError,
     LLMProviderError,
     StreamChunk,
+    UnsupportedContentError,
     Usage,
     new_completion_id,
 )
@@ -41,7 +42,7 @@ from ..models.config import ModelConfig
 from ..models.tokenizer import BaseTokenizer, parse_tool_call_text
 from ..runtime.engine import GenRequest, InferenceEngine, TokenEvent
 from .base import LLMProvider, MessageLike, to_message_dicts
-from .utils import prune_images
+from .utils import count_images
 from .worker import EngineWorker
 
 logger = logging.getLogger("kafka_tpu.llm.tpu")
@@ -97,14 +98,12 @@ class TPULLMProvider(LLMProvider):
         tokenizer: BaseTokenizer,
         model_name: str = "llama",
         worker: Optional[EngineWorker] = None,
-        max_images: int = 19,
     ):
         self.engine = engine
         self.tokenizer = tokenizer
         self.model_name = model_name
         self.worker = worker or EngineWorker(engine)
         self.worker.start()
-        self.max_images = max_images
         self._counter = itertools.count()
         # pre-build the constrained-decoding vocab index off the event loop
         # so the first tool_choice-constrained request doesn't stall serving
@@ -182,7 +181,15 @@ class TPULLMProvider(LLMProvider):
         **kwargs: Any,
     ) -> AsyncIterator[StreamChunk]:
         self.validate_messages(messages)
-        dicts = prune_images(to_message_dicts(messages), self.max_images)
+        dicts = to_message_dicts(messages)
+        # Text-only engine: reject image parts loudly (typed 400) rather
+        # than silently flattening them — the model must not answer as if
+        # it saw an image it never received.  prune_images (the reference's
+        # newest-19 bookkeeping, llm/utils.py) remains for deployments that
+        # front a vision-capable model.
+        n_images = count_images(dicts)
+        if n_images:
+            raise UnsupportedContentError(n_images, provider=self.provider_name)
         prompt_ids = self.tokenizer.encode_chat(dicts, tools=tools)
         if len(prompt_ids) > self.max_prompt_tokens:
             raise ContextLengthError(

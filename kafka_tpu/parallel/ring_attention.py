@@ -272,12 +272,23 @@ def ulysses_prefill(
     kh = _a2a_seq_to_heads(k_chunk, axis_name)
     vh = _a2a_seq_to_heads(v_chunk, axis_name)
     pos_full = lax.all_gather(q_positions, axis_name, axis=1, tiled=True)
-    k_ctx_loc = lax.dynamic_slice_in_dim(
-        repeat_kv(k_ctx, n_rep), rank * h_loc, h_loc, axis=2
-    )
-    v_ctx_loc = lax.dynamic_slice_in_dim(
-        repeat_kv(v_ctx, n_rep), rank * h_loc, h_loc, axis=2
-    )
+    if h_loc % n_rep == 0:
+        # GQA fast path: the rank's head block spans whole kv-head groups
+        # (repeat_kv repeats consecutively, so repeated head h maps to kv
+        # head h // n_rep) — slice the kv heads first and repeat only the
+        # local block, materializing 1/n_rep of the context per rank
+        kv_loc = h_loc // n_rep
+        k_ctx_loc = repeat_kv(lax.dynamic_slice_in_dim(
+            k_ctx, rank * kv_loc, kv_loc, axis=2), n_rep)
+        v_ctx_loc = repeat_kv(lax.dynamic_slice_in_dim(
+            v_ctx, rank * kv_loc, kv_loc, axis=2), n_rep)
+    else:
+        k_ctx_loc = lax.dynamic_slice_in_dim(
+            repeat_kv(k_ctx, n_rep), rank * h_loc, h_loc, axis=2
+        )
+        v_ctx_loc = lax.dynamic_slice_in_dim(
+            repeat_kv(v_ctx, n_rep), rank * h_loc, h_loc, axis=2
+        )
     k_all = jnp.concatenate([k_ctx_loc, kh], axis=1)
     v_all = jnp.concatenate([v_ctx_loc, vh], axis=1)
     kv_pos = jnp.concatenate([ctx_positions, pos_full], axis=1)

@@ -76,8 +76,8 @@ from .prefix_cache import PrefixCache
 
 logger = logging.getLogger("kafka_tpu.engine")
 
-WAITING, PREFILLING, ACTIVE, DRAINING, FINISHED = (
-    "waiting", "prefilling", "active", "draining", "finished"
+WAITING, PREFILLING, PARKED, ACTIVE, DRAINING, FINISHED = (
+    "waiting", "prefilling", "parked", "active", "draining", "finished"
 )
 
 # Compiled step functions are cached per (model cfg, engine shape) so that
@@ -124,6 +124,19 @@ class EngineConfig:
     # (admission waits at most k-1 steps, ~35ms — see _pick_multi_step).
     # 1 disables.
     multi_step: int = 8
+    # Off-slot admission: when every decode slot is busy, waiting requests
+    # may still prefill and emit their FIRST token ("parked"), then join
+    # the decode batch as slots free.  Under oversubscription this bounds
+    # TTFT by prefill latency instead of queue wait (BASELINE's <200ms p50
+    # north star held at p90 too — round-3's measured phase stacked 640ms
+    # of queueing at 4x load).  Parked sequences pin their KV pages until
+    # seated, so parking is page-gated (park_reserve_pages stay free) and
+    # always reclaimable: under page pressure parked lanes roll back to
+    # the waiting queue BEFORE any active lane is preempted.  0 disables.
+    max_parked: int = 64
+    # Pool pages kept free of parked pinning (headroom for active lanes'
+    # decode growth).  None -> 2 * max_batch.
+    park_reserve_pages: Optional[int] = None
 
     @property
     def max_window(self) -> int:
@@ -172,6 +185,11 @@ class GenRequest:
     # KV prefix reuse: requests sharing a key (thread id) share cached
     # prompt-prefix pages and re-prefill only the suffix (BASELINE config 2)
     prefix_key: Optional[str] = None
+    # Off-slot (parked) admission: the prefill's sampled token as a device
+    # scalar, held until a decode slot frees and seeds _d_last at seating.
+    # None for resumed parked lanes — their pending token is host-known
+    # (output_ids[-1]).
+    pending_tok: Optional[Any] = None
 
     @property
     def cached_len(self) -> int:
@@ -335,6 +353,11 @@ class InferenceEngine:
         B = self.ecfg.max_batch
         self.slots: List[Optional[GenRequest]] = [None] * B
         self.waiting: List[GenRequest] = []
+        # off-slot lanes (state PREFILLING with slot -1, or PARKED), FIFO
+        self.parked: List[GenRequest] = []
+        # scheduler iterations left before off-slot admission may resume
+        # after a page-pressure rollback (see _ensure_pages)
+        self._park_cooldown = 0
         self._requests: Dict[str, GenRequest] = {}
         self._step_count = 0
         self._prefill_fns: Dict[int, Callable] = {}
@@ -698,7 +721,12 @@ class InferenceEngine:
 
     @property
     def has_work(self) -> bool:
-        return self.num_active > 0 or bool(self.waiting) or bool(self._pending)
+        return (
+            self.num_active > 0
+            or bool(self.waiting)
+            or bool(self.parked)
+            or bool(self._pending)
+        )
 
     def step(self) -> List[TokenEvent]:
         """One scheduler iteration: drain fetches, admit, advance one
@@ -710,6 +738,8 @@ class InferenceEngine:
         for its whole prefill — their inter-token gap is bounded by ~one
         chunk's compute.
         """
+        if self._park_cooldown > 0:
+            self._park_cooldown -= 1
         self._drain(block=False)
         self._admit()
         self._advance_prefills()
@@ -827,8 +857,13 @@ class InferenceEngine:
             row = vals[j]
             finals = entry.final[j]
             for i, req in enumerate(entry.items):
-                if req is None or req.state == FINISHED:
-                    continue  # incl. lanes whose stop token hit mid-burst
+                if req is None:
+                    continue
+                if req.state == FINISHED:
+                    # dispatched after the request finished (stop token
+                    # discovered in flight / cancel): speculative waste
+                    self.metrics.record_wasted_token()
+                    continue
                 n += 1
                 self._process_token(
                     req, int(row[i if row.size > 1 else 0]), finals[i]
@@ -917,6 +952,33 @@ class InferenceEngine:
             req.seq.pages, req.seq.length = hit
 
     def _admit(self) -> None:
+        # Off-slot lanes claim freed slots first — UNLESS the waiting head
+        # is older (a preemption victim re-inserted at waiting[0] must not
+        # lose its place to parked lanes submitted after it): strict
+        # submit-order FIFO across both queues.  A PARKED lane seats into
+        # decode directly (its pages and first token already exist); a
+        # still-PREFILLING off-slot lane adopts the slot and finishes its
+        # chunks as an ordinary slot lane.
+        while self.parked:
+            slot = self._free_slot()
+            if slot is None:
+                break
+            oldest = min(self.parked, key=lambda r: r.submit_time)
+            if self.waiting and self.waiting[0].submit_time < oldest.submit_time:
+                break  # the waiting loop below owns this slot
+            req = oldest
+            self.parked.remove(req)
+            req.slot = slot
+            self.slots[slot] = req
+            self._ctl_dirty = True
+            if req.state == PARKED:
+                req.state = ACTIVE
+                pending = (
+                    req.pending_tok if req.pending_tok is not None
+                    else req.output_ids[-1]  # resumed: host-known
+                )
+                self._d_last = self._d_last.at[slot].set(pending)
+                req.pending_tok = None
         while self.waiting:
             slot = self._free_slot()
             if slot is None:
@@ -948,6 +1010,52 @@ class InferenceEngine:
                 req.seq = None
                 self.waiting.insert(0, req)
                 break
+        self._admit_offslot()
+
+    def _admit_offslot(self) -> None:
+        """Start off-slot prefills for waiting requests when slots are full.
+
+        TTFT under oversubscription (EngineConfig.max_parked): the first
+        token comes from the prefill dispatch itself, which needs pages but
+        no decode slot — so a queued request's first token need not wait
+        for a slot to free.  Gated on pool headroom: a reserve stays free
+        for active lanes' decode growth, and parked pages are reclaimed
+        (rolled back to waiting) before any active lane would be preempted
+        (_ensure_pages).
+        """
+        ecfg = self.ecfg
+        if ecfg.max_parked <= 0 or not self.waiting:
+            return
+        if self._park_cooldown > 0:
+            return  # recent page-pressure rollback: let ACTIVE lanes grow
+        if self._free_slot() is not None:
+            return  # slot admission (or its page gate) owns the queue head
+        reserve = (
+            ecfg.park_reserve_pages
+            if ecfg.park_reserve_pages is not None
+            else 2 * ecfg.max_batch
+        )
+        while self.waiting and len(self.parked) < ecfg.max_parked:
+            req = self.waiting[0]
+            self._attach_prefix(req)
+            needed = self._pages_needed(req)
+            if needed > self.pool.free_pages - reserve:
+                # parking must never eat the decode-growth headroom
+                if req.seq is not None:
+                    self.pool.free_sequence(req.seq)
+                    req.seq = None
+                break
+            self.waiting.pop(0)
+            try:
+                self._start_prefill(req, -1)
+            except OutOfPagesError:
+                if req.seq:
+                    self.pool.free_sequence(req.seq)
+                req.state = WAITING
+                req.seq = None
+                self.waiting.insert(0, req)
+                break
+            self.parked.append(req)
 
     def _start_prefill(self, req: GenRequest, slot: int) -> None:
         """Reserve pages + the batch slot; chunks run via _advance_prefill.
@@ -968,8 +1076,9 @@ class InferenceEngine:
                 req.prefill_allowed = self._dev(row)
         req.state = PREFILLING
         req.slot = slot
-        self.slots[slot] = req
-        self._ctl_dirty = True  # decode must mask this lane immediately
+        if slot >= 0:
+            self.slots[slot] = req
+            self._ctl_dirty = True  # decode must mask this lane immediately
 
     def _prefill_bucket_for(self, req: GenRequest) -> int:
         remaining = len(req.prefill_ids) - req.seq.length
@@ -979,19 +1088,29 @@ class InferenceEngine:
         )
 
     def _advance_prefills(self) -> None:
-        """Advance every prefilling lane one chunk this iteration.
+        """Advance the OLDEST <=W prefilling lanes one chunk this iteration.
 
-        Lanes whose next chunk shares a bucket advance TOGETHER through the
-        batched prefill program (one dispatch instead of one per sequence —
-        admission storms of short thread turns are exactly this shape);
-        constrained lanes and sp/pp meshes take the single-sequence path.
+        FIFO window, not round-robin: advancing every lane each iteration
+        makes all N prefills finish together at the END of the aggregate
+        prefill work, so a storm of long prompts gives every request the
+        worst-case TTFT (measured: 24 concurrent 9k-token prompts all got
+        their first token at ~13s).  Advancing only the oldest W staggers
+        completions at identical total cost — request k's first token
+        arrives at ~k/N of the aggregate time, strictly better at every
+        percentile.  W matches the batched-prefill width so a same-bucket
+        window still fuses into ONE dispatch (admission storms of short
+        thread turns are exactly this shape); constrained lanes and sp/pp
+        meshes take the single-sequence path.
         """
         prefilling = [
             s for s in self.slots if s is not None and s.state == PREFILLING
-        ]
+        ] + [r for r in self.parked if r.state == PREFILLING]
         if not prefilling:
             return
         W = min(4, self.ecfg.max_batch)
+        if len(prefilling) > W:
+            prefilling.sort(key=lambda r: r.submit_time)
+            prefilling = prefilling[:W]
         groups: Dict[int, List[GenRequest]] = {}
         singles: List[GenRequest] = []
         for req in prefilling:
@@ -1061,16 +1180,26 @@ class InferenceEngine:
             if req.seq.length < len(req.prefill_ids):
                 continue  # more chunks to go
             req.prefill_allowed = None
-            req.state = ACTIVE
-            self._ctl_dirty = True
-            if req.resumed:
-                # pending token already known host-side (see _finish_prefill)
-                req.resumed = False
-                self._d_last = self._d_last.at[req.slot].set(
-                    req.output_ids[-1]
-                )
-                continue
-            self._d_last = self._d_last.at[req.slot].set(toks[i])
+            if req.slot < 0:
+                # off-slot lane: park until a decode slot frees (_admit);
+                # its first token still ships through the fetch below
+                req.state = PARKED
+                if req.resumed:
+                    req.resumed = False
+                    req.pending_tok = None  # host-known: output_ids[-1]
+                    continue
+                req.pending_tok = toks[i]
+            else:
+                req.state = ACTIVE
+                self._ctl_dirty = True
+                if req.resumed:
+                    # pending token already known host-side
+                    req.resumed = False
+                    self._d_last = self._d_last.at[req.slot].set(
+                        req.output_ids[-1]
+                    )
+                    continue
+                self._d_last = self._d_last.at[req.slot].set(toks[i])
             req.dispatched += 1
             fin = self._limit_reason_after_dispatch(req)
             items[i] = req
@@ -1115,23 +1244,33 @@ class InferenceEngine:
         self._finish_prefill(req, tok)
 
     def _finish_prefill(self, req: GenRequest, tok) -> None:
-        """Last chunk dispatched: the lane joins the decode batch."""
+        """Last chunk dispatched: the lane joins the decode batch (or parks
+        awaiting a slot when it prefilled off-slot)."""
         slot = req.slot
         req.prefill_allowed = None
-        req.state = ACTIVE
-        self._ctl_dirty = True
-        if req.resumed:
-            # Re-entry after preemption: the pending last token is already in
-            # output_ids (outputs are complete — preemption drains the
-            # pipeline); the freshly sampled token is its deterministic
-            # duplicate (same seed, same position) — drop it and seed the
-            # device last-token lane from the host-known value.
-            req.resumed = False
-            self._d_last = self._d_last.at[slot].set(req.output_ids[-1])
-            return
-        # Seed the device last-token lane directly from the device scalar —
-        # the token value itself is fetched asynchronously.
-        self._d_last = self._d_last.at[slot].set(tok)
+        if slot < 0:
+            req.state = PARKED
+            if req.resumed:
+                req.resumed = False
+                req.pending_tok = None  # host-known: output_ids[-1]
+                return
+            req.pending_tok = tok
+        else:
+            req.state = ACTIVE
+            self._ctl_dirty = True
+            if req.resumed:
+                # Re-entry after preemption: the pending last token is
+                # already in output_ids (outputs are complete — preemption
+                # drains the pipeline); the freshly sampled token is its
+                # deterministic duplicate (same seed, same position) — drop
+                # it and seed the device last-token lane from the
+                # host-known value.
+                req.resumed = False
+                self._d_last = self._d_last.at[slot].set(req.output_ids[-1])
+                return
+            # Seed the device last-token lane directly from the device
+            # scalar — the token value itself is fetched asynchronously.
+            self._d_last = self._d_last.at[slot].set(tok)
         req.dispatched += 1
         final = self._limit_reason_after_dispatch(req)
         tok.copy_to_host_async()
@@ -1173,6 +1312,9 @@ class InferenceEngine:
             self.slots[req.slot] = None
             req.slot = -1
             self._ctl_dirty = True
+        elif req in self.parked:
+            self.parked.remove(req)  # finished at prefill (e.g. 1-token cap)
+        req.pending_tok = None
         if req.prefix_key is None or self.prefix_cache is None:
             if req.seq is not None:
                 self.pool.free_sequence(req.seq)
@@ -1237,11 +1379,18 @@ class InferenceEngine:
             # fetch_wait_s bound — gating on the latter would throttle
             # constrained lanes to 1/fetch_wait_s tok/s in busy batches.
             # RTT is also the floor: the next mask cannot be built before
-            # the previous token reaches the host.  With no unconstrained
-            # lanes nobody is stalled by blocking, so fetch immediately.
+            # the previous token reaches the host.  Age alone is not enough
+            # under load: dispatch→landed time includes device compute
+            # backlog, so an aged-but-unfinished fetch would block the
+            # single scheduler thread and stall the unconstrained lanes'
+            # dispatch cadence — require the device compute to be done too
+            # (is_ready; the async copy then lands within ~RTT, which the
+            # age bound already covers).  With no unconstrained lanes
+            # nobody is stalled by blocking, so fetch immediately.
             entry = self._constrained_fetch
             aged = time.monotonic() - entry.t0 >= self._rtt_age_bound()
-            if aged or not n_uncon:
+            ready = getattr(entry.arr, "is_ready", lambda: True)()
+            if (aged and ready) or not n_uncon:
                 self._pop_entry_now(entry)
                 self._constrained_fetch = None
         n_con = 0
@@ -1290,6 +1439,9 @@ class InferenceEngine:
             or any(s.logits_mask_fn is not None for s in active_slots)
             or any(s is not None and s.state == PREFILLING
                    for s in self.slots)
+            # off-slot prefills advance one chunk per iteration; fusing
+            # would slow the very TTFT parking exists to protect
+            or any(r.state == PREFILLING for r in self.parked)
             # a free slot + waiting queue means admission is page-blocked;
             # stay fine-grained so relief (retire/reclaim) happens sooner
             or (self.waiting and self._free_slot() is not None)
@@ -1429,12 +1581,28 @@ class InferenceEngine:
         self._drain(block=True)
         if req.state != ACTIVE or req.seq is None:
             return True
-        try:
-            self.pool.ensure_capacity(req.seq, req.seq.length + 1)
-            self._ctl_dirty = True
-            return False
-        except OutOfPagesError:
-            self._preempt_youngest()
+        # parked lanes' pages are reclaimable before any ACTIVE lane pays:
+        # roll them back to the waiting queue, YOUNGEST BY SUBMIT TIME first
+        # (not list tail: a re-parked preemption victim sits at the tail
+        # with the largest prefill investment — rolling it back by position
+        # would re-run its whole prefill every page-pressure cycle)
+        while True:
+            try:
+                self.pool.ensure_capacity(req.seq, req.seq.length + 1)
+                self._ctl_dirty = True
+                return False
+            except OutOfPagesError:
+                if self.parked:
+                    self._preempt(
+                        max(self.parked, key=lambda r: r.submit_time)
+                    )
+                    # hysteresis: pages just freed must feed ACTIVE growth,
+                    # not an immediate re-park of the same lane (which
+                    # would burn a full prefill per reclaimed page)
+                    self._park_cooldown = 32
+                    continue
+                break
+        self._preempt_youngest()
         if req.state != ACTIVE or req.seq is None:
             return True
         try:
@@ -1517,6 +1685,9 @@ class InferenceEngine:
             self.slots[req.slot] = None
             req.slot = -1
             self._ctl_dirty = True
+        if req in self.parked:
+            self.parked.remove(req)
+        req.pending_tok = None
         if req.seq is not None:
             self.pool.free_sequence(req.seq)
             req.seq = None

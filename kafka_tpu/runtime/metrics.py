@@ -61,6 +61,11 @@ class EngineMetrics:
     generated_tokens: int = 0
     decode_steps: int = 0
     decode_busy_slots: int = 0  # sum over steps -> occupancy = /steps/B
+    # Tokens dispatched for a lane whose request was already finished when
+    # the fetch matured (stop token discovered in flight, or a cancel) —
+    # the cost of the pipelined/fused speculative dispatch.  These occupied
+    # batch slots; wasted/(generated+wasted) is the throughput tax.
+    speculative_wasted_tokens: int = 0
 
     def __post_init__(self) -> None:
         self.ttft_ms: Deque[float] = collections.deque(maxlen=self.window)
@@ -87,6 +92,9 @@ class EngineMetrics:
 
     def record_token(self) -> None:
         self.generated_tokens += 1
+
+    def record_wasted_token(self) -> None:
+        self.speculative_wasted_tokens += 1
 
     def record_decode_step(self, busy_slots: int, steps: int = 1) -> None:
         """steps>1 = a fused multi-step dispatch.  The gap between this
@@ -147,6 +155,13 @@ class EngineMetrics:
                 "generated": self.generated_tokens,
                 "generated_per_s": round(self.generated_tokens / up, 2)
                 if up > 0 else 0.0,
+                "speculative_wasted": self.speculative_wasted_tokens,
+                "speculative_waste_frac": round(
+                    self.speculative_wasted_tokens
+                    / (self.generated_tokens + self.speculative_wasted_tokens),
+                    4,
+                ) if (self.generated_tokens
+                      + self.speculative_wasted_tokens) else 0.0,
             },
             "ttft_ms": {k: round(v, 2) for k, v in
                         _percentiles(_copy_samples(self.ttft_ms)).items()},

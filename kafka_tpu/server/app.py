@@ -26,6 +26,7 @@ agent_done, SURVEY §5.8), so the reference playground works unmodified.
 
 from __future__ import annotations
 
+import hashlib
 import hmac
 import json
 import logging
@@ -308,7 +309,12 @@ def auth_middleware(api_token: Optional[str]):
     async def mw(request: web.Request, handler):
         if api_token and request.path not in open_paths:
             supplied = request.headers.get("Authorization", "")
-            if not hmac.compare_digest(supplied, f"Bearer {api_token}"):
+            # compare as bytes: compare_digest raises TypeError on non-ASCII
+            # str inputs, which would turn a malformed credential into a 500
+            if not hmac.compare_digest(
+                supplied.encode("utf-8", "surrogateescape"),
+                f"Bearer {api_token}".encode(),
+            ):
                 return web.json_response(
                     {"error": {"message": "invalid or missing bearer token",
                                "type": "authentication_error"}},
@@ -421,7 +427,12 @@ async def _agent_events(
         # client must end up holding the durable canonical form.
         nonlocal last_batched
         batch = _cumulative_batch()
-        fingerprint = hash(json.dumps(batch, sort_keys=True, default=str))
+        # constant-size digest (a Python hash() collision after an in-place
+        # rewrite would silently skip the corrected canonical batch; the
+        # raw JSON string would pin the whole batch in memory per stream)
+        fingerprint = hashlib.sha256(
+            json.dumps(batch, sort_keys=True, default=str).encode()
+        ).hexdigest()
         if batch and fingerprint != last_batched:
             last_batched = fingerprint
             return {"type": "tool_messages", "messages": batch}

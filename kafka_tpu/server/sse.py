@@ -53,6 +53,14 @@ async def sse_response(
             await resp.write(frame({"type": "error", "error": str(e)}))
         except ConnectionResetError:
             return resp
+    finally:
+        # Close the pipeline NOW, not at GC: on client disconnect this is
+        # what propagates cancellation down to the engine (agent generator →
+        # provider stream finally → worker.cancel), freeing the batch slot
+        # instead of decoding the rest of the request for a dead socket.
+        aclose = getattr(events, "aclose", None)
+        if aclose is not None:
+            await aclose()
     try:
         await resp.write(DONE_FRAME)
         await resp.write_eof()

