@@ -39,9 +39,9 @@ def log(msg: str) -> None:
 
 
 def param_bytes(params) -> int:
-    import jax
+    from kafka_tpu.models.quant import param_bytes as _pb
 
-    return sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(params))
+    return _pb(params)  # one accounting for dense AND QTensor trees
 
 
 def make_prompt(rng: random.Random, n: int, vocab: int):
@@ -94,6 +94,353 @@ def hbm_traffic_per_step(engine, pbytes: int, batch: int,
     return pbytes + kv_read + kv_write
 
 
+def percentiles_ms(samples, pts=(50, 90, 99)):
+    s = sorted(x * 1e3 for x in samples if x is not None)
+    if not s:
+        return {f"p{p}": None for p in pts}
+    return {
+        f"p{p}": round(s[min(len(s) - 1, max(0, -(-p * len(s) // 100) - 1))], 1)
+        for p in pts
+    }
+
+
+def serving_phase(cfg, params, args, quick: bool):
+    """Measure the SERVED path end to end: real aiohttp app, real SSE
+    clients, agent loop + constrained tool calls (VERDICT r3 next #1;
+    BASELINE configs 3-4 name this surface, not the raw engine).
+
+    Boots create_app around a fresh engine sharing `params`, drives N
+    concurrent SSE clients through POST /v1/threads/{id}/chat/completions
+    (two turns per thread: turn 2 replays history through the thread store
+    and hits the thread-keyed prefix cache), then M concurrent agent runs
+    through POST /v1/agent/run with a scripted tool and a FORCED tool call
+    (constrained JSON decode in the sampler).  All latencies are measured
+    at the HTTP client — they include tokenization, the worker handoff,
+    the agent loop, SSE encoding, and aiohttp, unlike the engine-only
+    phases above (reference serve path: server.py:384-411).
+    """
+    import asyncio
+    import tempfile
+
+    async def run():
+        import aiohttp
+        from aiohttp import web
+
+        from kafka_tpu.llm.tpu_provider import TPULLMProvider
+        from kafka_tpu.models.tokenizer import ByteTokenizer
+        from kafka_tpu.runtime import EngineConfig, InferenceEngine
+        from kafka_tpu.runtime.metrics import EngineMetrics
+        from kafka_tpu.server import ServingConfig, create_app
+        from kafka_tpu.tools import Tool
+
+        n_threads = 4 if quick else 32
+        n_agents = 2 if quick else 8
+        gen_len = 8 if quick else 32
+        # window 1536: system prompt + tool defs run ~700 byte-tokens, and
+        # turn 2 replays the whole turn-1 conversation on top
+        ecfg = EngineConfig(
+            max_batch=args.batch,
+            page_size=16,
+            max_pages_per_seq=96,
+            prefill_buckets=(64, 256, 512),
+        )
+        ecfg.num_pages = 3 * args.batch * ecfg.max_pages_per_seq + 1
+        engine = InferenceEngine(cfg, params, ecfg)
+        tokenizer = ByteTokenizer(vocab_size=cfg.vocab_size)
+        provider = TPULLMProvider(engine, tokenizer, model_name=cfg.name)
+
+        def lookup(city: str):
+            return {"city": city, "population": 1234567, "weather": "sunny"}
+
+        tmp = tempfile.mkdtemp(prefix="kafka_bench_")
+        scfg = ServingConfig(
+            model_name=cfg.name,
+            db_path=f"{tmp}/threads.db",
+            system_prompt="You are a concise assistant. Answer briefly.",
+            warmup=False,  # warmed explicitly below, then metrics reset
+        )
+        app = await create_app(
+            cfg=scfg,
+            llm_provider=provider,
+            tools=[Tool(
+                name="lookup",
+                description="Look up basic facts about a city.",
+                parameters={
+                    "type": "object",
+                    "properties": {"city": {"type": "string"}},
+                    "required": ["city"],
+                },
+                handler=lookup,
+            )],
+            mcp_servers=[],
+        )
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        base = f"http://127.0.0.1:{port}"
+        out = {}
+        try:
+            async with aiohttp.ClientSession() as sess:
+                async def turn(tid, content, gen):
+                    """One streamed thread turn; returns (ttft, total)."""
+                    t0 = time.monotonic()
+                    ttft = None
+                    url = f"{base}/v1/threads/{tid}/chat/completions"
+                    async with sess.post(url, json={
+                        "model": cfg.name, "stream": True,
+                        "max_tokens": gen, "temperature": 0.0,
+                        "messages": [{"role": "user", "content": content}],
+                    }) as r:
+                        assert r.status == 200, await r.text()
+                        async for line in r.content:
+                            if line.startswith(b'data: {"type":"error"'):
+                                raise RuntimeError(
+                                    f"served-path error: {line!r}")
+                            if ttft is None and b'"content"' in line:
+                                ttft = time.monotonic() - t0
+                    return ttft, time.monotonic() - t0
+
+                # warm: compile every serving program outside the measured
+                # window.  TWO rounds per warm thread so both measured
+                # shapes compile: round 1 = cold full prefill (large
+                # buckets + batched prefill + fused decode), round 2 =
+                # thread-history replay with a prefix-cache hit (small
+                # suffix buckets) — r04's first TPU run had the suffix
+                # bucket compiling inside measured turn 2 (42s p90).
+                t0 = time.monotonic()
+                for r in range(2):
+                    await asyncio.gather(*(
+                        turn(f"warm-{i}",
+                             f"warm round {r} for client {i} padding",
+                             gen_len)
+                        for i in range(min(4, n_threads))
+                    ))
+                log(f"serving warmup/compile: {time.monotonic() - t0:.1f}s")
+                engine.metrics = EngineMetrics()
+
+                # ---- server_path: 2 turns x n_threads concurrent SSE ----
+                t0 = time.monotonic()
+                r1 = await asyncio.gather(*(
+                    turn(f"bench-t{i}",
+                         f"hello from client {i}, tell me something",
+                         gen_len)
+                    for i in range(n_threads)
+                ))
+                wall1 = time.monotonic() - t0
+                t0 = time.monotonic()
+                r2 = await asyncio.gather(*(
+                    turn(f"bench-t{i}", f"and a follow-up question {i}",
+                         gen_len)
+                    for i in range(n_threads)
+                ))
+                wall2 = time.monotonic() - t0
+                snap = engine.metrics.snapshot(engine)
+                out["server_path"] = {
+                    "n_threads": n_threads,
+                    "turns_per_thread": 2,
+                    "gen_len": gen_len,
+                    "req_per_s": round(2 * n_threads / (wall1 + wall2), 2),
+                    "ttft_ms": percentiles_ms(
+                        [t for t, _ in r1] + [t for t, _ in r2]),
+                    "turn1_ttft_ms": percentiles_ms([t for t, _ in r1]),
+                    "turn2_ttft_ms": percentiles_ms([t for t, _ in r2]),
+                    "e2e_latency_ms": percentiles_ms(
+                        [w for _, w in r1] + [w for _, w in r2]),
+                    "engine_ttft_ms": snap["ttft_ms"],
+                    "prefix_cache": snap.get("prefix_cache"),
+                    "speculative_waste_frac":
+                        snap["tokens"]["speculative_waste_frac"],
+                    "note": ("client-observed over HTTP/SSE incl. "
+                             "tokenization, agent loop, worker handoff, "
+                             "aiohttp; turn 2 replays thread history "
+                             "(prefix-cache hit)"),
+                }
+                log(f"server_path: {out['server_path']['req_per_s']} req/s, "
+                    f"ttft p50 {out['server_path']['ttft_ms']['p50']} ms "
+                    f"p90 {out['server_path']['ttft_ms']['p90']} ms")
+
+                # ---- agent_path: forced tool call w/ constrained decode --
+                async def agent_run(i):
+                    t0 = time.monotonic()
+                    first_tool = total = None
+                    done_reason = None
+                    async with sess.post(f"{base}/v1/agent/run", json={
+                        "model": cfg.name, "max_tokens": 48,
+                        "temperature": 0.0,
+                        "messages": [{
+                            "role": "user",
+                            "content": f"look up city number {i}",
+                        }],
+                        "tool_choice": {"type": "function",
+                                        "function": {"name": "lookup"}},
+                    }) as r:
+                        assert r.status == 200, await r.text()
+                        async for line in r.content:
+                            if line.startswith(b'data: {"type":"error"'):
+                                raise RuntimeError(
+                                    f"agent-path error: {line!r}")
+                            if (first_tool is None
+                                    and b'"tool_result"' in line):
+                                first_tool = time.monotonic() - t0
+                            if b'"agent_done"' in line:
+                                m = json.loads(
+                                    line.decode()[len("data: "):])
+                                done_reason = m.get("reason")
+                    total = time.monotonic() - t0
+                    return first_tool, total, done_reason
+
+                await agent_run(999)  # constrained-path warmup/compile
+                t0 = time.monotonic()
+                runs = await asyncio.gather(*(
+                    agent_run(i) for i in range(n_agents)))
+                wall = time.monotonic() - t0
+                out["agent_path"] = {
+                    "n_agents": n_agents,
+                    "req_per_s": round(n_agents / wall, 2),
+                    "time_to_tool_result_ms": percentiles_ms(
+                        [ft for ft, _, _ in runs]),
+                    "e2e_latency_ms": percentiles_ms(
+                        [t for _, t, _ in runs]),
+                    "tool_result_seen": sum(
+                        1 for ft, _, _ in runs if ft is not None),
+                    "done_reasons": sorted(
+                        {str(dr) for _, _, dr in runs}),
+                    "note": ("POST /v1/agent/run with tool_choice forcing "
+                             "a scripted tool: constrained JSON decode in "
+                             "the sampler -> tool execution -> free final "
+                             "turn (BASELINE config 4 shape). Constrained "
+                             "lanes advance at device->host RTT cadence "
+                             "(each mask needs the previous token back); "
+                             "on this TUNNELED chip RTT is ~100ms/token "
+                             "and dominates e2e — on-prem ICI-attached "
+                             "serving pays ~1ms"),
+                }
+                log(f"agent_path: {out['agent_path']['req_per_s']} req/s, "
+                    f"tool result p50 "
+                    f"{out['agent_path']['time_to_tool_result_ms']['p50']}"
+                    f" ms")
+        finally:
+            await runner.cleanup()
+            await provider.aclose()
+        return out
+
+    return asyncio.run(run())
+
+
+def scale_phase(args, base_cfg, base_params) -> dict:
+    """Bigger-model headline numbers (VERDICT r3 next #4).
+
+    * llama-3.2-1b int8: decode throughput AND greedy token match rate vs
+      the bf16 engine (same weights — the shipped quality sanity check).
+    * llama-3.2-3b bf16 and llama-3-8b int8: single-chip decode
+      throughput.  8B bf16 is 16 GB and does NOT fit a v5e chip — int8
+      weight-only (models/quant.py) is what makes the literal BASELINE
+      metric ("tokens/sec/chip, Llama-3-8B") servable at all.  Throughput
+      is weight-value independent, so the big models use constant-fill
+      params (random-init of 8B on a tunneled chip costs ~8 minutes of
+      pure RNG; quality is covered by the 1B match rate above).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from kafka_tpu.models import get_config, quantize_params
+    from kafka_tpu.runtime import EngineConfig, GenRequest, InferenceEngine
+
+    rng = random.Random(7)
+    out = {}
+
+    def mk_engine(cfg, params, batch=8, gen=128):
+        ecfg = EngineConfig(
+            max_batch=batch, page_size=16,
+            max_pages_per_seq=max(2, -(-(args.prompt_len + gen + 16) // 16)),
+        )
+        ecfg.num_pages = batch * ecfg.max_pages_per_seq + 1
+        return InferenceEngine(cfg, params, ecfg)
+
+    def fill_params(cfg):
+        """Constant-fill weights (throughput-only models): init_params'
+        EXACT pytree via eval_shape (zero RNG/compute — random-init of 8B
+        through the tunnel costs minutes), constant values."""
+        from kafka_tpu.models import init_params
+
+        shapes = jax.eval_shape(init_params, cfg, jax.random.PRNGKey(0))
+        return jax.tree.map(
+            lambda sd: jnp.full(sd.shape, 0.01, sd.dtype), shapes
+        )
+
+    def decode_tps(cfg, params, label, gen=128):
+        eng = mk_engine(cfg, params, batch=8, gen=gen)
+        t0 = time.monotonic()
+        eng.generate(make_prompt(rng, args.prompt_len, cfg.vocab_size),
+                     max_new_tokens=2)
+        for i in range(4):
+            eng.submit(GenRequest(
+                request_id=f"w{label}{i}",
+                prompt_ids=make_prompt(rng, args.prompt_len, cfg.vocab_size),
+                max_new_tokens=eng.ecfg.multi_step + 4))
+        eng.run_to_completion()
+        log(f"{label} compile: {time.monotonic() - t0:.1f}s")
+        tps, sps = decode_phase(eng, cfg, 8, args.prompt_len, gen, rng)
+        pb = param_bytes(params)
+        ctx = args.prompt_len + gen // 2
+        gbs = hbm_traffic_per_step(eng, pb, 8, ctx) * sps / 1e9
+        del eng
+        return tps, sps, pb, gbs
+
+    # ---- 1B int8: throughput + greedy match-rate quality check ----------
+    q1 = quantize_params(base_params, base_cfg)
+    bf_eng = mk_engine(base_cfg, base_params, batch=2, gen=40)
+    q_eng = mk_engine(base_cfg, q1, batch=2, gen=40)
+    match = total = 0
+    for i in range(3):
+        p = make_prompt(rng, args.prompt_len, base_cfg.vocab_size)
+        a = bf_eng.generate(p, max_new_tokens=32).output_ids
+        b = q_eng.generate(p, max_new_tokens=32).output_ids
+        total += len(a)
+        match += sum(1 for x, y in zip(a, b) if x == y)
+    del bf_eng, q_eng
+    tps, sps, pb, gbs = decode_tps(base_cfg, q1, "1b-int8")
+    del q1
+    out["llama-3.2-1b-int8"] = {
+        "decode_tok_s_b8": round(tps, 1),
+        "weight_gb": round(pb / 1e9, 2),
+        "hbm_gb_s_est": round(gbs, 1),
+        "greedy_match_rate_vs_bf16": round(match / total, 3),
+        "match_note": ("random weights are the adversarial case for "
+                       "argmax stability (near-tied logits); real "
+                       "checkpoints match higher"),
+    }
+    log(f"1b int8: {tps:.1f} tok/s, match {match}/{total}")
+
+    # ---- 3B bf16 / 8B int8 ----------------------------------------------
+    cfg3 = get_config("llama-3.2-3b")
+    p3 = fill_params(cfg3)
+    tps, sps, pb, gbs = decode_tps(cfg3, p3, "3b-bf16")
+    del p3
+    out["llama-3.2-3b-bf16"] = {
+        "decode_tok_s_b8": round(tps, 1),
+        "weight_gb": round(pb / 1e9, 2),
+        "hbm_gb_s_est": round(gbs, 1),
+    }
+    log(f"3b bf16: {tps:.1f} tok/s")
+
+    cfg8 = get_config("llama-3-8b")
+    p8 = quantize_params(fill_params(cfg8), cfg8)
+    tps, sps, pb, gbs = decode_tps(cfg8, p8, "8b-int8")
+    del p8
+    out["llama-3-8b-int8"] = {
+        "decode_tok_s_b8": round(tps, 1),
+        "weight_gb": round(pb / 1e9, 2),
+        "hbm_gb_s_est": round(gbs, 1),
+        "note": ("THE BASELINE metric model: 8B bf16 (16 GB) does not fit "
+                 "one v5e chip; int8 weight-only serves it single-chip"),
+    }
+    log(f"8b int8: {tps:.1f} tok/s")
+    return out
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="llama-3.2-1b")
@@ -106,16 +453,33 @@ def main() -> None:
                     help="prompt length for the equal-length cache proof")
     ap.add_argument("--batch-sweep", type=str, default="16,32",
                     help="extra decode batch points (comma list; '' = none)")
+    ap.add_argument("--no-serve", action="store_true",
+                    help="skip the HTTP/SSE served-path phase")
+    ap.add_argument("--no-scale", action="store_true",
+                    help="skip the 1B-int8/3B/8B model-scale phase")
     args = ap.parse_args()
 
     import jax
+
+    # persistent XLA compile cache (same knob the server sets,
+    # server/app.py): repeat bench runs on one machine skip the ~30-70s
+    # per-program compiles that otherwise dominate wall time
+    import os as _os
+
+    _cache = _os.path.expanduser("~/.cache/kafka_tpu/xla")
+    _os.makedirs(_cache, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", _cache)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
 
     from kafka_tpu.models import get_config, init_params
     from kafka_tpu.runtime import EngineConfig, GenRequest, InferenceEngine
     from kafka_tpu.runtime.metrics import EngineMetrics
 
     if args.quick:
-        cfg = get_config("tiny-gqa")
+        # vocab must cover the ByteTokenizer's byte+special range (262) so
+        # the serving phase's constrained tool-call masks stay in-vocab
+        cfg = get_config("tiny-gqa").replace(vocab_size=262)
         args.prompt_len, args.gen_len = 32, 32
         args.cache_prompt_len = 64
         args.batch_sweep = ""
@@ -302,9 +666,21 @@ def main() -> None:
     ct_wall = time.monotonic() - t0
     concurrent_req_s = done_ct / ct_wall
 
+    # ---- served path: HTTP/SSE through the real app (VERDICT r3 #1) -----
+    if args.no_serve:
+        served = {}
+    else:
+        served = serving_phase(cfg, params, args, args.quick)
+
     # the same counters GET /metrics exports (runtime/metrics.py) — bench
     # and the server report one source of truth
     snap = engine.metrics.snapshot(engine)
+
+    # ---- bigger models: 1B int8 quality/thpt, 3B bf16, 8B int8 ----------
+    scale = {}
+    if not args.quick and not args.no_scale:
+        del engine  # free the main pool before the big models come up
+        scale = scale_phase(args, cfg, params)
 
     # Headline = BASELINE.json's first metric (tokens/sec/chip). The
     # reference publishes no numbers, so vs_baseline is the improvement over
@@ -348,6 +724,9 @@ def main() -> None:
                 "prefix_cache": snap.get("prefix_cache"),
                 "rtt_est_ms": snap["engine"]["rtt_est_ms"],
             },
+            "server_path": served.get("server_path"),
+            "agent_path": served.get("agent_path"),
+            "model_scale": scale or None,
             "concurrent_thread_req_per_s": round(concurrent_req_s, 2),
             "concurrent_threads": n_threads,
             "concurrent_note": (

@@ -3,6 +3,7 @@
 from .config import CONFIGS, ModelConfig, config_from_hf_json, get_config
 from .llama import KVCache, forward, init_kv_cache, init_params
 from .loader import convert_hf_state_dict, load_checkpoint, resolve_checkpoint_dir
+from .quant import QTensor, dequantize, quantize_params
 from .tokenizer import (
     BaseTokenizer,
     ByteTokenizer,
@@ -23,6 +24,9 @@ __all__ = [
     "convert_hf_state_dict",
     "load_checkpoint",
     "resolve_checkpoint_dir",
+    "QTensor",
+    "dequantize",
+    "quantize_params",
     "BaseTokenizer",
     "ByteTokenizer",
     "HFTokenizer",

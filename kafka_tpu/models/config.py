@@ -51,6 +51,16 @@ class ModelConfig:
     # shards over ICI neighbors; "ulysses" all_to_alls to head-sharded
     # layout (parallel/ring_attention.py — needs heads/tp % sp == 0)
     cp_strategy: str = "ring"
+    # Mixture-of-experts MLP (Mixtral-style): 0 = dense.  When >0 each
+    # layer's MLP is num_experts stacked SwiGLU experts with top-k routing
+    # (softmax over the top-k router logits); expert weights shard over
+    # the "ep" mesh axis (parallel/sharding.py).
+    num_experts: int = 0
+    num_experts_per_tok: int = 2
+
+    @property
+    def is_moe(self) -> bool:
+        return self.num_experts > 0
 
     @property
     def q_per_kv(self) -> int:
@@ -130,6 +140,28 @@ CONFIGS = {
         tie_word_embeddings=False,
         rope_scaling_factor=8.0,
     ),
+    "tiny-moe": ModelConfig(
+        name="tiny-moe", num_heads=8, num_kv_heads=2, hidden_size=128,
+        head_dim=16, num_experts=4, num_experts_per_tok=2,
+    ),
+    # Mixtral 8x7B architecture (HF mistralai/Mixtral-8x7B-v0.1
+    # config.json): the servable MoE flagship shape.  Experts shard over
+    # "ep"; attention + per-expert FFN still shard over "tp".
+    "mixtral-8x7b": ModelConfig(
+        name="mixtral-8x7b",
+        vocab_size=32000,
+        hidden_size=4096,
+        intermediate_size=14336,
+        num_layers=32,
+        num_heads=32,
+        num_kv_heads=8,
+        head_dim=128,
+        rope_theta=1e6,
+        max_context=32768,
+        tie_word_embeddings=False,
+        num_experts=8,
+        num_experts_per_tok=2,
+    ),
     "llama-3-70b": ModelConfig(
         name="llama-3-70b",
         vocab_size=128256,
@@ -176,6 +208,9 @@ def config_from_hf_json(path: str) -> ModelConfig:
     )
     return ModelConfig(
         dtype=dtype,
+        # MoE (HF Mixtral config keys); absent -> 0 = dense
+        num_experts=hf.get("num_local_experts", 0) or 0,
+        num_experts_per_tok=hf.get("num_experts_per_tok", 2),
         name=os.path.basename(os.path.dirname(os.path.abspath(path))),
         vocab_size=hf["vocab_size"],
         hidden_size=hf["hidden_size"],

@@ -35,8 +35,16 @@ from ..models.config import ModelConfig
 from ..ops.attention import causal_attention
 from ..ops.norms import rms_norm
 from ..ops.rope import apply_rope, rope_cos_sin, rope_frequencies
+from .quant import QTensor, dequantize
 
 Params = Dict[str, Any]
+
+
+def _w(lp: Params, name: str, dtype) -> jnp.ndarray:
+    """Fetch a weight, dequantizing int8 QTensors in-graph (models/quant.py:
+    XLA fuses the convert into the matmul's operand read, keeping HBM
+    traffic int8-sized)."""
+    return dequantize(lp[name], dtype)
 
 
 class KVCache(NamedTuple):
@@ -93,25 +101,35 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=None) -> Params:
     dtype = dtype or cfg.activation_dtype
     h, f, d = cfg.hidden_size, cfg.intermediate_size, cfg.head_dim
     hq, hkv, L = cfg.num_heads, cfg.num_kv_heads, cfg.num_layers
-    keys = jax.random.split(key, 9)
+    keys = jax.random.split(key, 10)
 
     def norm01(k, shape, fan_in):
         return (jax.random.normal(k, shape, jnp.float32) * (fan_in**-0.5)).astype(dtype)
 
+    layers: Params = {
+        "ln_attn": jnp.ones((L, h), dtype),
+        "ln_mlp": jnp.ones((L, h), dtype),
+        "wq": norm01(keys[1], (L, h, hq, d), h),
+        "wk": norm01(keys[2], (L, h, hkv, d), h),
+        "wv": norm01(keys[3], (L, h, hkv, d), h),
+        "wo": norm01(keys[4], (L, hq, d, h), hq * d),
+    }
+    if cfg.is_moe:
+        # Mixtral-style MoE MLP: router [L, H, E] + E stacked SwiGLU
+        # experts per layer (expert axis shards over "ep")
+        E = cfg.num_experts
+        layers["router"] = norm01(keys[9], (L, h, E), h)
+        layers["wg"] = norm01(keys[5], (L, E, h, f), h)
+        layers["wu"] = norm01(keys[6], (L, E, h, f), h)
+        layers["wd"] = norm01(keys[7], (L, E, f, h), f)
+    else:
+        layers["wg"] = norm01(keys[5], (L, h, f), h)
+        layers["wu"] = norm01(keys[6], (L, h, f), h)
+        layers["wd"] = norm01(keys[7], (L, f, h), f)
     params: Params = {
         "embed": norm01(keys[0], (cfg.vocab_size, h), h),
         "final_norm": jnp.ones((h,), dtype),
-        "layers": {
-            "ln_attn": jnp.ones((L, h), dtype),
-            "ln_mlp": jnp.ones((L, h), dtype),
-            "wq": norm01(keys[1], (L, h, hq, d), h),
-            "wk": norm01(keys[2], (L, h, hkv, d), h),
-            "wv": norm01(keys[3], (L, h, hkv, d), h),
-            "wo": norm01(keys[4], (L, hq, d, h), hq * d),
-            "wg": norm01(keys[5], (L, h, f), h),
-            "wu": norm01(keys[6], (L, h, f), h),
-            "wd": norm01(keys[7], (L, f, h), f),
-        },
+        "layers": layers,
     }
     if not cfg.tie_word_embeddings:
         params["lm_head"] = norm01(keys[8], (h, cfg.vocab_size), h)
@@ -133,9 +151,10 @@ def _attention_block(
     mesh=None,
 ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray], Optional[jnp.ndarray]]:
     """One attention sublayer. x: [B, S, H]. Returns (out, k_cache', v_cache')."""
-    q = jnp.einsum("bsh,hnd->bsnd", x, lp["wq"])
-    k = jnp.einsum("bsh,hnd->bsnd", x, lp["wk"])
-    v = jnp.einsum("bsh,hnd->bsnd", x, lp["wv"])
+    dt = x.dtype
+    q = jnp.einsum("bsh,hnd->bsnd", x, _w(lp, "wq", dt))
+    k = jnp.einsum("bsh,hnd->bsnd", x, _w(lp, "wk", dt))
+    v = jnp.einsum("bsh,hnd->bsnd", x, _w(lp, "wv", dt))
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
 
@@ -240,15 +259,48 @@ def _attention_block(
             kv_positions=kv_pos,
             kv_valid=kv_valid,
         )
-    out = jnp.einsum("bsnd,ndh->bsh", out, lp["wo"])
+    out = jnp.einsum("bsnd,ndh->bsh", out, _w(lp, "wo", out.dtype))
     return out, k_cache, v_cache
 
 
 def _mlp_block(x: jnp.ndarray, lp: Params) -> jnp.ndarray:
     """SwiGLU MLP: down( silu(gate(x)) * up(x) )."""
-    g = jnp.einsum("bsh,hf->bsf", x, lp["wg"])
-    u = jnp.einsum("bsh,hf->bsf", x, lp["wu"])
-    return jnp.einsum("bsf,fh->bsh", jax.nn.silu(g) * u, lp["wd"])
+    g = jnp.einsum("bsh,hf->bsf", x, _w(lp, "wg", x.dtype))
+    u = jnp.einsum("bsh,hf->bsf", x, _w(lp, "wu", x.dtype))
+    return jnp.einsum("bsf,fh->bsh", jax.nn.silu(g) * u, _w(lp, "wd", x.dtype))
+
+
+def _moe_block(x: jnp.ndarray, lp: Params, cfg: ModelConfig) -> jnp.ndarray:
+    """Mixtral-style top-k routed MoE MLP. x: [B, S, H].
+
+    Dense dispatch (parallel/expert.py's capacity-unlimited formulation,
+    validated there against a per-token loop): every expert computes every
+    token, the [T, E] routing weights zero the non-selected contributions,
+    and the combine einsum contracts the expert axis.  With wg/wu/wd
+    sharded P(layer, "ep", ..., "tp") GSPMD partitions the expert einsums
+    over ep and inserts the combine psum automatically — the same program
+    serves single-device, ep, and ep x tp meshes.  Routing: softmax over
+    the top-k router logits only (HF MixtralSparseMoeBlock semantics),
+    computed in f32.
+    """
+    b, s, h = x.shape
+    t = x.reshape(b * s, h)
+    logits = jnp.einsum(
+        "th,he->te", t, lp["router"], preferred_element_type=jnp.float32
+    )
+    # exactly k experts per token (HF MixtralSparseMoeBlock semantics):
+    # softmax over the selected logits, scattered back — a >=threshold
+    # mask would activate extra experts on k-th-place ties
+    top_vals, top_idx = jax.lax.top_k(logits, cfg.num_experts_per_tok)
+    w_top = jax.nn.softmax(top_vals, axis=-1)
+    w = jnp.zeros_like(logits).at[
+        jnp.arange(t.shape[0])[:, None], top_idx
+    ].set(w_top)  # [T, E] f32
+    g = jnp.einsum("th,ehf->tef", t, _w(lp, "wg", t.dtype))
+    u = jnp.einsum("th,ehf->tef", t, _w(lp, "wu", t.dtype))
+    y = jnp.einsum("tef,efh->teh", jax.nn.silu(g) * u, _w(lp, "wd", t.dtype))
+    out = jnp.einsum("te,teh->th", w.astype(y.dtype), y)
+    return out.reshape(b, s, h)
 
 
 def forward(
@@ -273,7 +325,15 @@ def forward(
         PagedView index plan.
     Returns (logits [B, S, vocab] float32, updated cache or None).
     """
-    x = params["embed"][token_ids].astype(cfg.activation_dtype)
+    embed = params["embed"]
+    if isinstance(embed, QTensor):
+        # per-row dequant of only the looked-up rows (scale is [V, 1])
+        x = (
+            embed.q[token_ids].astype(cfg.activation_dtype)
+            * embed.s[token_ids].astype(cfg.activation_dtype)
+        )
+    else:
+        x = embed[token_ids].astype(cfg.activation_dtype)
     inv_freq = rope_frequencies(cfg)
     cos, sin = rope_cos_sin(positions, inv_freq)
 
@@ -286,7 +346,8 @@ def forward(
         )
         h = h + attn_out
         mlp_in = rms_norm(h, lp["ln_mlp"], cfg.rms_norm_eps)
-        h = h + _mlp_block(mlp_in, lp)
+        h = h + (_moe_block(mlp_in, lp, cfg) if cfg.is_moe
+                 else _mlp_block(mlp_in, lp))
         return h, (kc, vc)
 
     if kv_cache is None:
@@ -309,12 +370,29 @@ def forward(
     # [V, H] table to f32 would stream an extra ~1 GB per step through HBM
     # on a 128k vocab for no accuracy the f32 accumulator doesn't already
     # provide.
+    # Int8 heads: the matmul streams the int8 table upcast to bf16 and the
+    # per-vocab-row scale applies to the f32 OUTPUT — exact (scales are
+    # per output channel) and cheaper than dequantizing the [V, H] table.
     if cfg.tie_word_embeddings:
-        logits = jnp.einsum(
-            "bsh,vh->bsv", x, params["embed"], preferred_element_type=jnp.float32
-        )
+        head = params["embed"]  # [V, H]
+        if isinstance(head, QTensor):
+            logits = jnp.einsum(
+                "bsh,vh->bsv", x, head.q.astype(x.dtype),
+                preferred_element_type=jnp.float32,
+            ) * head.s.reshape(1, 1, -1)
+        else:
+            logits = jnp.einsum(
+                "bsh,vh->bsv", x, head, preferred_element_type=jnp.float32
+            )
     else:
-        logits = jnp.einsum(
-            "bsh,hv->bsv", x, params["lm_head"], preferred_element_type=jnp.float32
-        )
+        head = params["lm_head"]  # [H, V]
+        if isinstance(head, QTensor):
+            logits = jnp.einsum(
+                "bsh,hv->bsv", x, head.q.astype(x.dtype),
+                preferred_element_type=jnp.float32,
+            ) * head.s.reshape(1, 1, -1)
+        else:
+            logits = jnp.einsum(
+                "bsh,hv->bsv", x, head, preferred_element_type=jnp.float32
+            )
     return logits, new_cache

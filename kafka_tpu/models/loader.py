@@ -75,10 +75,32 @@ def convert_hf_state_dict(
         "wo": stack(
             "layers.{i}.self_attn.o_proj.weight", lambda w: w.T.reshape(hq, d, h)
         ),
-        "wg": stack("layers.{i}.mlp.gate_proj.weight", lambda w: w.T),
-        "wu": stack("layers.{i}.mlp.up_proj.weight", lambda w: w.T),
-        "wd": stack("layers.{i}.mlp.down_proj.weight", lambda w: w.T),
     }
+    if cfg.is_moe:
+        # HF Mixtral layout: block_sparse_moe.gate [E, H] router;
+        # experts.{e}.w1/w3/w2 = gate/up/down [F, H] / [F, H] / [H, F].
+        # Stacked here to [L, E, H, F] (w1/w3 transposed) and [L, E, F, H].
+        E = cfg.num_experts
+
+        def stack_experts(wname: str) -> jnp.ndarray:
+            return jnp.asarray(np.stack([
+                np.stack([
+                    get(f"layers.{i}.block_sparse_moe.experts.{e}."
+                        f"{wname}.weight").T
+                    for e in range(E)
+                ]) for i in range(L)
+            ]), dtype)
+
+        layers["router"] = stack(
+            "layers.{i}.block_sparse_moe.gate.weight", lambda w: w.T
+        )
+        layers["wg"] = stack_experts("w1")
+        layers["wu"] = stack_experts("w3")
+        layers["wd"] = stack_experts("w2")
+    else:
+        layers["wg"] = stack("layers.{i}.mlp.gate_proj.weight", lambda w: w.T)
+        layers["wu"] = stack("layers.{i}.mlp.up_proj.weight", lambda w: w.T)
+        layers["wd"] = stack("layers.{i}.mlp.down_proj.weight", lambda w: w.T)
     params: Params = {
         "embed": jnp.asarray(get("embed_tokens.weight"), dtype),
         "final_norm": jnp.asarray(get("norm.weight"), dtype),

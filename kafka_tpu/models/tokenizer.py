@@ -146,7 +146,17 @@ def parse_tool_call_text(text: str) -> Optional[List[Dict[str, Any]]]:
 
 
 class ByteTokenizer(BaseTokenizer):
-    """Byte-level tokenizer: ids 0-255 are raw bytes; specials above."""
+    """Byte-level tokenizer: ids 0-255 are raw bytes; specials above.
+
+    `vocab_size` may pad the vocabulary past the byte+special range so the
+    tokenizer can front a model with a larger embedding table (benchmarks
+    serving the flagship architecture with random weights in this
+    no-egress environment): padded "filler" ids are never produced by
+    encode, and decode maps each to one deterministic letter/digit so
+    every sampled token is user-visible text (TTFT measured at an HTTP
+    client is then a real token signal, and none of them opens the
+    provider's tool-call JSON buffering).
+    """
 
     SPECIALS = [
         "<|begin_of_text|>",
@@ -157,7 +167,10 @@ class ByteTokenizer(BaseTokenizer):
         "<|pad|>",
     ]
 
-    def __init__(self) -> None:
+    _FILLER = ("abcdefghijklmnopqrstuvwxyz"
+               "ABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789")
+
+    def __init__(self, vocab_size: Optional[int] = None) -> None:
         self._special_to_id = {s: 256 + i for i, s in enumerate(self.SPECIALS)}
         self._id_to_special = {v: k for k, v in self._special_to_id.items()}
         self.bos_id = self._special_to_id["<|begin_of_text|>"]
@@ -165,7 +178,8 @@ class ByteTokenizer(BaseTokenizer):
         self.eot_id = self._special_to_id["<|eot_id|>"]
         self.pad_id = self._special_to_id["<|pad|>"]
         self.stop_ids = (self.eos_id, self.eot_id)
-        self.vocab_size = 256 + len(self.SPECIALS)
+        base = 256 + len(self.SPECIALS)
+        self.vocab_size = max(base, vocab_size or 0)
 
     def encode(self, text: str) -> List[int]:
         ids: List[int] = []
@@ -195,6 +209,9 @@ class ByteTokenizer(BaseTokenizer):
                 if buf:
                     out.append(buf.decode("utf-8", errors="replace"))
                     buf = bytearray()
+                if t not in self._id_to_special:
+                    # vocab-padded filler id -> one deterministic printable
+                    out.append(self._FILLER[t % len(self._FILLER)])
                 # specials render as empty on decode (not user-visible)
         if buf:
             out.append(buf.decode("utf-8", errors="replace"))

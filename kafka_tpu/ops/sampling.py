@@ -142,7 +142,15 @@ def sample_tokens(
     back to unconstrained (never emit garbage from an over-tight mask).
     """
     greedy_choice, scaled = _filtered_logits(logits, params, allowed_mask)
-    sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+    # categorical generates a [B, V] gumbel field (threefry) — measurable
+    # per-step HBM/VPU work at a 128k vocab; skip it when every row is
+    # greedy (the agent default), same pattern as the filter sorts above
+    sampled = jax.lax.cond(
+        jnp.any(params.temperature > 0.0),
+        lambda s: jax.random.categorical(key, s, axis=-1).astype(jnp.int32),
+        lambda s: greedy_choice,
+        scaled,
+    )
     return jnp.where(params.temperature <= 0.0, greedy_choice, sampled)
 
 
@@ -159,7 +167,12 @@ def sample_tokens_per_slot(
     step — requests are reproducible under preemption and re-batching.
     """
     greedy_choice, scaled = _filtered_logits(logits, params, allowed_mask)
-    sampled = jax.vmap(
-        lambda k, row: jax.random.categorical(k, row).astype(jnp.int32)
-    )(keys, scaled)
+    sampled = jax.lax.cond(
+        jnp.any(params.temperature > 0.0),
+        lambda s: jax.vmap(
+            lambda k, row: jax.random.categorical(k, row).astype(jnp.int32)
+        )(keys, s),
+        lambda s: greedy_choice,
+        scaled,
+    )
     return jnp.where(params.temperature <= 0.0, greedy_choice, sampled)

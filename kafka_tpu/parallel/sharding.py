@@ -53,20 +53,35 @@ def _kv_axis(cfg: ModelConfig, mesh: Mesh) -> Optional[str]:
 def param_specs(cfg: ModelConfig, mesh: Mesh) -> Params:
     """PartitionSpec pytree congruent with init_params' tree."""
     kv = _kv_axis(cfg, mesh)
+    layers: Params = {
+        "ln_attn": P(),
+        "ln_mlp": P(),
+        "wq": P(None, None, "tp", None),
+        "wk": P(None, None, kv, None),
+        "wv": P(None, None, kv, None),
+        "wo": P(None, "tp", None, None),
+    }
+    if cfg.is_moe:
+        # MoE (models/llama.py:_moe_block): experts over "ep", per-expert
+        # FFN dim still Megatron-split over "tp" — ep x tp composes.  The
+        # router stays replicated so every rank routes identically; GSPMD
+        # inserts the expert-axis psum at the combine einsum.
+        ep = "ep" if (
+            mesh.shape.get("ep", 1) > 1
+            and cfg.num_experts % mesh.shape["ep"] == 0
+        ) else None
+        layers["router"] = P()
+        layers["wg"] = P(None, ep, None, "tp")   # [L, E, H, F]
+        layers["wu"] = P(None, ep, None, "tp")
+        layers["wd"] = P(None, ep, "tp", None)   # [L, E, F, H]
+    else:
+        layers["wg"] = P(None, None, "tp")
+        layers["wu"] = P(None, None, "tp")
+        layers["wd"] = P(None, "tp", None)
     specs: Params = {
         "embed": P(),
         "final_norm": P(),
-        "layers": {
-            "ln_attn": P(),
-            "ln_mlp": P(),
-            "wq": P(None, None, "tp", None),
-            "wk": P(None, None, kv, None),
-            "wv": P(None, None, kv, None),
-            "wo": P(None, "tp", None, None),
-            "wg": P(None, None, "tp"),
-            "wu": P(None, None, "tp"),
-            "wd": P(None, "tp", None),
-        },
+        "layers": layers,
     }
     if not cfg.tie_word_embeddings:
         specs["lm_head"] = P(None, "tp")
@@ -82,10 +97,31 @@ def kv_pool_spec(cfg: ModelConfig, mesh: Mesh) -> P:
 
 
 def shard_params(params: Params, cfg: ModelConfig, mesh: Mesh) -> Params:
-    """Place a param pytree onto the mesh per the TP rules."""
+    """Place a param pytree onto the mesh per the TP rules.
+
+    Int8 QTensor leaves (models/quant.py) shard their `q` exactly like the
+    dense weight; the per-output-channel scale follows the same spec with
+    size-1 (contraction) dims unsharded.
+    """
+    from ..models.quant import QTensor
+
     specs = param_specs(cfg, mesh)
+
+    def place(x, spec):
+        if isinstance(x, QTensor):
+            axes = list(spec) + [None] * (x.q.ndim - len(spec))
+            s_spec = P(*(
+                ax if x.s.shape[i] != 1 else None
+                for i, ax in enumerate(axes)
+            ))
+            return QTensor(
+                q=jax.device_put(x.q, NamedSharding(mesh, spec)),
+                s=jax.device_put(x.s, NamedSharding(mesh, s_spec)),
+            )
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
     return jax.tree.map(
-        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs
+        place, params, specs, is_leaf=lambda x: isinstance(x, QTensor)
     )
 
 

@@ -48,16 +48,17 @@ class DataParallelEngines:
         dp: int,
         tp: int = 1,
         sp: int = 1,
+        ep: int = 1,
         kv_dtype=None,
         devices: Optional[List[jax.Device]] = None,
     ):
         devices = list(devices if devices is not None else jax.devices())
-        per = tp * sp
+        per = tp * sp * ep
         need = dp * per
         if len(devices) < need:
             raise ValueError(
-                f"dp={dp} x sp={sp} x tp={tp} needs {need} devices, "
-                f"have {len(devices)}"
+                f"dp={dp} x sp={sp} x tp={tp} x ep={ep} needs {need} "
+                f"devices, have {len(devices)}"
             )
         self.engines: List[InferenceEngine] = []
         for r in range(dp):
@@ -65,7 +66,8 @@ class DataParallelEngines:
             # a mesh over exactly this replica's devices pins its params
             # and KV pool there (the engine places for any provided mesh);
             # sp>1 replicas run ring-sharded chunked prefill internally
-            mesh = make_mesh(MeshConfig(sp=sp, tp=tp), devices=slice_devices)
+            mesh = make_mesh(MeshConfig(sp=sp, tp=tp, ep=ep),
+                             devices=slice_devices)
             self.engines.append(
                 InferenceEngine(
                     cfg, params, engine_cfg, kv_dtype=kv_dtype, mesh=mesh
@@ -105,7 +107,8 @@ class DataParallelEngines:
             if hit is not None:
                 self._affinity.move_to_end(req.prefix_key)
                 return hit
-        loads = [e.num_active + len(e.waiting) for e in self.engines]
+        loads = [e.num_active + len(e.waiting) + len(e.parked)
+                 for e in self.engines]
         return loads.index(min(loads))
 
     def submit(self, req: GenRequest) -> None:

@@ -243,7 +243,34 @@ class InferenceEngine:
         sp = mesh.shape.get("sp", 1) if mesh is not None else 1
         self._sp = sp
         self._pp = mesh.shape.get("pp", 1) if mesh is not None else 1
+        self._ep = mesh.shape.get("ep", 1) if mesh is not None else 1
+        if self._ep > 1:
+            if not cfg.is_moe:
+                raise ValueError(
+                    f"mesh has ep={self._ep} but {cfg.name!r} is dense: "
+                    "the ep axis shards MoE expert weights"
+                )
+            if cfg.num_experts % self._ep:
+                raise ValueError(
+                    f"num_experts={cfg.num_experts} not divisible by "
+                    f"ep={self._ep}"
+                )
         if self._pp > 1:
+            if cfg.is_moe:
+                raise ValueError(
+                    "pp stage sharding does not support MoE models yet: "
+                    "use ep x tp meshes for Mixtral-class serving"
+                )
+            from ..models.quant import QTensor
+
+            if any(isinstance(x, QTensor) for x in jax.tree.leaves(
+                params, is_leaf=lambda v: isinstance(v, QTensor)
+            )):
+                raise ValueError(
+                    "pp stage sharding does not support int8 QTensor "
+                    "params yet: quantization targets single-chip/tp "
+                    "serving"
+                )
             if sp > 1:
                 raise ValueError(
                     "pp does not compose with sp ring prefill yet: use "
@@ -952,65 +979,88 @@ class InferenceEngine:
             req.seq.pages, req.seq.length = hit
 
     def _admit(self) -> None:
-        # Off-slot lanes claim freed slots first — UNLESS the waiting head
-        # is older (a preemption victim re-inserted at waiting[0] must not
-        # lose its place to parked lanes submitted after it): strict
-        # submit-order FIFO across both queues.  A PARKED lane seats into
-        # decode directly (its pages and first token already exist); a
-        # still-PREFILLING off-slot lane adopts the slot and finishes its
-        # chunks as an ordinary slot lane.
-        while self.parked:
+        # Strict submit-order FIFO across BOTH queues: each free slot goes
+        # to the older of (waiting head, oldest parked lane) — a preemption
+        # victim re-inserted at waiting[0] keeps its place ahead of parked
+        # lanes submitted after it, and parked lanes keep theirs ahead of
+        # younger waiting requests.  One liveness exception: a PAGE-BLOCKED
+        # waiting head yields the slot to parked lanes — seating them needs
+        # no new pages, and their completions are what will free pages for
+        # the blocked head (holding the slot for it could otherwise spin
+        # with an idle slot and never-seated parked lanes).
+        while True:
             slot = self._free_slot()
             if slot is None:
                 break
-            oldest = min(self.parked, key=lambda r: r.submit_time)
-            if self.waiting and self.waiting[0].submit_time < oldest.submit_time:
-                break  # the waiting loop below owns this slot
-            req = oldest
-            self.parked.remove(req)
-            req.slot = slot
-            self.slots[slot] = req
-            self._ctl_dirty = True
-            if req.state == PARKED:
-                req.state = ACTIVE
-                pending = (
-                    req.pending_tok if req.pending_tok is not None
-                    else req.output_ids[-1]  # resumed: host-known
-                )
-                self._d_last = self._d_last.at[slot].set(pending)
-                req.pending_tok = None
-        while self.waiting:
-            slot = self._free_slot()
-            if slot is None:
+            oldest = (
+                min(self.parked, key=lambda r: r.submit_time)
+                if self.parked else None
+            )
+            head = self.waiting[0] if self.waiting else None
+            if head is None and oldest is None:
                 break
-            req = self.waiting[0]
-            self._attach_prefix(req)
-            needed = self._pages_needed(req)
-            if needed > self.pool.free_pages and not (
-                self.prefix_cache is not None
-                and self.prefix_cache.reclaim(needed)
-            ):
-                # Waiting requests must not pin pool pages: drop the prefix
-                # retains taken above, else a blocked head could deadlock a
-                # preempted victim ahead of it under extreme page pressure
-                # (the cache keeps its own retains; _attach_prefix simply
-                # re-acquires on the next attempt).
-                if req.seq is not None:
-                    self.pool.free_sequence(req.seq)
-                    req.seq = None
-                break  # wait for pages to free up
-            self.waiting.pop(0)
-            try:
-                self._start_prefill(req, slot)
-            except OutOfPagesError:
-                # couldn't reserve the prompt's pages; roll back, retry later
-                if req.seq:
-                    self.pool.free_sequence(req.seq)
-                req.state = WAITING
-                req.seq = None
-                self.waiting.insert(0, req)
+            head_first = head is not None and (
+                oldest is None or head.submit_time < oldest.submit_time
+            )
+            if head_first and self._admit_waiting_head(slot):
+                continue
+            if head_first and oldest is None:
+                break  # head page-blocked, nothing parked to seat
+            if oldest is None:
                 break
+            self.parked.remove(oldest)
+            self._seat(oldest, slot)
         self._admit_offslot()
+
+    def _admit_waiting_head(self, slot: int) -> bool:
+        """Try to start the waiting head's prefill in `slot`.
+
+        Returns False (leaving the queue untouched) when page-blocked.
+        Waiting requests must not pin pool pages: prefix retains taken for
+        the page estimate are dropped on failure, else a blocked head could
+        deadlock a preempted victim ahead of it under extreme pressure
+        (the cache keeps its own retains; _attach_prefix re-acquires).
+        """
+        req = self.waiting[0]
+        self._attach_prefix(req)
+        needed = self._pages_needed(req)
+        if needed > self.pool.free_pages and not (
+            self.prefix_cache is not None
+            and self.prefix_cache.reclaim(needed)
+        ):
+            if req.seq is not None:
+                self.pool.free_sequence(req.seq)
+                req.seq = None
+            return False
+        self.waiting.pop(0)
+        try:
+            self._start_prefill(req, slot)
+        except OutOfPagesError:
+            # couldn't reserve the prompt's pages; roll back, retry later
+            if req.seq:
+                self.pool.free_sequence(req.seq)
+            req.state = WAITING
+            req.seq = None
+            self.waiting.insert(0, req)
+            return False
+        return True
+
+    def _seat(self, req: GenRequest, slot: int) -> None:
+        """Move an off-slot lane into a decode slot.  A PARKED lane joins
+        decode directly (its pages and first token already exist); a
+        still-PREFILLING lane adopts the slot and finishes its chunks as
+        an ordinary slot lane."""
+        req.slot = slot
+        self.slots[slot] = req
+        self._ctl_dirty = True
+        if req.state == PARKED:
+            req.state = ACTIVE
+            pending = (
+                req.pending_tok if req.pending_tok is not None
+                else req.output_ids[-1]  # resumed: host-known
+            )
+            self._d_last = self._d_last.at[slot].set(pending)
+            req.pending_tok = None
 
     def _admit_offslot(self) -> None:
         """Start off-slot prefills for waiting requests when slots are full.
@@ -1072,7 +1122,7 @@ class InferenceEngine:
             allowed_ids = req.logits_mask_fn(req.output_ids)
             if allowed_ids is not None:
                 row = np.zeros((1, self.cfg.vocab_size), bool)
-                row[0, np.asarray(allowed_ids, np.int64)] = True
+                row[0, self._in_vocab(allowed_ids)] = True
                 req.prefill_allowed = self._dev(row)
         req.state = PREFILLING
         req.slot = slot
@@ -1643,6 +1693,17 @@ class InferenceEngine:
             [s.seed if s else 0 for s in slots], np.uint32))
         self._ctl_dirty = False
 
+    def _in_vocab(self, allowed_ids) -> np.ndarray:
+        """Clip a constrained-decoding allow-list to the model vocab.
+
+        A tokenizer whose id space exceeds the model's embedding table
+        (e.g. special ids atop a smaller checkpoint vocab) must degrade to
+        a tighter mask, not crash the single engine thread — a step-loop
+        exception fails EVERY in-flight request (worker._fail_all).
+        """
+        ids = np.asarray(allowed_ids, np.int64)
+        return ids[(ids >= 0) & (ids < self.cfg.vocab_size)]
+
     def _build_allowed_mask(self) -> Optional[np.ndarray]:
         """Batched constrained-decoding mask, if any slot constrains.
 
@@ -1664,7 +1725,7 @@ class InferenceEngine:
                 allowed = s.logits_mask_fn(s.output_ids)
                 if allowed is not None:
                     row = np.zeros(V, bool)
-                    row[np.asarray(allowed, np.int64)] = True
+                    row[self._in_vocab(allowed)] = True
                     rows.append(row)
                     any_mask = True
                     continue

@@ -23,6 +23,8 @@ def main() -> None:
                    help="pipeline stages for models exceeding one slice's HBM")
     p.add_argument("--dp-size", type=int, default=None,
                    help="data-parallel engine replicas (dp*sp*tp devices)")
+    p.add_argument("--ep-size", type=int, default=None,
+                   help="expert-parallel width for MoE models (Mixtral)")
     p.add_argument("--max-batch", type=int, default=None)
     p.add_argument("--tiny-model", action="store_true",
                    help="serve a tiny random-weight model (dev/demo)")
@@ -47,6 +49,8 @@ def main() -> None:
         overrides["pp_size"] = args.pp_size
     if args.dp_size is not None:
         overrides["dp_size"] = args.dp_size
+    if args.ep_size is not None:
+        overrides["ep_size"] = args.ep_size
     if args.max_batch is not None:
         overrides["max_batch"] = args.max_batch
     if args.tiny_model:

@@ -115,6 +115,12 @@ def build_tpu_provider(cfg: ServingConfig) -> LLMProvider:
             vocab_size=max(tokenizer.vocab_size, 262), dtype=cfg.dtype
         )
         params = init_params(model_cfg, jax.random.PRNGKey(0))
+    if cfg.quantize == "int8":
+        from ..models import quantize_params
+
+        params = quantize_params(params, model_cfg)
+    elif cfg.quantize:
+        raise ValueError(f"unknown quantize mode {cfg.quantize!r}")
 
     engine_cfg = EngineConfig(
         max_batch=cfg.max_batch,
@@ -143,15 +149,18 @@ def build_tpu_provider(cfg: ServingConfig) -> LLMProvider:
         engine = DataParallelEngines(
             model_cfg, params, engine_cfg,
             dp=cfg.dp_size, tp=cfg.tp_size, sp=cfg.sp_size,
+            ep=cfg.ep_size,
             devices=local,
         )
     else:
         mesh = None
-        if cfg.tp_size > 1 or cfg.sp_size > 1 or cfg.pp_size > 1:
+        if (cfg.tp_size > 1 or cfg.sp_size > 1 or cfg.pp_size > 1
+                or cfg.ep_size > 1):
             from ..parallel import MeshConfig, make_mesh
 
             mesh = make_mesh(MeshConfig(
-                pp=cfg.pp_size, sp=cfg.sp_size, tp=cfg.tp_size
+                pp=cfg.pp_size, sp=cfg.sp_size, tp=cfg.tp_size,
+                ep=cfg.ep_size,
             ))
         engine = InferenceEngine(model_cfg, params, engine_cfg, mesh=mesh)
     if cfg.warmup:
@@ -244,6 +253,7 @@ async def create_app(
         tools=tools,
         mcp_servers=mcp_servers,
         default_model=cfg.model_name,
+        system_prompt=cfg.system_prompt,
     )
     await kafka.initialize()
 
@@ -399,6 +409,7 @@ async def _agent_events(
             mcp_servers=state["mcp_servers"],
             thread_id=thread_id,
             default_model=model,
+            system_prompt=state["cfg"].system_prompt,
         )
         await kafka.initialize()
         stream = kafka.run_with_thread(thread_id, messages, **sampling)

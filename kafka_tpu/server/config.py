@@ -18,6 +18,9 @@ class ServingConfig:
     model_name: str = "llama-3.2-1b"
     checkpoint_dir: Optional[str] = None  # HF safetensors dir; None=random init
     dtype: str = "bfloat16"
+    # weight-only quantization: "" (bf16) or "int8" (models/quant.py) —
+    # halves decode weight traffic and fits Llama-3-8B on one v5e chip
+    quantize: str = ""
     # engine shape
     max_batch: int = 8
     page_size: int = 16
@@ -35,10 +38,13 @@ class ServingConfig:
     #   dp — data parallel: dp independent engine replicas, each over its
     #        own tp*sp device slice, with thread-affinity request routing
     #        (runtime/dp_router.py).  dp*pp*sp*tp devices total.
+    #   ep — expert parallel (MoE): expert weights shard over "ep" for
+    #        Mixtral-class models; composes with tp (and dp replicas)
     tp_size: int = 1
     sp_size: int = 1
     pp_size: int = 1
     dp_size: int = 1
+    ep_size: int = 1
     # long-context CP strategy when sp>1: "ring" or "ulysses"
     cp_strategy: str = "ring"
     # server
@@ -52,6 +58,12 @@ class ServingConfig:
     cors_origins: str = "*"
     # test/dev: tiny random model instead of a real checkpoint
     tiny_model: bool = False
+    # Static system prompt bypassing the sectioned prompt provider
+    # (reference src/kafka/v1.py:85 / src/agents/base.py:102-104 had the
+    # same seam).  None = the full PromptProviderV1 persona.  Benchmarks
+    # use it to keep the served prompt a realistic size under the
+    # byte-level tokenizer.
+    system_prompt: Optional[str] = None
     # compile the serving programs at boot (one tiny generation per engine)
     # so the first real request doesn't pay the 20-40s XLA compile
     warmup: bool = True
@@ -111,6 +123,7 @@ class ServingConfig:
             sp_size=get_axis("SP", cls.sp_size),
             pp_size=get_axis("PP", cls.pp_size),
             dp_size=get_axis("DP", cls.dp_size),
+            ep_size=get_axis("EP", cls.ep_size),
             cp_strategy=get("CP_STRATEGY", cls.cp_strategy),
             host=get("HOST", cls.host),
             port=get("PORT", cls.port, int),
@@ -118,6 +131,8 @@ class ServingConfig:
             db_path=get("DB_PATH", cls.db_path),
             local_sandbox_url=get("SANDBOX_URL", None),
             tiny_model=get("TINY_MODEL", "0") in ("1", "true", "True"),
+            system_prompt=get("SYSTEM_PROMPT", None),
+            quantize=get("QUANTIZE", cls.quantize),
             warmup=get("WARMUP", "1") not in ("0", "false", "False"),
             compile_cache_dir=get("COMPILE_CACHE", cls.compile_cache_dir),
         )

@@ -34,13 +34,26 @@ from kafka_tpu.runtime import EngineConfig, GenRequest, InferenceEngine
 from kafka_tpu.runtime.kv_cache import page_table_array
 
 
-def timed_loop(fn, steps: int) -> float:
+def timed_loop(fn, steps: int, final=None) -> float:
+    """Time `steps` pipelined dispatches, blocking ONCE at the end.
+
+    On a tunneled TPU a per-step block_until_ready measures the ~100ms
+    device->host RTT, not compute (the r03 version of this script did
+    exactly that and attributed ~118ms to a 5ms step).  Queuing all
+    dispatches and blocking on the final state keeps the device saturated
+    the way the engine's async fetch pipeline does.
+    """
     fn()  # warmup/compile
+    if final is not None:
+        jax.block_until_ready(final())
     jax.effects_barrier()
     t0 = time.monotonic()
     for _ in range(steps):
         fn()
-    jax.effects_barrier()
+    if final is not None:
+        jax.block_until_ready(final())
+    else:
+        jax.effects_barrier()
     return (time.monotonic() - t0) / steps * 1e3
 
 
@@ -74,9 +87,17 @@ def main() -> None:
     while engine.num_active < args.batch:
         engine.step()
 
-    # ---- A. full scheduler loop -----------------------------------------
-    ms_a = timed_loop(lambda: engine.step(), args.steps)
-    print(f"A engine.step() full loop      : {ms_a:8.2f} ms/step")
+    # ---- A. full scheduler loop (divide by fused depth!) -----------------
+    s0 = engine.metrics.decode_steps
+    t0 = time.monotonic()
+    iters = 0
+    while engine.metrics.decode_steps - s0 < args.steps:
+        engine.step()
+        iters += 1
+    dsteps = engine.metrics.decode_steps - s0
+    ms_a = (time.monotonic() - t0) / dsteps * 1e3
+    print(f"A engine.step() full loop      : {ms_a:8.2f} ms/device-step "
+          f"({dsteps} device steps in {iters} scheduler iterations)")
 
     # ---- device-resident args for the raw fn loops ----------------------
     B, ps, C = ecfg.max_batch, ecfg.page_size, ecfg.max_window
@@ -100,9 +121,8 @@ def main() -> None:
             engine.params, state["k"], state["v"], table, state["last"],
             seq_lens, active, temps, top_ks, top_ps, seeds, None)
         state["k"], state["v"], state["last"] = k, v, toks
-        toks.block_until_ready()
 
-    ms_b = timed_loop(run_b, args.steps)
+    ms_b = timed_loop(run_b, args.steps, final=lambda: state["last"])
     print(f"B decode_fn device loop        : {ms_b:8.2f} ms/step"
           f"   (host sched overhead: {ms_a - ms_b:.2f})")
 
@@ -137,9 +157,8 @@ def main() -> None:
             k, v, toks = fn(engine.params, state["k"], state["v"], table,
                             state["last"], seq_lens)
             state["k"], state["v"], state["last"] = k, v, toks
-            toks.block_until_ready()
 
-        ms = timed_loop(run, args.steps)
+        ms = timed_loop(run, args.steps, final=lambda: state["last"])
         print(f"{label}: {ms:8.2f} ms/step")
 
     # ---- E. logits head alone (bf16 vs f32-cast) -------------------------
@@ -150,9 +169,12 @@ def main() -> None:
         "bh,vh->bv", x.astype(jnp.float32), h.astype(jnp.float32)))
     bf16 = jax.jit(lambda x, h: jnp.einsum(
         "bh,vh->bv", x, h, preferred_element_type=jnp.float32))
-    ms = timed_loop(lambda: f32(x, head).block_until_ready(), args.steps)
+    sink = {"a": None}
+    ms = timed_loop(lambda: sink.__setitem__("a", f32(x, head)),
+                    args.steps, final=lambda: sink["a"])
     print(f"E logits head f32-cast         : {ms:8.2f} ms/step")
-    ms = timed_loop(lambda: bf16(x, head).block_until_ready(), args.steps)
+    ms = timed_loop(lambda: sink.__setitem__("a", bf16(x, head)),
+                    args.steps, final=lambda: sink["a"])
     print(f"F logits head bf16->f32 accum  : {ms:8.2f} ms/step")
 
     # ---- G. sampling pipeline alone --------------------------------------
@@ -160,8 +182,62 @@ def main() -> None:
     keys = jax.vmap(jax.random.key)(jnp.arange(B, dtype=jnp.uint32))
     samp = jax.jit(lambda lg: sample_tokens_per_slot(
         lg, SamplingParams(temps, top_ks, top_ps), keys, None))
-    ms = timed_loop(lambda: samp(logits).block_until_ready(), args.steps)
+    ms = timed_loop(lambda: sink.__setitem__("a", samp(logits)),
+                    args.steps, final=lambda: sink["a"])
     print(f"G sampling pipeline (greedy)   : {ms:8.2f} ms/step")
+
+    # ---- I. head orientation: tied [V, H] vs transposed [H, V] -----------
+    # The tied-embedding logits einsum contracts the MINOR axis of a [V, H]
+    # table; if XLA tiles that poorly, a one-time transposed copy (engine
+    # option) buys the MXU-natural [H, V] layout.
+    head_t = jnp.asarray(np.asarray(head).T)  # [H, V]
+    vh = jax.jit(lambda x, h: jnp.einsum(
+        "bh,vh->bv", x, h, preferred_element_type=jnp.float32))
+    hv = jax.jit(lambda x, h: jnp.einsum(
+        "bh,hv->bv", x, h, preferred_element_type=jnp.float32))
+    ms = timed_loop(lambda: sink.__setitem__("a", vh(x, head)),
+                    args.steps, final=lambda: sink["a"])
+    print(f"I logits head [V,H] (tied)     : {ms:8.2f} ms/step")
+    ms = timed_loop(lambda: sink.__setitem__("a", hv(x, head_t)),
+                    args.steps, final=lambda: sink["a"])
+    print(f"J logits head [H,V] transposed : {ms:8.2f} ms/step")
+
+    # ---- K. decode with xla attention backend (vs auto/pallas above) -----
+    if engine.cfg.attention_backend == "pallas":
+        import dataclasses as _dc
+
+        from kafka_tpu.runtime.engine import InferenceEngine as IE
+
+        xeng = IE(cfg, engine.params,
+                  _dc.replace(ecfg, attention_backend="xla"), kv_dtype=None)
+        xstate = {"k": xeng.k_pool, "v": xeng.v_pool, "last": state["last"]}
+
+        def run_x():
+            k, v, toks, _ = xeng._decode_fn(
+                xeng.params, xstate["k"], xstate["v"], table,
+                xstate["last"], seq_lens, active, temps, top_ks, top_ps,
+                seeds, None)
+            xstate["k"], xstate["v"], xstate["last"] = k, v, toks
+
+        ms = timed_loop(run_x, args.steps, final=lambda: xstate["last"])
+        print(f"K decode_fn xla attention      : {ms:8.2f} ms/step")
+
+    # ---- H. fused multi-step scan (the serving configuration) ------------
+    k = ecfg.multi_step
+    if k > 1:
+        mfn = engine._get_multi_decode_fn(k)
+
+        def run_m():
+            kp, vp, toks_seq, last_, lens_ = mfn(
+                engine.params, state["k"], state["v"], table,
+                state["last"], seq_lens, active, temps, top_ks,
+                top_ps, seeds)
+            state["k"], state["v"], state["last"] = kp, vp, last_
+
+        ms = timed_loop(run_m, max(4, args.steps // k),
+                        final=lambda: state["last"])
+        print(f"H fused {k}-step scan          : {ms / k:8.2f} ms/step "
+              f"({ms:.2f} ms/dispatch)")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,159 @@
+"""Servable MoE (VERDICT r3 next #5): the ep axis carries a real serving
+engine, not just a standalone layer.
+
+Covers: the in-model MoE block matches parallel/expert.py's validated
+dense-dispatch reference; the paged serving engine is token-exact on a MoE
+model (single device, ep mesh, ep x tp mesh); HF Mixtral-style checkpoint
+weights load; misconfigured meshes fail loudly.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from kafka_tpu.models import ModelConfig, forward, get_config, init_params
+from kafka_tpu.parallel import MeshConfig, make_mesh
+from kafka_tpu.parallel.expert import moe_mlp_reference
+from kafka_tpu.runtime import EngineConfig, GenRequest, InferenceEngine
+
+
+@pytest.fixture(scope="module")
+def moe_model():
+    cfg = ModelConfig(name="moe-test", vocab_size=128, hidden_size=64,
+                      intermediate_size=96, num_layers=2, num_heads=4,
+                      num_kv_heads=2, head_dim=16, dtype="float32",
+                      num_experts=4, num_experts_per_tok=2)
+    params = init_params(cfg, jax.random.PRNGKey(11))
+    return cfg, params
+
+
+def make_engine(cfg, params, mesh=None, **kw):
+    defaults = dict(max_batch=4, page_size=8, num_pages=64,
+                    max_pages_per_seq=8, prefill_buckets=(8, 16, 32))
+    defaults.update(kw)
+    return InferenceEngine(cfg, params, EngineConfig(**defaults),
+                          kv_dtype=jnp.float32, mesh=mesh)
+
+
+class TestMoEBlock:
+    def test_matches_expert_module_reference(self, moe_model):
+        """models/llama.py:_moe_block == parallel/expert.py's validated
+        dense-dispatch reference, layer by layer."""
+        cfg, params = moe_model
+        from kafka_tpu.models.llama import _moe_block
+
+        x = jax.random.normal(jax.random.PRNGKey(3), (2, 5, cfg.hidden_size),
+                              jnp.float32)
+        for layer in range(cfg.num_layers):
+            lp = {k: v[layer] for k, v in params["layers"].items()}
+            got = _moe_block(x, lp, cfg)
+            ref = moe_mlp_reference(
+                x.reshape(-1, cfg.hidden_size),
+                {"router": lp["router"], "wg": lp["wg"], "wu": lp["wu"],
+                 "wd": lp["wd"]},
+                top_k=cfg.num_experts_per_tok,
+            ).reshape(x.shape)
+            np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                       rtol=2e-5, atol=2e-5)
+
+    def test_registry_configs(self):
+        mix = get_config("mixtral-8x7b")
+        assert mix.is_moe and mix.num_experts == 8
+        assert get_config("tiny-moe").is_moe
+        assert not get_config("tiny").is_moe
+
+
+class TestMoEServing:
+    def test_engine_greedy_matches_uncached_forward(self, moe_model):
+        cfg, params = moe_model
+        eng = make_engine(cfg, params)
+        prompt = [5, 99, 23, 4, 17, 42, 8]
+        req = eng.generate(prompt, max_new_tokens=10)
+        seq = prompt + req.output_ids
+        x = jnp.asarray([seq], jnp.int32)
+        pos = jnp.arange(len(seq), dtype=jnp.int32)[None, :]
+        logits, _ = forward(params, cfg, x, pos)
+        preds = np.asarray(jnp.argmax(logits[0], axis=-1))
+        for i in range(len(prompt) - 1, len(seq) - 1):
+            assert preds[i] == seq[i + 1], f"divergence at {i}"
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
+class TestExpertParallelServing:
+    def test_ep_engine_token_exact(self, moe_model):
+        """The SERVING engine (paged prefill + decode) on an ep=4 mesh
+        matches the single-device engine token for token."""
+        cfg, params = moe_model
+        base = make_engine(cfg, params)
+        eng = make_engine(cfg, params, mesh=make_mesh(MeshConfig(ep=4)))
+        prompts = {"a": [3, 9, 27, 81], "b": [100] * 11, "c": [7, 6, 5]}
+        for rid, p in prompts.items():
+            base.submit(GenRequest(request_id=rid, prompt_ids=p,
+                                   max_new_tokens=8))
+            eng.submit(GenRequest(request_id=rid, prompt_ids=p,
+                                  max_new_tokens=8))
+        want = base.run_to_completion()
+        got = eng.run_to_completion()
+        for rid in prompts:
+            assert got[rid].output_ids == want[rid].output_ids, rid
+
+    def test_ep_x_tp_engine_token_exact(self, moe_model):
+        cfg, params = moe_model
+        base = make_engine(cfg, params)
+        mesh = make_mesh(MeshConfig(ep=4, tp=2))
+        eng = make_engine(cfg, params, mesh=mesh)
+        prompt = [5, 2, 9, 31, 4]
+        want = base.generate(prompt, max_new_tokens=8).output_ids
+        got = eng.generate(prompt, max_new_tokens=8).output_ids
+        assert got == want
+
+    def test_dense_model_on_ep_mesh_rejected(self, moe_model):
+        cfg = ModelConfig(dtype="float32")
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        with pytest.raises(ValueError, match="dense"):
+            make_engine(cfg, params, mesh=make_mesh(MeshConfig(ep=4)))
+
+    def test_indivisible_experts_rejected(self, moe_model):
+        cfg, params = moe_model  # 4 experts
+        with pytest.raises(ValueError, match="divisible"):
+            make_engine(cfg, params, mesh=make_mesh(MeshConfig(ep=8)))
+
+
+class TestMixtralCheckpoint:
+    def test_hf_mixtral_state_dict_loads_and_matches(self):
+        """Convert a tiny HF MixtralForCausalLM state dict and check our
+        forward matches transformers logits (the same proof
+        test_llama_numerics.py gives the dense family)."""
+        torch = pytest.importorskip("torch")
+        transformers = pytest.importorskip("transformers")
+
+        hf_cfg = transformers.MixtralConfig(
+            vocab_size=96, hidden_size=32, intermediate_size=48,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, num_local_experts=4,
+            num_experts_per_tok=2, rope_theta=10000.0,
+            max_position_embeddings=128,
+        )
+        torch.manual_seed(0)
+        hf_model = transformers.MixtralForCausalLM(hf_cfg).eval()
+
+        from kafka_tpu.models.loader import convert_hf_state_dict
+
+        cfg = ModelConfig(
+            name="tiny-mixtral", vocab_size=96, hidden_size=32,
+            intermediate_size=48, num_layers=2, num_heads=4,
+            num_kv_heads=2, head_dim=8, rope_theta=10000.0,
+            dtype="float32", tie_word_embeddings=False,
+            num_experts=4, num_experts_per_tok=2,
+        )
+        params = convert_hf_state_dict(
+            hf_model.state_dict(), cfg, dtype=jnp.float32
+        )
+        ids = [[1, 17, 3, 44, 9, 60, 2]]
+        with torch.no_grad():
+            ref = hf_model(torch.tensor(ids)).logits.numpy()
+        pos = jnp.arange(len(ids[0]), dtype=jnp.int32)[None, :]
+        got, _ = forward(params, cfg, jnp.asarray(ids, jnp.int32), pos)
+        np.testing.assert_allclose(np.asarray(got), ref, rtol=2e-4, atol=2e-4)

@@ -1,0 +1,178 @@
+"""Playground single-file client checks (VERDICT r3 next #7).
+
+No browser/JS runtime exists in this environment, so these tests guard
+what is mechanically checkable: the page serves, the UX surfaces the
+verdict asked for are present (markdown renderer, tool-call cards,
+per-completion segmentation, stop/abort), and the inline script is
+lexically sound (an ordered scanner that understands JS strings, template
+literals, comments, and regex literals balance-checks every bracket — a
+stray brace would otherwise break the ENTIRE client silently).
+"""
+
+import re
+
+import pytest
+
+PLAYGROUND = "kafka_tpu/server/playground.html"
+
+
+def _script(path=PLAYGROUND):
+    html = open(path).read()
+    m = re.search(r"<script>(.*)</script>", html, re.S)
+    assert m, "no script block"
+    return html, m.group(1)
+
+
+def scan_js(js: str):
+    """Ordered lexical scan: yields bracket tokens outside strings,
+    template literals, comments, and regex literals."""
+    i, n = 0, len(js)
+    out = []
+    # chars after which a `/` starts a regex, not division
+    regex_prefix = set("=([{,;:!&|?+-*%~^<>\n")
+    last_sig = "\n"
+    while i < n:
+        c = js[i]
+        if c == "/" and i + 1 < n and js[i + 1] == "/":
+            i = js.find("\n", i)
+            i = n if i < 0 else i
+            continue
+        if c == "/" and i + 1 < n and js[i + 1] == "*":
+            i = js.find("*/", i)
+            assert i >= 0, "unterminated block comment"
+            i += 2
+            continue
+        if c in "'\"":
+            q = c
+            i += 1
+            while i < n and js[i] != q:
+                i += 2 if js[i] == "\\" else 1
+            assert i < n, f"unterminated string at ...{js[max(0,i-40):i]}"
+            i += 1
+            last_sig = q
+            continue
+        if c == "`":
+            i += 1
+            while i < n and js[i] != "`":
+                if js[i] == "\\":
+                    i += 2
+                    continue
+                if js[i] == "$" and i + 1 < n and js[i + 1] == "{":
+                    # template expression: scan to matching }
+                    depth = 1
+                    i += 2
+                    while i < n and depth:
+                        if js[i] == "{":
+                            depth += 1
+                        elif js[i] == "}":
+                            depth -= 1
+                        i += 1
+                    continue
+                i += 1
+            assert i < n, "unterminated template literal"
+            i += 1
+            last_sig = "`"
+            continue
+        if c == "/" and last_sig in regex_prefix:
+            i += 1
+            in_class = False
+            while i < n and (in_class or js[i] != "/"):
+                if js[i] == "\\":
+                    i += 2
+                    continue
+                if js[i] == "[":
+                    in_class = True
+                elif js[i] == "]":
+                    in_class = False
+                i += 1
+            assert i < n, "unterminated regex literal"
+            i += 1
+            while i < n and js[i].isalpha():
+                i += 1
+            last_sig = "/"  # regex result: treat like value
+            continue
+        if not c.isspace():
+            last_sig = c
+        if c in "{}()[]":
+            out.append(c)
+        i += 1
+    return out
+
+
+class TestPlaygroundFile:
+    def test_script_brackets_balanced(self):
+        _, js = _script()
+        stack = []
+        pairs = {"}": "{", ")": "(", "]": "["}
+        for tok in scan_js(js):
+            if tok in "{([":
+                stack.append(tok)
+            else:
+                assert stack and stack[-1] == pairs[tok], (
+                    f"unbalanced {tok!r} (stack tail {stack[-5:]})"
+                )
+                stack.pop()
+        assert not stack, f"unclosed brackets: {stack}"
+
+    def test_ux_surfaces_present(self):
+        html, js = _script()
+        # markdown renderer + tool cards + segmentation + stop/abort
+        for marker in (
+            "mdToHtml", "mdInline", "<pre><code>",     # markdown
+            "toolCard", "card-head", "prettyJson",     # tool-call cards
+            "completionId",                            # per-completion seg
+            "AbortController", "aborter.abort",        # stop button
+            "tool_messages", "tool_result",
+            "agent_done", "[DONE]",                    # SSE contract
+            "localStorage", "Authorization",           # auth bar
+        ):
+            assert marker in html, f"missing {marker!r}"
+
+    def test_markdown_renderer_escapes_before_transform(self):
+        """mdToHtml must escape raw HTML before inserting tags — the
+        escHtml call has to appear inside the inline transformer."""
+        _, js = _script()
+        inline = js[js.index("function mdInline"):]
+        inline = inline[:inline.index("}")]
+        assert "escHtml(" in inline
+
+
+class TestPlaygroundServed:
+    def test_served_at_endpoint(self, tmp_path):
+        import asyncio
+
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from kafka_tpu.core.types import StreamChunk  # noqa: F401
+        from kafka_tpu.db import LocalDBClient
+        from kafka_tpu.llm.base import LLMProvider
+        from kafka_tpu.server import ServingConfig, create_app
+
+        class NullLLM(LLMProvider):
+            provider_name = "null"
+
+            async def stream_completion(self, messages, **kw):
+                if False:
+                    yield None
+
+            def get_available_models(self):
+                return []
+
+        async def go():
+            app = await create_app(
+                cfg=ServingConfig(db_path=str(tmp_path / "t.db")),
+                llm_provider=NullLLM(),
+                db=LocalDBClient(str(tmp_path / "t.db")),
+                tools=[], mcp_servers=[],
+            )
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                r = await client.get("/playground")
+                assert r.status == 200
+                body = await r.text()
+                assert "mdToHtml" in body and "toolCard" in body
+            finally:
+                await client.close()
+
+        asyncio.run(go())

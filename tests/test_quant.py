@@ -1,0 +1,141 @@
+"""Int8 weight-only quantization (VERDICT r3 next #4).
+
+Covers: quantize/dequantize error bounds, the quantized engine serving
+token streams with a high greedy match rate vs the bf16/f32 model, QTensor
+sharding on a tp mesh, and the ServingConfig wiring.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from kafka_tpu.models import (
+    ModelConfig, QTensor, dequantize, init_params, quantize_params,
+)
+from kafka_tpu.models.quant import quantize_array
+from kafka_tpu.runtime import EngineConfig, GenRequest, InferenceEngine
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = ModelConfig(name="quant-test", vocab_size=128, hidden_size=64,
+                      intermediate_size=128, num_layers=2, num_heads=4,
+                      num_kv_heads=2, head_dim=16, dtype="float32")
+    params = init_params(cfg, jax.random.PRNGKey(5))
+    return cfg, params
+
+
+def make_engine(cfg, params, mesh=None):
+    return InferenceEngine(
+        cfg, params,
+        EngineConfig(max_batch=2, page_size=8, num_pages=64,
+                     max_pages_per_seq=8, prefill_buckets=(8, 16, 32)),
+        kv_dtype=jnp.float32, mesh=mesh,
+    )
+
+
+class TestQuantizeArray:
+    def test_roundtrip_error_bound(self):
+        w = jax.random.normal(jax.random.PRNGKey(0), (4, 64, 32), jnp.float32)
+        qt = quantize_array(w, (1,))
+        assert qt.q.dtype == jnp.int8 and qt.s.shape == (4, 1, 32)
+        deq = np.asarray(dequantize(qt, jnp.float32))
+        # symmetric per-channel: |err| <= scale/2 per element
+        bound = np.asarray(qt.s.astype(jnp.float32)) / 2 + 1e-6
+        assert (np.abs(deq - np.asarray(w)) <= bound).all()
+
+    def test_quantize_params_coverage(self, model):
+        cfg, params = model
+        qp = quantize_params(params, cfg)
+        assert isinstance(qp["embed"], QTensor)
+        for name in ("wq", "wk", "wv", "wo", "wg", "wu", "wd"):
+            assert isinstance(qp["layers"][name], QTensor), name
+        # norms stay dense
+        assert not isinstance(qp["layers"]["ln_attn"], QTensor)
+        assert not isinstance(qp["final_norm"], QTensor)
+        # stored weight bytes roughly halve vs f32/4 (int8 + small scales)
+        from kafka_tpu.models.quant import param_bytes
+
+        dense = sum(x.size * x.dtype.itemsize
+                    for x in jax.tree.leaves(params))
+        assert param_bytes(qp) < 0.35 * dense
+
+    def test_moe_experts_quantize(self):
+        cfg = ModelConfig(name="qmoe", vocab_size=64, hidden_size=32,
+                          intermediate_size=48, num_layers=2, num_heads=4,
+                          num_kv_heads=2, head_dim=8, dtype="float32",
+                          num_experts=4)
+        qp = quantize_params(init_params(cfg, jax.random.PRNGKey(1)), cfg)
+        assert isinstance(qp["layers"]["wg"], QTensor)
+        assert not isinstance(qp["layers"]["router"], QTensor)
+
+
+class TestQuantizedServing:
+    def test_greedy_match_rate_vs_dense(self, model):
+        """The int8 engine's greedy stream matches the dense engine's on
+        most steps (random weights are the adversarial case: logit gaps
+        are tiny, so near-ties flip; real checkpoints match higher)."""
+        cfg, params = model
+        dense = make_engine(cfg, params)
+        q_eng = make_engine(cfg, quantize_params(params, cfg))
+        match = total = 0
+        for i in range(4):
+            prompt = [3 + i, 17, 92, 5, 44 + i]
+            a = dense.generate(prompt, max_new_tokens=16).output_ids
+            b = q_eng.generate(prompt, max_new_tokens=16).output_ids
+            total += len(a)
+            match += sum(1 for x, y in zip(a, b) if x == y)
+        assert match / total > 0.5, f"match rate {match}/{total}"
+
+    def test_quantized_engine_serves_batch(self, model):
+        cfg, params = model
+        eng = make_engine(cfg, quantize_params(params, cfg))
+        for i in range(3):
+            eng.submit(GenRequest(request_id=f"q{i}",
+                                  prompt_ids=[5 + i, 2, 9],
+                                  max_new_tokens=8))
+        done = eng.run_to_completion()
+        assert len(done) == 3
+        assert all(len(r.output_ids) == 8 for r in done.values())
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
+class TestQuantizedTP:
+    def test_qtensor_shards_on_tp_mesh(self, model):
+        from jax.sharding import PartitionSpec as P
+
+        from kafka_tpu.parallel import MeshConfig, make_mesh, shard_params
+
+        cfg, params = model
+        qp = quantize_params(params, cfg)
+        mesh = make_mesh(MeshConfig(tp=4))
+        sharded = shard_params(qp, cfg, mesh)
+        wq = sharded["layers"]["wq"]
+        assert wq.q.sharding.spec == P(None, None, "tp", None)
+        assert wq.s.sharding.spec == P(None, None, "tp", None)
+        # row-parallel wo: q shards the contraction, scale is replicated
+        wo = sharded["layers"]["wo"]
+        assert wo.q.sharding.spec == P(None, "tp", None, None)
+        assert all(ax is None for ax in wo.s.sharding.spec)
+
+    def test_tp_quantized_engine_matches_single_device(self, model):
+        from kafka_tpu.parallel import MeshConfig, make_mesh
+
+        cfg, params = model
+        qp = quantize_params(params, cfg)
+        base = make_engine(cfg, qp)
+        eng = make_engine(cfg, qp, mesh=make_mesh(MeshConfig(tp=4)))
+        prompt = [5, 99, 23, 4, 17]
+        want = base.generate(prompt, max_new_tokens=10).output_ids
+        got = eng.generate(prompt, max_new_tokens=10).output_ids
+        assert got == want
+
+
+class TestServingConfigWiring:
+    def test_env_quantize(self, monkeypatch):
+        from kafka_tpu.server import ServingConfig
+
+        monkeypatch.setenv("KAFKA_TPU_QUANTIZE", "int8")
+        assert ServingConfig.from_env().quantize == "int8"

