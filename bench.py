@@ -95,13 +95,15 @@ def hbm_traffic_per_step(engine, pbytes: int, batch: int,
 
 
 def percentiles_ms(samples, pts=(50, 90, 99)):
-    s = sorted(x * 1e3 for x in samples if x is not None)
+    """Client-side percentiles via the SAME nearest-rank method the engine
+    metrics use, so server_path ttft_ms and engine_ttft_ms are directly
+    comparable."""
+    from kafka_tpu.runtime.metrics import _percentiles
+
+    s = [x * 1e3 for x in samples if x is not None]
     if not s:
         return {f"p{p}": None for p in pts}
-    return {
-        f"p{p}": round(s[min(len(s) - 1, max(0, -(-p * len(s) // 100) - 1))], 1)
-        for p in pts
-    }
+    return {k: round(v, 1) for k, v in _percentiles(s, pts).items()}
 
 
 def serving_phase(cfg, params, args, quick: bool):
@@ -217,6 +219,16 @@ def serving_phase(cfg, params, args, quick: bool):
                              gen_len)
                         for i in range(min(4, n_threads))
                     ))
+                # SOLO turns: a lone prefilling lane takes the
+                # single-sequence prefill program, which the concurrent
+                # rounds never compile (uniform-length storms always group
+                # into the batched program) — but a fragmented measured
+                # storm does, and an uncompiled single-seq bucket once put
+                # a ~60s XLA compile inside measured turn 1 (p90 17s)
+                for r in range(2):
+                    await turn("warm-solo",
+                               f"solo warm turn {r} for the single path",
+                               gen_len)
                 log(f"serving warmup/compile: {time.monotonic() - t0:.1f}s")
                 engine.metrics = EngineMetrics()
 
@@ -359,16 +371,56 @@ def scale_phase(args, base_cfg, base_params) -> dict:
         ecfg.num_pages = batch * ecfg.max_pages_per_seq + 1
         return InferenceEngine(cfg, params, ecfg)
 
+    def _shapes(cfg):
+        from kafka_tpu.models import init_params
+
+        return jax.eval_shape(
+            lambda k: init_params(cfg, k), jax.random.PRNGKey(0)
+        )
+
     def fill_params(cfg):
         """Constant-fill weights (throughput-only models): init_params'
         EXACT pytree via eval_shape (zero RNG/compute — random-init of 8B
         through the tunnel costs minutes), constant values."""
-        from kafka_tpu.models import init_params
-
-        shapes = jax.eval_shape(init_params, cfg, jax.random.PRNGKey(0))
         return jax.tree.map(
-            lambda sd: jnp.full(sd.shape, 0.01, sd.dtype), shapes
+            lambda sd: jnp.full(sd.shape, 0.01, sd.dtype), _shapes(cfg)
         )
+
+    def fill_params_int8(cfg):
+        """Constant-fill DIRECTLY in int8 QTensor form.
+
+        quantize_params(fill_params(...)) would materialize the bf16 tree
+        first — 16 GB for 8B, which is exactly what does not fit the chip
+        (the reason int8 exists).  Throughput needs shapes, not values.
+        """
+        from kafka_tpu.models import QTensor
+        from kafka_tpu.models.quant import _CONTRACT, _CONTRACT_MOE
+
+        contract = dict(_CONTRACT)
+        if cfg.is_moe:
+            contract.update(_CONTRACT_MOE)
+
+        def qt(sd, axes):
+            sshape = tuple(
+                1 if i in axes else d for i, d in enumerate(sd.shape)
+            )
+            return QTensor(q=jnp.ones(sd.shape, jnp.int8),
+                           s=jnp.full(sshape, 0.01, jnp.float32))
+
+        shapes = _shapes(cfg)
+        layers = {
+            name: qt(sd, contract[name]) if name in contract
+            else jnp.full(sd.shape, 0.01, sd.dtype)
+            for name, sd in shapes["layers"].items()
+        }
+        out = {
+            "embed": qt(shapes["embed"], (1,)),
+            "final_norm": jnp.ones(shapes["final_norm"].shape, jnp.bfloat16),
+            "layers": layers,
+        }
+        if "lm_head" in shapes:
+            out["lm_head"] = qt(shapes["lm_head"], (0,))
+        return out
 
     def decode_tps(cfg, params, label, gen=128):
         eng = mk_engine(cfg, params, batch=8, gen=gen)
@@ -427,7 +479,7 @@ def scale_phase(args, base_cfg, base_params) -> dict:
     log(f"3b bf16: {tps:.1f} tok/s")
 
     cfg8 = get_config("llama-3-8b")
-    p8 = quantize_params(fill_params(cfg8), cfg8)
+    p8 = fill_params_int8(cfg8)
     tps, sps, pb, gbs = decode_tps(cfg8, p8, "8b-int8")
     del p8
     out["llama-3-8b-int8"] = {

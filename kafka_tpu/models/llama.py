@@ -270,6 +270,23 @@ def _mlp_block(x: jnp.ndarray, lp: Params) -> jnp.ndarray:
     return jnp.einsum("bsf,fh->bsh", jax.nn.silu(g) * u, _w(lp, "wd", x.dtype))
 
 
+def _routing_weights(t: jnp.ndarray, router: jnp.ndarray,
+                     top_k: int) -> jnp.ndarray:
+    """Per-token expert weights [T, E]: softmax over EXACTLY the top-k
+    router logits, scattered back (HF MixtralSparseMoeBlock semantics —
+    a >=threshold mask would activate extra experts on k-th-place ties).
+    The canonical routing implementation; parallel/expert.py reuses it.
+    """
+    logits = jnp.einsum(
+        "th,he->te", t, router, preferred_element_type=jnp.float32
+    )
+    top_vals, top_idx = jax.lax.top_k(logits, top_k)
+    w_top = jax.nn.softmax(top_vals, axis=-1)
+    return jnp.zeros_like(logits).at[
+        jnp.arange(t.shape[0])[:, None], top_idx
+    ].set(w_top)
+
+
 def _moe_block(x: jnp.ndarray, lp: Params, cfg: ModelConfig) -> jnp.ndarray:
     """Mixtral-style top-k routed MoE MLP. x: [B, S, H].
 
@@ -285,17 +302,7 @@ def _moe_block(x: jnp.ndarray, lp: Params, cfg: ModelConfig) -> jnp.ndarray:
     """
     b, s, h = x.shape
     t = x.reshape(b * s, h)
-    logits = jnp.einsum(
-        "th,he->te", t, lp["router"], preferred_element_type=jnp.float32
-    )
-    # exactly k experts per token (HF MixtralSparseMoeBlock semantics):
-    # softmax over the selected logits, scattered back — a >=threshold
-    # mask would activate extra experts on k-th-place ties
-    top_vals, top_idx = jax.lax.top_k(logits, cfg.num_experts_per_tok)
-    w_top = jax.nn.softmax(top_vals, axis=-1)
-    w = jnp.zeros_like(logits).at[
-        jnp.arange(t.shape[0])[:, None], top_idx
-    ].set(w_top)  # [T, E] f32
+    w = _routing_weights(t, lp["router"], cfg.num_experts_per_tok)
     g = jnp.einsum("th,ehf->tef", t, _w(lp, "wg", t.dtype))
     u = jnp.einsum("th,ehf->tef", t, _w(lp, "wu", t.dtype))
     y = jnp.einsum("tef,efh->teh", jax.nn.silu(g) * u, _w(lp, "wd", t.dtype))

@@ -70,9 +70,15 @@ def quantize_array(w: jnp.ndarray, contract_axes) -> QTensor:
 
 
 def dequantize(w: Any, dtype) -> jnp.ndarray:
-    """QTensor -> dense (fused into the consuming matmul under jit)."""
+    """QTensor -> dense (fused into the consuming matmul under jit).
+
+    The multiply keeps the scale in f32 (int8->dtype is exact for |q|<=127;
+    dtype*f32 promotes to f32) and rounds ONCE at the end — casting the
+    scale to bf16 first would re-add the rounding error f32 scale storage
+    exists to avoid.
+    """
     if isinstance(w, QTensor):
-        return (w.q.astype(dtype) * w.s.astype(dtype)).astype(dtype)
+        return (w.q.astype(dtype) * w.s).astype(dtype)
     return w
 
 

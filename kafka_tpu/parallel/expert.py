@@ -49,15 +49,11 @@ def init_moe_params(
 
 
 def _routing_weights(x: jnp.ndarray, router: jnp.ndarray, top_k: int):
-    """Per-token expert weights [T, E]: softmax over EXACTLY the top-k
-    logits (scatter of the selected softmax; a >=threshold mask would
-    activate extra experts on k-th-place ties — HF Mixtral picks k)."""
-    logits = jnp.einsum("th,he->te", x, router)
-    top_vals, top_idx = lax.top_k(logits, top_k)
-    w_top = jax.nn.softmax(top_vals, axis=-1)
-    return jnp.zeros_like(logits).at[
-        jnp.arange(x.shape[0])[:, None], top_idx
-    ].set(w_top)
+    """Canonical exact-top-k routing lives in models/llama.py (the served
+    model); reused here so the two cannot drift."""
+    from ..models.llama import _routing_weights as impl
+
+    return impl(x, router, top_k)
 
 
 def moe_mlp_reference(x: jnp.ndarray, params: Params, top_k: int = 2):

@@ -93,12 +93,13 @@ class EngineConfig:
     max_pages_per_seq: int = 16  # attention window = this * page_size
     prefill_buckets: Tuple[int, ...] = (16, 32, 64, 128, 256, 512)
     max_new_tokens_default: int = 512
-    # In-flight token fetches tolerated before the host blocks on the oldest.
+    # In-flight DEVICE STEPS tolerated in the fetch pipeline before the
+    # host force-pops the oldest entry (a fused k-step dispatch counts k).
     # Sized so fetch_lag * step_time exceeds the device->host round trip
-    # even when the link's RTT spikes — then every blocking read finds its
-    # transfer already complete.  On fast links the fetch_wait_s age bound
-    # pops entries long before this depth, so a generous value costs
-    # nothing there while keeping tunneled TPUs out of the blocking regime.
+    # even when the link's RTT spikes — then every forced read finds its
+    # transfer already complete.  On fast links the age/landed bounds pop
+    # entries long before this depth, so a generous value costs nothing
+    # there while keeping tunneled TPUs out of the blocking regime.
     fetch_lag: int = 96
     # Also pop a fetch once it has been in flight this long (seconds) —
     # bounds token latency when the pipeline fills slower than fetch_lag
@@ -223,6 +224,10 @@ class _Fetch:
     final: List[List[Optional[str]]]  # [steps][lanes] finish reasons
     t0: float = 0.0  # dispatch time (fetch_wait_s aging)
     steps: int = 1
+    # first time device compute was observed complete (is_ready); the
+    # async host copy starts at compute completion and lands ~RTT later —
+    # t_ready + rtt_est is when popping becomes non-blocking
+    t_ready: Optional[float] = None
 
 
 class InferenceEngine:
@@ -398,6 +403,10 @@ class InferenceEngine:
         self._d_temps = self._d_top_ks = self._d_top_ps = self._d_seeds = None
         self._ctl_dirty = True
         self._pending: List[_Fetch] = []
+        # device steps represented by _pending (fused entries count k):
+        # the fetch_lag depth bound is in STEPS, so multi-step dispatch
+        # doesn't multiply the emission runway by k
+        self._pending_steps = 0
         # In-flight constrained micro-batch fetch (at most one): constrained
         # lanes redispatch only after it matures, so their masks always see
         # complete output_ids while unconstrained lanes stay pipelined.
@@ -443,17 +452,25 @@ class InferenceEngine:
         The Pallas kernel needs: a real TPU (it runs in slow interpret mode
         anywhere else), no multi-device mesh (GSPMD cannot partition a
         custom call — the TP path keeps the XLA formulation), a merged KV
-        row that is lane-tile aligned (Hkv*D % 128), and page rows aligned
-        to the bf16 sublane tile (page_size % 16).
+        row that is lane-tile aligned (Hkv*D % 128), page rows aligned
+        to the bf16 sublane tile (page_size % 16), and head geometry whose
+        kernel intermediates fit scoped VMEM: the flash-prefill kernel
+        stacks a [Hq*D, Hkv*D]-shaped bf16 working set, which at
+        Llama-3-8B geometry (4096 x 1024) measured 19.5 MB against the
+        16 MB v5e limit — past ~7 MB for that product, resolve to the XLA
+        formulation (3B at 3072 x 1024 = 6.3 MB compiles and runs).
         """
         choice = ecfg.attention_backend
         if choice != "auto":
             return choice
+        merged_q = cfg.num_heads * cfg.head_dim
+        merged_kv = cfg.num_kv_heads * cfg.head_dim
         ok = (
             jax.default_backend() == "tpu"
             and (mesh is None or mesh.size == 1)
-            and (cfg.num_kv_heads * cfg.head_dim) % 128 == 0
+            and merged_kv % 128 == 0
             and ecfg.page_size % 16 == 0
+            and merged_q * merged_kv * 2 <= 7 * 1024 * 1024
         )
         return "pallas" if ok else "xla"
 
@@ -817,14 +834,51 @@ class InferenceEngine:
         """
         emitted = 0
         wait = self._emit_wait()
+        self._stamp_ready()
         while self._pending:
             if not block:
-                aged = time.monotonic() - self._pending[0].t0 >= wait
-                if len(self._pending) <= self.ecfg.fetch_lag and not aged:
+                entry = self._pending[0]
+                within_lag = self._pending_steps <= self.ecfg.fetch_lag
+                now = time.monotonic()
+                aged = now - entry.t0 >= wait
+                if within_lag and not aged:
                     break
-            emitted += self._process_entry(self._pending.pop(0))
+                # Aged is necessary but not sufficient: the host dispatch
+                # loop runs several entries ahead of device execution, so
+                # an aged entry may not have EXECUTED yet — and even once
+                # compute finishes, the async host copy lands ~RTT later.
+                # Popping earlier blocks the single scheduler thread on
+                # the device backlog + transfer, freezing admissions/
+                # retirement/prefill while the batch churns (measured:
+                # 1.3s emission gaps and a halved concurrent-turnover
+                # rate when tunnel RTT rose).  Pop only once the entry
+                # has been observed compute-done for ~an RTT (the copy
+                # has landed; np.asarray is then free); the fetch_lag
+                # depth bound still force-pops as the memory backstop.
+                if within_lag and (
+                    entry.t_ready is None
+                    or now - entry.t_ready < self._rtt_est
+                ):
+                    break
+            popped = self._pending.pop(0)
+            self._pending_steps -= popped.steps
+            emitted += self._process_entry(popped)
         if emitted:
             self.metrics.record_emit_burst(emitted)
+
+    def _push_entry(self, entry: _Fetch) -> None:
+        self._pending.append(entry)
+        self._pending_steps += entry.steps
+
+    def _stamp_ready(self) -> None:
+        """Record compute-completion times for the leading in-flight
+        fetches (is_ready is a cheap non-blocking probe)."""
+        now = time.monotonic()
+        for e in self._pending[:8]:
+            if e.t_ready is None and getattr(
+                e.arr, "is_ready", lambda: True
+            )():
+                e.t_ready = now
 
     def _rtt_age_bound(self) -> float:
         """Age at which an in-flight fetch's transfer has presumably landed
@@ -854,6 +908,7 @@ class InferenceEngine:
         constrained micro-batch, whose lanes appear in no other entries).
         """
         self._pending.remove(entry)
+        self._pending_steps -= entry.steps
         n = self._process_entry(entry)
         if n:
             self.metrics.record_emit_burst(n)
@@ -1256,7 +1311,7 @@ class InferenceEngine:
             finals_row[i] = fin
         if any(m is not None for m in items):
             toks.copy_to_host_async()
-            self._pending.append(_Fetch(
+            self._push_entry(_Fetch(
                 arr=toks, items=items, final=[finals_row],
                 t0=time.monotonic(),
             ))
@@ -1326,7 +1381,7 @@ class InferenceEngine:
         tok.copy_to_host_async()
         entry = _Fetch(arr=tok, items=[req], final=[[final]],
                        t0=time.monotonic())
-        self._pending.append(entry)
+        self._push_entry(entry)
         if final is not None:
             self._to_draining(req)
         if req.logits_mask_fn is not None:
@@ -1438,9 +1493,16 @@ class InferenceEngine:
             # age bound already covers).  With no unconstrained lanes
             # nobody is stalled by blocking, so fetch immediately.
             entry = self._constrained_fetch
-            aged = time.monotonic() - entry.t0 >= self._rtt_age_bound()
-            ready = getattr(entry.arr, "is_ready", lambda: True)()
-            if (aged and ready) or not n_uncon:
+            now = time.monotonic()
+            if entry.t_ready is None and getattr(
+                entry.arr, "is_ready", lambda: True
+            )():
+                entry.t_ready = now
+            landed = (
+                entry.t_ready is not None
+                and now - entry.t_ready >= self._rtt_est
+            )
+            if landed or not n_uncon:
                 self._pop_entry_now(entry)
                 self._constrained_fetch = None
         n_con = 0
@@ -1603,7 +1665,7 @@ class InferenceEngine:
         finals = [[None] * len(items) for _ in range(steps - 1)] + [last_final]
         entry = _Fetch(arr=toks, items=items, final=finals,
                        t0=time.monotonic(), steps=steps)
-        self._pending.append(entry)
+        self._push_entry(entry)
         for req, fin in zip(members, last_final):
             if req is not None and fin is not None:
                 self._to_draining(req)

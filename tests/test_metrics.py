@@ -88,18 +88,27 @@ class TestEngineRecording:
                          fetch_wait_s=10.0),  # absurd cap: adaptivity must win
             kv_dtype=jnp.float32,
         )
-        eng.generate(list(range(1, 9)), max_new_tokens=40)
-        snap = eng.metrics.snapshot(eng)
         # without the adaptive bound every token would arrive in ONE
         # 40-token burst at the end (fetch_wait_s=10s, fetch_lag=96); with
-        # it the typical pop is a single token across many emission events
-        # (an occasional multi-token pop after a host hiccup is fine)
-        # non-adaptive behavior would be exactly two bursts: [1, 39].
-        # Loose bounds: on a loaded host an aged-but-unlanded fetch blocks,
-        # during which more entries age and pop together as a larger burst.
-        assert len(eng.metrics.burst_tokens) >= 3
-        assert max(eng.metrics.burst_tokens) <= 30
-        assert snap["emission"]["burst_gap_ms"]["p50"] < 100
+        # it the typical pop is a single token across many emission events.
+        # Non-adaptive behavior would be exactly two bursts: [1, 39].
+        # Timing-sensitive on a loaded host (a hiccup groups tokens into a
+        # larger burst), so allow a few attempts — non-adaptive code fails
+        # ALL of them deterministically.
+        from kafka_tpu.runtime.metrics import EngineMetrics
+
+        last = None
+        for _ in range(3):
+            eng.metrics = EngineMetrics()
+            eng.generate(list(range(1, 9)), max_new_tokens=40)
+            snap = eng.metrics.snapshot(eng)
+            last = (len(eng.metrics.burst_tokens),
+                    max(eng.metrics.burst_tokens),
+                    snap["emission"]["burst_gap_ms"]["p50"])
+            if last[0] >= 3 and last[1] <= 30 and last[2] < 100:
+                break
+        else:
+            raise AssertionError(f"emission stayed bursty: {last}")
 
     def test_emit_wait_tightens_only_when_quiet(self, engine):
         """The adaptive age bound applies at <=2 active streams and must
