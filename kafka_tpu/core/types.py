@@ -48,10 +48,22 @@ class Message:
     # Opaque provider metadata carried through unmodified (the analog of the
     # reference's Gemini `thought_signature` passthrough, portkey.py:381-417).
     metadata: Optional[Dict[str, Any]] = None
+    # Unknown TOP-LEVEL keys round-tripped verbatim: foreign providers put
+    # opaque fields directly on the message (e.g. `thought_signature`,
+    # portkey.py:282-287); dict -> Message -> dict must not strip them or
+    # a passthrough deployment silently loses provider state across turns.
+    extra: Optional[Dict[str, Any]] = None
+
+    _KNOWN = ("role", "content", "name", "tool_calls", "tool_call_id",
+              "metadata")
 
     def to_dict(self) -> Dict[str, Any]:
         """OpenAI-wire dict, omitting None fields (APIs reject nulls)."""
         d: Dict[str, Any] = {"role": self.role}
+        if self.extra:
+            for k, v in self.extra.items():
+                if k not in self._KNOWN:
+                    d[k] = v
         if self.content is not None:
             d["content"] = self.content
         if self.name is not None:
@@ -66,6 +78,7 @@ class Message:
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "Message":
+        extra = {k: v for k, v in d.items() if k not in cls._KNOWN}
         return cls(
             role=d["role"],
             content=d.get("content"),
@@ -73,6 +86,7 @@ class Message:
             tool_calls=d.get("tool_calls"),
             tool_call_id=d.get("tool_call_id"),
             metadata=d.get("metadata"),
+            extra=extra or None,
         )
 
     def text(self) -> str:

@@ -15,7 +15,15 @@ from pydantic import BaseModel, Field
 
 
 class ChatMessage(BaseModel):
-    """OpenAI-compatible message in requests."""
+    """OpenAI-compatible message in requests.
+
+    extra="allow": opaque provider fields placed directly on a message
+    (the reference's Gemini `thought_signature`, portkey.py:282-287) must
+    survive request parsing — Message.from_dict/to_dict round-trips them
+    and the thread store persists them.
+    """
+
+    model_config = {"extra": "allow"}
 
     role: str
     content: Optional[Union[str, List[Dict[str, Any]]]] = None

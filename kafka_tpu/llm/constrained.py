@@ -680,12 +680,21 @@ class TokenIndex:
 
     def __init__(self, tokenizer) -> None:
         self.vocab_size = tokenizer.vocab_size
+        # tokenizers may pad their id space past the real token set
+        # (ByteTokenizer.mask_vocab_size); padding ids are not grammar
+        # tokens — indexing them would turn forced characters into fake
+        # multi-option masks and break singleton-chained dispatch
+        index_limit = min(
+            self.vocab_size,
+            getattr(tokenizer, "mask_vocab_size", self.vocab_size),
+        )
         texts: List[str] = []
-        for i in range(self.vocab_size):
+        for i in range(index_limit):
             try:
                 texts.append(tokenizer.decode([i]))
             except Exception:
                 texts.append("")
+        texts.extend("" for _ in range(self.vocab_size - index_limit))
         self.texts = texts
         self.buckets: Dict[str, List[int]] = {}
         safe: List[int] = []

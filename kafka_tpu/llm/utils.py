@@ -3,7 +3,10 @@
 Parity targets from the reference's provider utils (src/llm/utils.py):
 model→provider routing heuristic (:11-29) and image pruning to the newest
 N images (:85-130).  Message normalization for Gemini-style providers
-(:32-82) is irrelevant to a local engine and intentionally absent.
+(:32-82) is irrelevant to a local engine and intentionally absent; the
+related opaque-field passthrough (thought_signature, portkey.py:282-287)
+IS preserved — unknown top-level message keys round-trip through
+core.types.Message.extra and the thread store.
 """
 
 from __future__ import annotations

@@ -180,6 +180,11 @@ class ByteTokenizer(BaseTokenizer):
         self.stop_ids = (self.eos_id, self.eot_id)
         base = 256 + len(self.SPECIALS)
         self.vocab_size = max(base, vocab_size or 0)
+        # Constrained decoding indexes only REAL tokens: filler ids decode
+        # to arbitrary letters, and letting thousands of them satisfy a
+        # grammar-forced character would turn every singleton mask into a
+        # fake choice point (defeating forced-token chaining).
+        self.mask_vocab_size = base
 
     def encode(self, text: str) -> List[int]:
         ids: List[int] = []

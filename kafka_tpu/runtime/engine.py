@@ -179,6 +179,12 @@ class GenRequest:
     prefill_ids: List[int] = dataclasses.field(default_factory=list)
     # constrained decoding: fn(output_ids) -> allowed token id list or None
     logits_mask_fn: Optional[Callable[[List[int]], Optional[List[int]]]] = None
+    # Singleton-mask chaining: tokens already dispatched whose value is
+    # grammar-FORCED (mask of exactly one id — masked sampling must return
+    # it), not yet drained.  Masks for later positions build on
+    # output_ids + predicted, so forced runs of tool-call JSON dispatch at
+    # scheduler cadence instead of one token per device->host round trip.
+    predicted: List[int] = dataclasses.field(default_factory=list)
     # device-resident constrained mask for the in-progress prefill (built
     # once at prefill start; the mask depends only on output_ids, constant
     # across chunks)
@@ -504,7 +510,8 @@ class InferenceEngine:
         ps, C, B = ecfg.page_size, ecfg.max_window, ecfg.max_batch
 
         def body(params, k_pool, v_pool, page_table, last_tokens, seq_lens,
-                 active, temps, top_ks, top_ps, seeds, allowed_mask):
+                 active, temps, top_ks, top_ps, seeds, allowed_mask,
+                 forced_tok=None, forced_on=None):
             positions = seq_lens[:, None]
             write_page = page_table[jnp.arange(B), seq_lens // ps]
             write_idx = (write_page * ps + seq_lens % ps)[:, None]
@@ -540,6 +547,11 @@ class InferenceEngine:
             toks = sample_tokens_per_slot(
                 logits, SamplingParams(temps, top_ks, top_ps), keys, allowed_mask
             )
+            if forced_tok is not None:
+                # grammar-forced lanes: the next token is host-known
+                # (singleton mask) — overriding the sample here replaces a
+                # [B, V] mask upload per chained dispatch with a [B] int32
+                toks = jnp.where(forced_on, forced_tok, toks)
             next_lens = seq_lens + active.astype(jnp.int32)
             return cache.k, cache.v, toks, next_lens
 
@@ -904,12 +916,29 @@ class InferenceEngine:
         """Take one entry out of the FIFO and process it immediately.
 
         Safe out of FIFO order only when the entry's requests have no older
-        in-flight entries (true for a just-admitted prefill and for the
-        constrained micro-batch, whose lanes appear in no other entries).
+        in-flight entries (true for a just-admitted prefill, whose request
+        appears in no earlier entry).
         """
         self._pending.remove(entry)
         self._pending_steps -= entry.steps
         n = self._process_entry(entry)
+        if n:
+            self.metrics.record_emit_burst(n)
+
+    def _pop_through(self, entry: _Fetch) -> None:
+        """Process pending entries in FIFO order up to AND including
+        `entry`.  Per-request token order must hold: with singleton-mask
+        chaining a constrained lane appears in several in-flight entries,
+        so popping its latest fetch ahead of its older ones would emit its
+        tokens out of order (and trip prediction reconciliation).
+        """
+        n = 0
+        while self._pending:
+            e = self._pending.pop(0)
+            self._pending_steps -= e.steps
+            n += self._process_entry(e)
+            if e is entry:
+                break
         if n:
             self.metrics.record_emit_burst(n)
 
@@ -955,6 +984,13 @@ class InferenceEngine:
     def _process_token(self, req: GenRequest, token: int,
                        final_reason: Optional[str]) -> None:
         req.drained += 1
+        if req.predicted:
+            # singleton-mask chain reconciliation: the dispatch ran with a
+            # one-id mask, so the sampled value is exactly the prediction
+            expected = req.predicted.pop(0)
+            assert expected == token, (
+                f"constrained prediction diverged: {expected} != {token}"
+            )
         req.output_ids.append(token)
         if req.first_token_time is None:
             req.first_token_time = time.monotonic()
@@ -1503,27 +1539,104 @@ class InferenceEngine:
                 and now - entry.t_ready >= self._rtt_est
             )
             if landed or not n_uncon:
-                self._pop_entry_now(entry)
+                self._pop_through(entry)
                 self._constrained_fetch = None
-        n_con = 0
-        if not self._constrained_inflight():
-            con = [
-                s if (s is not None and s.state == ACTIVE
-                      and s.logits_mask_fn is not None) else None
-                for s in self.slots
-            ]
-            n_con = sum(1 for m in con if m is not None)
-            if n_con:
-                allowed = self._build_allowed_mask()
-                d_act = self._dev(np.array([m is not None for m in con]))
-                self._constrained_fetch = self._dispatch_group(
-                    con, d_act, allowed, full=False
+        # Per-lane partition: lanes whose NEXT token is grammar-FORCED
+        # (singleton mask over output_ids + predicted — ~97% of tool-call
+        # JSON: braces, quotes, key names) have a host-known value, so
+        # they dispatch every scheduler iteration as a chained group
+        # without awaiting a device->host round trip; only lanes at a
+        # genuine choice point join the awaited micro-batch.  Lanes inside
+        # the still-in-flight awaited fetch sit out this iteration (their
+        # next mask needs that token).
+        awaiting = (
+            {id(r) for r in self._constrained_fetch.items if r is not None}
+            if self._constrained_inflight() else set()
+        )
+        V = self.cfg.vocab_size
+        B = self.ecfg.max_batch
+        chain_m: List[Optional[GenRequest]] = []
+        amb_m: List[Optional[GenRequest]] = []
+        amb_masks: Dict[int, Optional[np.ndarray]] = {}  # slot -> row
+        chain_toks: List[Tuple[GenRequest, int]] = []
+        forced_tok = np.zeros(B, np.int32)
+        forced_on = np.zeros(B, bool)
+        n_chain = n_amb = 0
+        for slot_i, s in enumerate(self.slots):
+            c_req = a_req = None
+            if (
+                s is not None and s.state == ACTIVE
+                and s.logits_mask_fn is not None
+                and id(s) not in awaiting
+                # a forced stop token means the lane is logically finished
+                # and retires when its fetch drains: stop dispatching, and
+                # never call the mask fn past the grammar's end
+                and not any(t in s.stop_token_ids for t in s.predicted)
+            ):
+                try:
+                    allowed = s.logits_mask_fn(s.output_ids + s.predicted)
+                except Exception:
+                    # a user mask fn must not kill the engine thread (a
+                    # step-loop exception fails EVERY in-flight request);
+                    # degrade the lane to unconstrained for this step
+                    logger.exception(
+                        "logits_mask_fn failed for %s; treating step as "
+                        "unconstrained", s.request_id,
+                    )
+                    allowed = None
+                ids = (
+                    self._in_vocab(allowed) if allowed is not None else None
                 )
-        if n_uncon or n_con:
+                if ids is not None and len(ids) == 1:
+                    c_req = s
+                    forced_tok[slot_i] = int(ids[0])
+                    forced_on[slot_i] = True
+                    chain_toks.append((s, int(ids[0])))
+                    n_chain += 1
+                else:
+                    a_req = s
+                    if ids is not None:
+                        # len 0 (fully clipped) builds an all-False row:
+                        # the sampler's fully-masked fallback decides, the
+                        # same semantics as the prefill mask path
+                        row = np.zeros(V, bool)
+                        row[ids] = True
+                        amb_masks[slot_i] = row
+                    else:
+                        amb_masks[slot_i] = None  # free step
+                    n_amb += 1
+            chain_m.append(c_req)
+            amb_m.append(a_req)
+        if n_chain:
+            d_act = self._dev(np.array([m is not None for m in chain_m]))
+            # no [B, V] mask: the known token overrides the sample on
+            # device, so the upload is two [B] vectors
+            self._dispatch_group(chain_m, d_act, None, full=False,
+                                 forced=(forced_tok, forced_on))
+            for req, tok in chain_toks:
+                if req.state in (ACTIVE, DRAINING):
+                    req.predicted.append(tok)
+        n_amb_dispatched = 0
+        if n_amb and not self._constrained_inflight():
+            # rows materialize only when actually dispatching (a pure
+            # forced chain must not allocate B x V bools per iteration)
+            amb_rows = [
+                amb_masks.get(i) if amb_masks.get(i) is not None
+                else np.ones(V, bool)
+                for i in range(B)
+            ]
+            d_act = self._dev(np.array([m is not None for m in amb_m]))
+            self._constrained_fetch = self._dispatch_group(
+                amb_m, d_act, np.stack(amb_rows), full=False
+            )
+            n_amb_dispatched = n_amb
+        if n_uncon or n_chain or n_amb_dispatched:
             # one scheduler iteration = one TPOT sample / occupancy record,
             # however many dispatch groups it took (group dispatches land
             # microseconds apart and are not per-token latency)
-            self.metrics.record_decode_step(n_uncon + n_con)
+            self.metrics.record_decode_step(
+                n_uncon + n_chain + n_amb_dispatched
+            )
 
     def _pick_multi_step(self, active_slots: List[GenRequest]) -> int:
         """How many decode steps to fuse into the next dispatch.
@@ -1619,20 +1732,33 @@ class InferenceEngine:
         d_active: jnp.ndarray,
         allowed: Optional[np.ndarray],
         full: bool,
+        forced: Optional[Tuple[np.ndarray, np.ndarray]] = None,
     ) -> _Fetch:
         """Dispatch one decode for the lanes in `members` (slot-aligned;
         None = not in this group).  Lanes outside the group are masked
         inactive for this call: their KV writes go to the trash page, their
         seq_lens don't advance, and their device last-token lanes keep their
-        previous value via the where-merge below.
+        previous value via the where-merge below.  `forced` = ([B] int32
+        tokens, [B] bool on-mask): grammar-forced lanes whose sampled token
+        is overridden device-side (no [B, V] mask upload).
         """
-        self.k_pool, self.v_pool, toks, self._d_seq_lens = self._decode_fn(
-            self.params, self.k_pool, self.v_pool,
-            self._d_table, self._d_last, self._d_seq_lens,
-            d_active, self._d_temps, self._d_top_ks,
-            self._d_top_ps, self._d_seeds,
-            None if allowed is None else self._arg(allowed),
-        )
+        if forced is None:
+            self.k_pool, self.v_pool, toks, self._d_seq_lens = self._decode_fn(
+                self.params, self.k_pool, self.v_pool,
+                self._d_table, self._d_last, self._d_seq_lens,
+                d_active, self._d_temps, self._d_top_ks,
+                self._d_top_ps, self._d_seeds,
+                None if allowed is None else self._arg(allowed),
+            )
+        else:
+            self.k_pool, self.v_pool, toks, self._d_seq_lens = self._decode_fn(
+                self.params, self.k_pool, self.v_pool,
+                self._d_table, self._d_last, self._d_seq_lens,
+                d_active, self._d_temps, self._d_top_ks,
+                self._d_top_ps, self._d_seeds,
+                None if allowed is None else self._arg(allowed),
+                self._arg(forced[0]), self._arg(forced[1]),
+            )
         self._d_last = toks if full else jnp.where(d_active, toks, self._d_last)
         return self._book_dispatch(toks, members, steps=1)
 
@@ -1765,36 +1891,6 @@ class InferenceEngine:
         """
         ids = np.asarray(allowed_ids, np.int64)
         return ids[(ids >= 0) & (ids < self.cfg.vocab_size)]
-
-    def _build_allowed_mask(self) -> Optional[np.ndarray]:
-        """Batched constrained-decoding mask, if any slot constrains.
-
-        Fast path first: in the common unconstrained case nothing is
-        allocated on the per-token hot path.
-        """
-        if not any(
-            s is not None and s.state == ACTIVE
-            and s.logits_mask_fn is not None
-            for s in self.slots
-        ):
-            return None
-        V = self.cfg.vocab_size
-        rows = []
-        any_mask = False
-        for s in self.slots:
-            if (s is not None and s.state == ACTIVE
-                    and s.logits_mask_fn is not None):
-                allowed = s.logits_mask_fn(s.output_ids)
-                if allowed is not None:
-                    row = np.zeros(V, bool)
-                    row[self._in_vocab(allowed)] = True
-                    rows.append(row)
-                    any_mask = True
-                    continue
-            rows.append(np.ones(V, bool))
-        if not any_mask:
-            return None
-        return np.stack(rows)
 
     def _release_slot(self, req: GenRequest) -> None:
         """Free a request's batch slot and pages (it may keep draining).

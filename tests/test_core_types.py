@@ -25,6 +25,20 @@ class TestMessage:
         m = Message(role="user", content="hi")
         assert m.to_dict() == {"role": "user", "content": "hi"}
 
+    def test_opaque_provider_fields_round_trip(self):
+        """VERDICT r3 missing #3: unknown top-level keys (the reference's
+        Gemini thought_signature, portkey.py:282-287) survive
+        dict -> Message -> dict unchanged."""
+        d = {"role": "assistant", "content": "ok",
+             "thought_signature": "sig-abc", "provider_state": {"k": 1}}
+        out = Message.from_dict(d).to_dict()
+        assert out["thought_signature"] == "sig-abc"
+        assert out["provider_state"] == {"k": 1}
+        # known keys cannot be shadowed by extras
+        m = Message.from_dict(d)
+        m.extra["role"] = "hacker"
+        assert Message.to_dict(m)["role"] == "assistant"
+
     def test_roundtrip(self):
         m = Message(role="assistant", content=None, tool_calls=[tc("a")])
         m2 = Message.from_dict(m.to_dict())

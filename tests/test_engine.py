@@ -541,3 +541,108 @@ class TestOffSlotAdmission:
         eng.run_to_completion()
         for r in reqs:
             assert len(r.output_ids) == 8
+
+
+class TestConstrainedChaining:
+    """Singleton-mask chaining: grammar-forced tokens dispatch at
+    scheduler cadence instead of one device->host round trip each (the
+    dominant cost of constrained tool-call JSON on high-RTT links)."""
+
+    def test_forced_sequence_chains_without_blocking_pops(self, model):
+        cfg, params = model
+        eng = make_engine(cfg, params, max_batch=2)
+        seq = [9, 23, 54, 3, 17, 88, 4, 61, 12, 7, 33, 90]
+
+        def mask_fn(out):
+            return [seq[len(out)]] if len(out) < len(seq) else [2]
+
+        pops = []
+        orig = eng._pop_entry_now
+        eng._pop_entry_now = lambda e: (pops.append(1), orig(e))[1]
+        req = GenRequest(request_id="chain", prompt_ids=[5, 2, 9],
+                         max_new_tokens=len(seq) + 1,
+                         logits_mask_fn=mask_fn)
+        eng.submit(req)
+        done = eng.run_to_completion()
+        assert done["chain"].output_ids == seq + [2]
+        # the prefill's synchronous pop is expected; the forced decode run
+        # must NOT have popped per token (13 tokens -> <= a few pops)
+        assert len(pops) <= 3, f"{len(pops)} blocking pops for forced run"
+
+    def test_mixed_forced_and_free_steps_still_correct(self, model):
+        cfg, params = model
+        eng = make_engine(cfg, params, max_batch=2)
+        forced_prefix = [11, 45, 2]
+
+        def mask_fn(out):
+            if len(out) < len(forced_prefix):
+                return [forced_prefix[len(out)]]
+            return None  # free generation afterwards
+
+        req = GenRequest(request_id="mix", prompt_ids=[7, 3],
+                         max_new_tokens=8, logits_mask_fn=mask_fn)
+        eng.submit(req)
+        done = eng.run_to_completion()
+        out = done["mix"].output_ids
+        assert out[:3] == forced_prefix and len(out) == 8
+        # the free tail must be the model's real greedy continuation
+        assert_greedy_consistent(cfg, params, [7, 3] + forced_prefix,
+                                 out[3:])
+
+    def test_chained_alongside_unconstrained_lane(self, model):
+        cfg, params = model
+        eng = make_engine(cfg, params, max_batch=2)
+        seq = [8, 19, 42, 5, 77, 1]
+
+        def mask_fn(out):
+            return [seq[len(out)]] if len(out) < len(seq) else [2]
+
+        free = GenRequest(request_id="free", prompt_ids=[1, 9, 23],
+                          max_new_tokens=12)
+        conq = GenRequest(request_id="con", prompt_ids=[5, 2, 9],
+                          max_new_tokens=len(seq) + 1,
+                          logits_mask_fn=mask_fn)
+        eng.submit(free)
+        eng.submit(conq)
+        done = eng.run_to_completion()
+        assert done["con"].output_ids == seq + [2]
+        assert_greedy_consistent(cfg, params, [1, 9, 23],
+                                 done["free"].output_ids)
+
+    def test_forced_stop_token_ends_chain_without_mask_overrun(self, model):
+        """A grammar whose table ends at the stop token must not be called
+        past its end (the chain stops at a predicted stop token), and a
+        mask fn that DOES get called out of range must degrade the step,
+        not kill the engine thread."""
+        cfg, params = model
+        eng = make_engine(cfg, params, max_batch=2)
+        seq = [9, 23, 54, 99]
+
+        def mask_fn(out):
+            return [seq[len(out)]]  # IndexError if called past the end
+
+        req = GenRequest(request_id="stop-chain", prompt_ids=[5, 2],
+                         max_new_tokens=20, stop_token_ids=(99,),
+                         logits_mask_fn=mask_fn)
+        eng.submit(req)
+        done = eng.run_to_completion()
+        assert done["stop-chain"].output_ids == seq
+        assert done["stop-chain"].finish_reason == "stop"
+
+    def test_exhausted_mask_table_degrades_not_crashes(self, model):
+        """Grammar ends but generation continues: the raising mask fn
+        degrades the lane to unconstrained instead of failing every
+        in-flight request."""
+        cfg, params = model
+        eng = make_engine(cfg, params, max_batch=2)
+        seq = [9, 23, 54]  # no stop token: generation outlives the table
+
+        def mask_fn(out):
+            return [seq[len(out)]]
+
+        req = GenRequest(request_id="exhaust", prompt_ids=[5, 2],
+                         max_new_tokens=8, logits_mask_fn=mask_fn)
+        eng.submit(req)
+        done = eng.run_to_completion()
+        out = done["exhaust"].output_ids
+        assert out[:3] == seq and len(out) == 8

@@ -162,6 +162,31 @@ class TestThreadCRUD:
 
         asyncio.run(go())
 
+    def test_opaque_fields_persist_through_thread_store(self, tmp_path):
+        """thought_signature-style opaque fields on an incoming message
+        survive request parsing, persistence, and replay (reference
+        portkey.py:282-287 passthrough)."""
+        built, llm, db = make_client(tmp_path, [text_turn("ok")])
+
+        async def go():
+            client = await built
+            try:
+                r = await client.post(
+                    "/v1/threads/t-opq/chat/completions",
+                    json={"model": "fake-model",
+                          "messages": [{"role": "user", "content": "hi",
+                                        "thought_signature": "sig-9"}]},
+                )
+                assert r.status == 200
+                r = await client.get("/v1/threads/t-opq/messages")
+                msgs = (await r.json())["messages"]
+            finally:
+                await client.close()
+            user = next(m for m in msgs if m["role"] == "user")
+            assert user.get("thought_signature") == "sig-9"
+
+        asyncio.run(go())
+
     def test_missing_thread_404(self, tmp_path):
         built, _, _ = make_client(tmp_path, [])
 
