@@ -676,7 +676,7 @@ def main() -> None:
     for b in [int(x) for x in args.batch_sweep.split(",") if x]:
         secfg = EngineConfig(
             max_batch=b, page_size=16,
-            max_pages_per_seq=max(2, -(-(args.prompt_len + 128 + 16) // 16)),
+            max_pages_per_seq=max(2, -(-(args.prompt_len + 256 + 16) // 16)),
         )
         secfg.num_pages = b * secfg.max_pages_per_seq + 1
         seng = InferenceEngine(cfg, params, secfg)
@@ -688,8 +688,11 @@ def main() -> None:
                                    max_new_tokens=secfg.multi_step + 4))
         seng.run_to_completion()
         log(f"batch {b} compile: {time.monotonic() - t0:.1f}s")
-        tps, sps = decode_phase(seng, cfg, b, args.prompt_len, 128, rng)
-        sb = hbm_traffic_per_step(seng, pbytes, b, args.prompt_len + 64)
+        # gen 256: short sweeps absorb the fixed ~RTT drain tail of the
+        # fetch pipeline into tok/s (measured: b16 varied 1.7-2.9k tok/s
+        # at gen 128 purely with tunnel RTT)
+        tps, sps = decode_phase(seng, cfg, b, args.prompt_len, 256, rng)
+        sb = hbm_traffic_per_step(seng, pbytes, b, args.prompt_len + 128)
         sweep[str(b)] = {
             "decode_tok_s": round(tps, 1),
             "steps_per_s": round(sps, 1),

@@ -185,6 +185,10 @@ class GenRequest:
     # output_ids + predicted, so forced runs of tool-call JSON dispatch at
     # scheduler cadence instead of one token per device->host round trip.
     predicted: List[int] = dataclasses.field(default_factory=list)
+    # (position, in-vocab allowed ids or None) memo for the position above:
+    # a lane blocked behind an in-flight awaited fetch must not re-run its
+    # mask fn (full automaton walk) every scheduler iteration
+    mask_cache: Optional[Tuple[int, Optional[Any]]] = None
     # device-resident constrained mask for the in-progress prefill (built
     # once at prefill start; the mask depends only on output_ids, constant
     # across chunks)
@@ -1557,7 +1561,7 @@ class InferenceEngine:
         B = self.ecfg.max_batch
         chain_m: List[Optional[GenRequest]] = []
         amb_m: List[Optional[GenRequest]] = []
-        amb_masks: Dict[int, Optional[np.ndarray]] = {}  # slot -> row
+        amb_ids: Dict[int, Optional[np.ndarray]] = {}  # slot -> allowed ids
         chain_toks: List[Tuple[GenRequest, int]] = []
         forced_tok = np.zeros(B, np.int32)
         forced_on = np.zeros(B, bool)
@@ -1573,20 +1577,30 @@ class InferenceEngine:
                 # never call the mask fn past the grammar's end
                 and not any(t in s.stop_token_ids for t in s.predicted)
             ):
-                try:
-                    allowed = s.logits_mask_fn(s.output_ids + s.predicted)
-                except Exception:
-                    # a user mask fn must not kill the engine thread (a
-                    # step-loop exception fails EVERY in-flight request);
-                    # degrade the lane to unconstrained for this step
-                    logger.exception(
-                        "logits_mask_fn failed for %s; treating step as "
-                        "unconstrained", s.request_id,
+                pos = len(s.output_ids) + len(s.predicted)
+                if s.mask_cache is not None and s.mask_cache[0] == pos:
+                    ids = s.mask_cache[1]  # blocked lane: no re-walk
+                else:
+                    try:
+                        allowed = s.logits_mask_fn(
+                            s.output_ids + s.predicted
+                        )
+                    except Exception:
+                        # a user mask fn must not kill the engine thread
+                        # (a step-loop exception fails EVERY in-flight
+                        # request); degrade the LANE to unconstrained —
+                        # once, not once per iteration
+                        logger.exception(
+                            "logits_mask_fn failed for %s; degrading the "
+                            "lane to unconstrained", s.request_id,
+                        )
+                        s.logits_mask_fn = None
+                        allowed = None
+                    ids = (
+                        self._in_vocab(allowed)
+                        if allowed is not None else None
                     )
-                    allowed = None
-                ids = (
-                    self._in_vocab(allowed) if allowed is not None else None
-                )
+                    s.mask_cache = (pos, ids)
                 if ids is not None and len(ids) == 1:
                     c_req = s
                     forced_tok[slot_i] = int(ids[0])
@@ -1595,15 +1609,7 @@ class InferenceEngine:
                     n_chain += 1
                 else:
                     a_req = s
-                    if ids is not None:
-                        # len 0 (fully clipped) builds an all-False row:
-                        # the sampler's fully-masked fallback decides, the
-                        # same semantics as the prefill mask path
-                        row = np.zeros(V, bool)
-                        row[ids] = True
-                        amb_masks[slot_i] = row
-                    else:
-                        amb_masks[slot_i] = None  # free step
+                    amb_ids[slot_i] = ids  # None = free step
                     n_amb += 1
             chain_m.append(c_req)
             amb_m.append(a_req)
@@ -1618,16 +1624,27 @@ class InferenceEngine:
                     req.predicted.append(tok)
         n_amb_dispatched = 0
         if n_amb and not self._constrained_inflight():
-            # rows materialize only when actually dispatching (a pure
-            # forced chain must not allocate B x V bools per iteration)
-            amb_rows = [
-                amb_masks.get(i) if amb_masks.get(i) is not None
-                else np.ones(V, bool)
-                for i in range(B)
-            ]
+            # Rows materialize only when actually dispatching, and only
+            # when some lane has a concrete mask (all-free steps skip the
+            # [B, V] build + upload entirely).  A lane's len-0 (fully
+            # clipped) id list builds an all-False row: the sampler's
+            # fully-masked fallback decides, the same semantics as the
+            # prefill mask path.
+            allowed_arr = None
+            if any(v is not None for v in amb_ids.values()):
+                rows = []
+                for i in range(B):
+                    ids = amb_ids.get(i)
+                    if ids is None:
+                        rows.append(np.ones(V, bool))
+                    else:
+                        row = np.zeros(V, bool)
+                        row[ids] = True
+                        rows.append(row)
+                allowed_arr = np.stack(rows)
             d_act = self._dev(np.array([m is not None for m in amb_m]))
             self._constrained_fetch = self._dispatch_group(
-                amb_m, d_act, np.stack(amb_rows), full=False
+                amb_m, d_act, allowed_arr, full=False
             )
             n_amb_dispatched = n_amb
         if n_uncon or n_chain or n_amb_dispatched:
