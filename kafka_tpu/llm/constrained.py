@@ -671,7 +671,6 @@ class ToolCallAutomaton:
 # tokenizer-level mask
 # ---------------------------------------------------------------------------
 
-_TOKEN_INDEX_CACHE: Dict[int, "TokenIndex"] = {}
 _TOKEN_INDEX_LOCK = __import__("threading").Lock()
 
 
@@ -696,6 +695,9 @@ class TokenIndex:
                 texts.append("")
         texts.extend("" for _ in range(self.vocab_size - index_limit))
         self.texts = texts
+        # longest decoded token: bounds forced_id's deterministic-run walk
+        # (a single-char tokenizer never probes past one character)
+        self.max_token_len = max((len(t) for t in texts), default=1)
         self.buckets: Dict[str, List[int]] = {}
         safe: List[int] = []
         for i, t in enumerate(texts):
@@ -713,16 +715,23 @@ class TokenIndex:
     def for_tokenizer(cls, tokenizer) -> "TokenIndex":
         """Cached build; the lock keeps a warmup thread and the first
         request from decoding the vocab twice (a 128k-vocab build is
-        seconds of work — see TokenIndex.warm)."""
-        key = id(tokenizer)
-        idx = _TOKEN_INDEX_CACHE.get(key)
+        seconds of work — see TokenIndex.warm).
+
+        The cache lives ON the tokenizer object: an id()-keyed dict can
+        hand a NEW tokenizer the index of a garbage-collected one whose
+        id the allocator reused (observed as a cross-test flake).
+        """
+        idx = getattr(tokenizer, "_token_index_cache", None)
         if idx is not None:
             return idx
         with _TOKEN_INDEX_LOCK:
-            idx = _TOKEN_INDEX_CACHE.get(key)
+            idx = getattr(tokenizer, "_token_index_cache", None)
             if idx is None:
                 idx = cls(tokenizer)
-                _TOKEN_INDEX_CACHE[key] = idx
+                try:
+                    tokenizer._token_index_cache = idx
+                except Exception:
+                    pass  # slotted/frozen tokenizer: rebuild per call
         return idx
 
     @classmethod
@@ -757,6 +766,10 @@ class ToolCallMaskFn:
         self._consumed = 0  # output_ids already fed (incremental)
         self._fed_text_len = 0
         self._max_tokens = max_tokens
+        # (text position, remaining deterministic run) memo: consecutive
+        # forced_id calls slice the already-derived run instead of
+        # re-probing ~98 chars per position (scheduler hot path)
+        self._run_cache: Tuple[int, str] = (-1, "")
 
     def set_budget(self, max_tokens: int) -> None:
         """Engine hook: the token budget after window clamping.  Near its
@@ -764,7 +777,9 @@ class ToolCallMaskFn:
         bounded generation still parses."""
         self._max_tokens = max_tokens
 
-    def __call__(self, output_ids: List[int]) -> Optional[List[int]]:
+    def _sync(self, output_ids: List[int]) -> bool:
+        """Advance the automaton to the given prefix (incremental).
+        Returns False when the prefix stopped validating (degrade)."""
         if self._consumed > len(output_ids):  # new attempt/rewind
             self._auto.reset()
             self._consumed = 0
@@ -776,16 +791,84 @@ class ToolCallMaskFn:
             if not self._auto.feed_text(delta):
                 # defensive: unconstrained prefix (shouldn't happen) —
                 # give up and stop constraining
-                return None
+                return False
             self._fed_text_len = len(text)
         self._consumed = len(output_ids)
-        if self._max_tokens is not None and not self._auto.done:
-            remaining = self._max_tokens - len(output_ids)
-            if remaining <= self._auto.min_close_chars() + self.WRAP_UP_SLACK:
-                wrapped = self._wrap_up_ids()
-                if wrapped:
-                    return wrapped
+        return True
+
+    def _wrapping_up(self, output_ids: List[int]) -> bool:
+        if self._max_tokens is None or self._auto.done:
+            return False
+        remaining = self._max_tokens - len(output_ids)
+        return remaining <= self._auto.min_close_chars() + self.WRAP_UP_SLACK
+
+    def __call__(self, output_ids: List[int]) -> Optional[List[int]]:
+        if not self._sync(output_ids):
+            return None
+        if self._wrapping_up(output_ids):
+            wrapped = self._wrap_up_ids()
+            if wrapped:
+                return wrapped
         return self._allowed_ids()
+
+    # how far ahead a deterministic text run is grown for forced_id; the
+    # canonical token picked is at most this many characters
+    MAX_FORCED_RUN = 24
+
+    def forced_id(self, output_ids: List[int]) -> Optional[int]:
+        """Engine chaining hook: a single canonical token id when the
+        grammar's next TEXT is deterministic, else None.
+
+        With subword tokenizers a forced text region ("name", '": "', key
+        names) admits many tokenizations, so the allowed-id mask is rarely
+        a singleton even though the model has no actual choice; the host
+        would then await a device round trip per token for nothing.  Here
+        the deterministic character run is grown from the automaton and
+        the LONGEST indexed token that prefixes it is returned — the
+        engine dispatches it without awaiting the previous fetch, and the
+        sampled token is overridden device-side.  Free-string content,
+        genuine choice points, and wrap-up mode return None (the masked
+        path decides).  For single-char tokenizers this returns exactly
+        the singleton the mask would have allowed.
+        """
+        if not self._sync(output_ids):
+            return None
+        auto = self._auto
+        if auto.done or auto.in_free_string:
+            return None
+        if self._wrapping_up(output_ids):
+            return None
+        cached_pos, cached_run = self._run_cache
+        if cached_pos == self._fed_text_len and cached_run:
+            run = cached_run
+        else:
+            c = auto.copy()
+            run = ""
+            limit = min(self.MAX_FORCED_RUN, self._index.max_token_len)
+            while len(run) < limit and not c.done:
+                legal: List[str] = []
+                for ch in PROBE_CHARS:
+                    if c.copy().feed(ch):
+                        legal.append(ch)
+                        if len(legal) > 1:
+                            break  # choice point: no need to finish
+                if len(legal) != 1:
+                    break
+                run += legal[0]
+                c.feed(legal[0])
+            if not run:
+                return None
+        best = None
+        best_len = 0
+        for tid in self._index.buckets.get(run[0], ()):
+            t = self._index.texts[tid]
+            if best_len < len(t) <= len(run) and run.startswith(t):
+                best, best_len = tid, len(t)
+        if best is not None:
+            self._run_cache = (
+                self._fed_text_len + best_len, run[best_len:]
+            )
+        return best
 
     def _allowed_ids(self) -> List[int]:
         auto, idx = self._auto, self._index

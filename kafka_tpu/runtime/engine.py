@@ -185,10 +185,11 @@ class GenRequest:
     # output_ids + predicted, so forced runs of tool-call JSON dispatch at
     # scheduler cadence instead of one token per device->host round trip.
     predicted: List[int] = dataclasses.field(default_factory=list)
-    # (position, in-vocab allowed ids or None) memo for the position above:
-    # a lane blocked behind an in-flight awaited fetch must not re-run its
-    # mask fn (full automaton walk) every scheduler iteration
-    mask_cache: Optional[Tuple[int, Optional[Any]]] = None
+    # (position, _next_constraint result) memo, where the result is one of
+    # ("forced", token_id) / ("ids", np array) / ("free", None): a lane
+    # blocked behind an in-flight awaited fetch must not re-run its mask
+    # fn (full automaton walk) every scheduler iteration
+    mask_cache: Optional[Tuple[int, Tuple[str, Any]]] = None
     # device-resident constrained mask for the in-progress prefill (built
     # once at prefill start; the mask depends only on output_ids, constant
     # across chunks)
@@ -1579,37 +1580,19 @@ class InferenceEngine:
             ):
                 pos = len(s.output_ids) + len(s.predicted)
                 if s.mask_cache is not None and s.mask_cache[0] == pos:
-                    ids = s.mask_cache[1]  # blocked lane: no re-walk
+                    kind, val = s.mask_cache[1]  # blocked lane: no re-walk
                 else:
-                    try:
-                        allowed = s.logits_mask_fn(
-                            s.output_ids + s.predicted
-                        )
-                    except Exception:
-                        # a user mask fn must not kill the engine thread
-                        # (a step-loop exception fails EVERY in-flight
-                        # request); degrade the LANE to unconstrained —
-                        # once, not once per iteration
-                        logger.exception(
-                            "logits_mask_fn failed for %s; degrading the "
-                            "lane to unconstrained", s.request_id,
-                        )
-                        s.logits_mask_fn = None
-                        allowed = None
-                    ids = (
-                        self._in_vocab(allowed)
-                        if allowed is not None else None
-                    )
-                    s.mask_cache = (pos, ids)
-                if ids is not None and len(ids) == 1:
+                    kind, val = self._next_constraint(s)
+                    s.mask_cache = (pos, (kind, val))
+                if kind == "forced":
                     c_req = s
-                    forced_tok[slot_i] = int(ids[0])
+                    forced_tok[slot_i] = val
                     forced_on[slot_i] = True
-                    chain_toks.append((s, int(ids[0])))
+                    chain_toks.append((s, val))
                     n_chain += 1
                 else:
                     a_req = s
-                    amb_ids[slot_i] = ids  # None = free step
+                    amb_ids[slot_i] = val  # None = free step
                     n_amb += 1
             chain_m.append(c_req)
             amb_m.append(a_req)
@@ -1897,6 +1880,40 @@ class InferenceEngine:
         self._d_seeds = self._dev(np.array(
             [s.seed if s else 0 for s in slots], np.uint32))
         self._ctl_dirty = False
+
+    def _next_constraint(self, s: GenRequest):
+        """Classify the next constrained step for a lane.
+
+        Returns ("forced", token_id) — the value is host-known and the
+        dispatch may chain without awaiting (grammar-forced: either the
+        mask fn's forced_id hook resolved a deterministic text run to one
+        canonical token, or the allowed list is a single id) — or
+        ("ids", np array) for a genuine choice point, or ("free", None)
+        for an unconstrained step.  A raising mask fn degrades the lane
+        to unconstrained permanently (one log line), never the engine
+        thread.
+        """
+        fn = s.logits_mask_fn
+        ctx = s.output_ids + s.predicted
+        try:
+            if hasattr(fn, "forced_id"):
+                fid = fn.forced_id(ctx)
+                if fid is not None and 0 <= int(fid) < self.cfg.vocab_size:
+                    return ("forced", int(fid))
+            allowed = fn(ctx)
+        except Exception:
+            logger.exception(
+                "logits_mask_fn failed for %s; degrading the lane to "
+                "unconstrained", s.request_id,
+            )
+            s.logits_mask_fn = None
+            return ("free", None)
+        if allowed is None:
+            return ("free", None)
+        ids = self._in_vocab(allowed)
+        if len(ids) == 1:
+            return ("forced", int(ids[0]))
+        return ("ids", ids)
 
     def _in_vocab(self, allowed_ids) -> np.ndarray:
         """Clip a constrained-decoding allow-list to the model vocab.

@@ -204,15 +204,19 @@ def build_tpu_provider(cfg: ServingConfig) -> LLMProvider:
                     prompt_ids=[3] * min(8, window // 4),
                     max_new_tokens=engine_cfg.multi_step + 2,
                 ))
-            # Constrained decoding uses two more program variants: the
-            # masked prefill trace and the forced-token chained decode
-            # ([B] override vector).  The first tool call would otherwise
-            # compile them on the scheduler thread, stalling every
-            # in-flight stream for the duration of an XLA compile.
+            # Constrained decoding uses three more program variants: the
+            # masked prefill trace, the forced-token chained decode ([B]
+            # override vector), and the ambiguous masked decode ([B, V]
+            # allowed mask — step 1 below returns TWO ids so it actually
+            # traces).  The first tool call would otherwise compile them
+            # on the scheduler thread, stalling every in-flight stream.
             e.submit(GenRequest(
                 request_id=f"__warmup_con_{n}",
                 prompt_ids=[3] * 4, max_new_tokens=3,
-                logits_mask_fn=lambda out: [3] if len(out) < 2 else None,
+                logits_mask_fn=lambda out: (
+                    [3] if len(out) == 0 else
+                    [3, 4] if len(out) == 1 else None
+                ),
             ))
             e.run_to_completion()
         engine.run_to_completion()

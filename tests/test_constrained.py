@@ -379,3 +379,109 @@ class TestEndToEndProperty:
         assert 0 < len(first) < 20
         texts = {tok.decode([t]) for t in first}
         assert all(t.startswith("{") for t in texts if t)
+
+
+class SubwordStubTokenizer:
+    """Minimal multi-char-token tokenizer: exercises BPE-style forced-text
+    regions where the allowed-id mask is never a singleton even though the
+    grammar text is deterministic (the forced_id canonical-token case)."""
+
+    PIECES = (
+        [chr(c) for c in range(0x20, 0x7F)]  # single chars first
+        + ['{"', '"name"', '": "', '", "', 'get_weather', 'name',
+           'parameters', '":', ' {"', '"}', '"}}', 'city', 'units',
+           'we', 'ath', 'er', 'get_', '{"name', '{"name":']
+    )
+
+    def __init__(self):
+        self.texts = list(self.PIECES) + ["<eot>"]
+        self.eot_id = len(self.texts) - 1
+        self.stop_ids = (self.eot_id,)
+        self.bos_id = self.eot_id
+        self.eos_id = self.eot_id
+        self.pad_id = self.eot_id
+        self.vocab_size = len(self.texts)
+
+    def decode(self, ids):
+        return "".join(
+            self.texts[int(i)] if int(i) != self.eot_id else ""
+            for i in ids
+        )
+
+    def encode(self, text):  # greedy longest-match (tests only)
+        out = []
+        i = 0
+        by_len = sorted(range(len(self.PIECES)),
+                        key=lambda t: -len(self.PIECES[t]))
+        while i < len(text):
+            for t in by_len:
+                p = self.PIECES[t]
+                if text.startswith(p, i):
+                    out.append(t)
+                    i += len(p)
+                    break
+            else:
+                raise ValueError(f"unencodable at {text[i:]!r}")
+        return out
+
+
+class TestForcedIdChaining:
+    """forced_id: deterministic grammar text resolves to ONE canonical
+    (longest) token even when the allowed-id mask has many options."""
+
+    def test_forced_id_picks_longest_canonical_token(self):
+        tok = SubwordStubTokenizer()
+        fn = ToolCallMaskFn(tok, TOOLS, force_name="get_weather")
+        fid = fn.forced_id([])
+        assert fid is not None
+        # the deterministic run is '{"name": "get_weather' — the longest
+        # indexed prefix token is '{"name":'
+        assert tok.texts[fid] == '{"name":'
+        # while the plain mask at the same position has MANY options
+        fn2 = ToolCallMaskFn(tok, TOOLS, force_name="get_weather")
+        assert len(fn2([])) > 1
+
+    def test_forced_id_is_none_in_free_string(self):
+        tok = SubwordStubTokenizer()
+        fn = ToolCallMaskFn(tok, TOOLS, force_name="get_weather")
+        prefix = '{"name": "get_weather", "parameters": {"city": "'
+        ids = tok.encode(prefix)
+        assert fn.forced_id(ids) is None  # model chooses the content
+
+    def test_forced_id_matches_mask_for_byte_tokenizer(self):
+        """Single-char tokenizers: forced_id == the singleton the mask
+        would allow (token-exact with the pre-chaining behavior)."""
+        tok = ByteTokenizer()
+        fn = ToolCallMaskFn(tok, TOOLS, force_name="get_weather")
+        fid = fn.forced_id([])
+        allowed = ToolCallMaskFn(tok, TOOLS, force_name="get_weather")([])
+        assert allowed == [fid]
+
+    def test_engine_chains_subword_tokens_and_output_parses(self):
+        """End to end with the subword tokenizer: the generation is
+        grammar-valid and uses far fewer tokens than characters."""
+        cfg = ModelConfig(name="bpe-chain", vocab_size=128 + 20,
+                          hidden_size=64, intermediate_size=128,
+                          num_layers=2, num_heads=4, num_kv_heads=2,
+                          head_dim=16, dtype="float32")
+        tok = SubwordStubTokenizer()
+        cfg = cfg.replace(vocab_size=max(cfg.vocab_size, tok.vocab_size))
+        params = init_params(cfg, jax.random.PRNGKey(3))
+        eng = InferenceEngine(
+            cfg, params,
+            EngineConfig(max_batch=2, page_size=8, num_pages=64,
+                         max_pages_per_seq=8, prefill_buckets=(8, 16, 32)),
+            kv_dtype=None,
+        )
+        fn = ToolCallMaskFn(tok, TOOLS, force_name="get_weather",
+                            max_tokens=40)
+        req = GenRequest(request_id="bpe", prompt_ids=[40, 41, 42],
+                         max_new_tokens=40, stop_token_ids=tok.stop_ids,
+                         logits_mask_fn=fn)
+        eng.submit(req)
+        eng.run_to_completion()
+        text = tok.decode(req.output_ids)
+        assert validate_tool_call_json(text, TOOLS), text
+        # chaining used multi-char canonical tokens: far fewer tokens
+        # than characters in the forced skeleton
+        assert len(req.output_ids) < len(text)
