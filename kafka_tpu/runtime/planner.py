@@ -1,0 +1,325 @@
+"""Memory-fit planner: pure arithmetic over model + engine + mesh shapes.
+
+A serving framework must answer "does this config fit this topology, and at
+what concurrency?" *before* anyone buys the topology.  The reference never
+had to (its LLM compute was a remote gateway, src/llm/portkey.py); a local
+TPU engine does.  This module computes per-device HBM bytes for a
+(ModelConfig, engine shape, mesh) triple using THE SAME placement rules the
+engine actually applies:
+
+* weights follow parallel/sharding.py's PartitionSpecs — including the GQA
+  fallback that REPLICATES kv projections and the KV pool when tp does not
+  divide num_kv_heads (sharding.py:45-50), which dominates the 70B budget;
+* the KV pool is the [L, num_pages * page_size, Hkv*D] pair of
+  runtime/kv_cache.py, k and v, layer axis split over pp
+  (parallel/pipeline.py stages), head axis over tp iff tp | Hkv;
+* int8 weight quantization (models/quant.py) stores 1 byte/param + an f32
+  scale per output channel; int8 KV halves pool bytes + per-page f32 scales.
+
+Activation peaks are *estimates* (XLA's scratch is its own business), sized
+from the dominant live tensors: the [S, V/tp] f32 prefill logits block, the
+flash-prefill window gather, and the decode-time [B, V] f32 logits +
+sampling workspace.  A fragmentation/scratch reserve (default 8%) absorbs
+what the formulas do not model; `tests/test_planner.py` pins the known
+ground truths (8B bf16 does NOT fit one v5e chip, 8B int8 DOES — both
+observed on real hardware in round 4).
+
+Known HBM budgets (public datasheet numbers):
+  v5e  (v5 lite): 16 GiB/chip
+  v5p:            95 GiB/chip
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+from ..models.config import ModelConfig
+
+GiB = 1024**3
+
+# chip generation -> HBM bytes per chip
+HBM_BYTES = {
+    "v5e": 16 * GiB,
+    "v5p": 95 * GiB,
+    "v4": 32 * GiB,
+}
+
+_DTYPE_BYTES = {"bfloat16": 2, "float32": 4, "float16": 2, "int8": 1}
+
+
+def _bytes(dtype: str) -> int:
+    return _DTYPE_BYTES[dtype]
+
+
+def _kv_shard(cfg: ModelConfig, tp: int) -> int:
+    """kv-head shard factor — mirrors parallel/sharding.py _kv_axis: kv
+    projections and the pool replicate when tp does not divide Hkv."""
+    return tp if (tp > 1 and cfg.num_kv_heads % tp == 0) else 1
+
+
+def hbm_for_device(dev) -> Optional[int]:
+    """Best-effort HBM budget for a live jax device: the runtime's
+    bytes_limit when reported, else the datasheet number for the chip
+    generation parsed from device_kind."""
+    stats = getattr(dev, "memory_stats", lambda: None)() or {}
+    if stats.get("bytes_limit"):
+        return int(stats["bytes_limit"])
+    if dev.platform != "tpu":
+        return None
+    kind = getattr(dev, "device_kind", "").lower()
+    if "v5p" in kind:
+        return HBM_BYTES["v5p"]
+    if "lite" in kind or "v5e" in kind or "v5" in kind:
+        return HBM_BYTES["v5e"]  # plain "v5": conservative (lite) budget
+    if "v4" in kind:
+        return HBM_BYTES["v4"]
+    return None
+
+
+@dataclasses.dataclass(frozen=True)
+class MemoryPlan:
+    """Per-device byte budget for one serving configuration."""
+
+    model: str
+    mesh: Dict[str, int]              # {"tp":..,"sp":..,"pp":..,"ep":..}
+    hbm_bytes: int                    # budget per chip
+    reserve_frac: float               # scratch/fragmentation allowance
+    weight_bytes: int                 # per device
+    kv_pool_bytes: int                # per device (both k and v)
+    activation_bytes: int             # estimated peak live activations
+    kv_replicated: bool               # GQA fallback engaged (tp !| Hkv)
+    kv_bytes_per_token: int           # per device, k+v, all layers
+    window_tokens: int                # configured attention window
+    notes: str = ""
+
+    @property
+    def total_bytes(self) -> int:
+        return self.weight_bytes + self.kv_pool_bytes + self.activation_bytes
+
+    @property
+    def usable_bytes(self) -> int:
+        return int(self.hbm_bytes * (1.0 - self.reserve_frac))
+
+    @property
+    def fits(self) -> bool:
+        return self.total_bytes <= self.usable_bytes
+
+    @property
+    def headroom_bytes(self) -> int:
+        return self.usable_bytes - self.total_bytes
+
+    @property
+    def max_concurrent_windows(self) -> int:
+        """How many FULL attention windows of KV the leftover HBM holds —
+        the honest "max concurrent N-token threads" number (weights and
+        activations charged first; the configured pool is not)."""
+        free = self.usable_bytes - self.weight_bytes - self.activation_bytes
+        per_window = self.kv_bytes_per_token * self.window_tokens
+        return max(0, free // per_window) if per_window else 0
+
+    def summary(self) -> Dict[str, object]:
+        return {
+            "model": self.model,
+            "mesh": self.mesh,
+            "hbm_gib": round(self.hbm_bytes / GiB, 2),
+            "weight_gib": round(self.weight_bytes / GiB, 3),
+            "kv_pool_gib": round(self.kv_pool_bytes / GiB, 3),
+            "activation_gib": round(self.activation_bytes / GiB, 3),
+            "total_gib": round(self.total_bytes / GiB, 3),
+            "usable_gib": round(self.usable_bytes / GiB, 3),
+            "fits": self.fits,
+            "headroom_gib": round(self.headroom_bytes / GiB, 3),
+            "kv_replicated": self.kv_replicated,
+            "window_tokens": self.window_tokens,
+            "max_concurrent_windows": self.max_concurrent_windows,
+            "notes": self.notes,
+        }
+
+
+def weight_bytes_per_device(
+    cfg: ModelConfig,
+    *,
+    tp: int = 1,
+    pp: int = 1,
+    ep: int = 1,
+    quantize: str = "",
+) -> int:
+    """Per-device weight bytes under parallel/sharding.py's rules."""
+    h, f, d = cfg.hidden_size, cfg.intermediate_size, cfg.head_dim
+    hq, hkv = cfg.num_heads, cfg.num_kv_heads
+    L = cfg.num_layers
+    wb = _bytes(cfg.dtype)
+    int8 = quantize == "int8"
+
+    def mat(rows: int, cols: int, shard: int) -> int:
+        """One weight matrix sharded `shard`-ways; int8 = 1B + f32 scale
+        per output channel (quant.py: scale shape keeps the out axis)."""
+        n = rows * cols // shard
+        return n + (cols // shard) * 4 if int8 else n * wb
+
+    kv_shard = _kv_shard(cfg, tp)
+
+    per_layer = (
+        mat(h, hq * d, tp)            # wq
+        + 2 * mat(h, hkv * d, kv_shard)  # wk, wv
+        + mat(hq * d, h, tp)          # wo (row-parallel: heads on tp)
+        + 2 * h * wb                  # norms (replicated)
+    )
+    if cfg.is_moe:
+        e_shard = ep if (ep > 1 and cfg.num_experts % ep == 0) else 1
+        per_layer += h * cfg.num_experts * wb  # router, replicated
+        per_layer += cfg.num_experts // e_shard * (
+            2 * mat(h, f, tp) + mat(f, h, tp)
+        )
+    else:
+        per_layer += 2 * mat(h, f, tp) + mat(f, h, tp)
+
+    total = per_layer * L // pp
+    # embed replicated (lookup local); untied lm_head tp-sharded over V
+    total += mat(cfg.vocab_size, h, 1) if int8 else cfg.vocab_size * h * wb
+    total += h * wb  # final norm
+    if not cfg.tie_word_embeddings:
+        total += mat(h, cfg.vocab_size, tp)
+    return total
+
+
+def kv_pool_bytes_per_device(
+    cfg: ModelConfig,
+    *,
+    num_pages: int,
+    page_size: int,
+    tp: int = 1,
+    pp: int = 1,
+    kv_dtype: str = "bfloat16",
+) -> int:
+    """Both pool arrays (k + v), [L/pp, num_pages*page_size, Hkv*D]."""
+    hkv_d = cfg.num_kv_heads * cfg.head_dim
+    kv_shard = _kv_shard(cfg, tp)
+    slots = num_pages * page_size
+    per = cfg.num_layers // pp * slots * hkv_d // kv_shard
+    b = per * _bytes(kv_dtype) * 2
+    if kv_dtype == "int8":
+        # per-page f32 scale per (layer, page, k|v) — runtime/kv_cache.py
+        b += cfg.num_layers // pp * num_pages * 2 * 4
+    return b
+
+
+def kv_bytes_per_token(
+    cfg: ModelConfig, *, tp: int = 1, pp: int = 1, kv_dtype: str = "bfloat16"
+) -> int:
+    kv_shard = _kv_shard(cfg, tp)
+    return (
+        cfg.num_layers // pp
+        * cfg.num_kv_heads * cfg.head_dim // kv_shard
+        * _bytes(kv_dtype) * 2
+    )
+
+
+def activation_bytes_estimate(
+    cfg: ModelConfig,
+    *,
+    max_batch: int,
+    prefill_bucket: int,
+    window: int,
+    tp: int = 1,
+    sp: int = 1,
+) -> int:
+    """Peak live activations, from the dominant tensors.
+
+    Prefill (chunk S over sp ranks, heads/F over tp):
+      logits block  S/sp * V/tp * 4   (f32, the [S, V] einsum output)
+      hidden trio   S/sp * (H + 2*F/tp) * 2
+      window gather S * Hkv*D * 2 * 2 (XLA fallback reads k+v windows;
+                    the flash kernel streams pages instead, but plan for
+                    the portable path)
+    Decode: B * V * 4 * 3 (logits + top-k sort workspace ~2 copies).
+    """
+    V, H, F = cfg.vocab_size, cfg.hidden_size, cfg.intermediate_size
+    hkv_d = cfg.num_kv_heads * cfg.head_dim
+    s_local = max(1, prefill_bucket // max(sp, 1))
+    prefill = (
+        s_local * (V // tp) * 4
+        + s_local * (H + 2 * F // tp) * 2
+        + window * hkv_d * 2 * 2
+    )
+    decode = max_batch * V * 4 * 3 + max_batch * window * hkv_d * 2 * 2
+    return max(prefill, decode)
+
+
+def plan_memory(
+    cfg: ModelConfig,
+    *,
+    tp: int = 1,
+    sp: int = 1,
+    pp: int = 1,
+    ep: int = 1,
+    num_pages: int,
+    page_size: int,
+    max_pages_per_seq: int,
+    max_batch: int,
+    prefill_bucket: int = 512,
+    quantize: str = "",
+    kv_dtype: str = "bfloat16",
+    hbm_bytes: Optional[int] = None,
+    chip: str = "v5e",
+    reserve_frac: float = 0.08,
+) -> MemoryPlan:
+    if hbm_bytes is None:
+        hbm_bytes = HBM_BYTES[chip]
+    kv_replicated = tp > 1 and _kv_shard(cfg, tp) == 1
+    window = max_pages_per_seq * page_size
+    plan = MemoryPlan(
+        model=cfg.name,
+        mesh={"tp": tp, "sp": sp, "pp": pp, "ep": ep},
+        hbm_bytes=hbm_bytes,
+        reserve_frac=reserve_frac,
+        weight_bytes=weight_bytes_per_device(
+            cfg, tp=tp, pp=pp, ep=ep, quantize=quantize
+        ),
+        kv_pool_bytes=kv_pool_bytes_per_device(
+            cfg, num_pages=num_pages, page_size=page_size, tp=tp, pp=pp,
+            kv_dtype=kv_dtype,
+        ),
+        activation_bytes=activation_bytes_estimate(
+            cfg, max_batch=max_batch, prefill_bucket=prefill_bucket,
+            window=window, tp=tp, sp=sp,
+        ),
+        kv_replicated=kv_replicated,
+        kv_bytes_per_token=kv_bytes_per_token(
+            cfg, tp=tp, pp=pp, kv_dtype=kv_dtype
+        ),
+        window_tokens=window,
+        notes=(
+            "kv params+pool replicated per chip: tp does not divide "
+            f"num_kv_heads ({cfg.num_kv_heads} % {tp}); grouped "
+            "head-sharing (tp/Hkv chips per head) is the documented "
+            "upgrade path (parallel/sharding.py:25-30), not implemented"
+            if kv_replicated else ""
+        ),
+    )
+    return plan
+
+
+def plan_for_serving(scfg, hbm_bytes: Optional[int] = None,
+                     chip: str = "v5e",
+                     model_cfg: Optional[ModelConfig] = None) -> MemoryPlan:
+    """Plan from a ServingConfig (server/config.py).
+
+    `model_cfg` overrides the registry lookup — the server passes the model
+    it actually loaded (checkpoint / tiny configs differ from model_name).
+    """
+    if model_cfg is None:
+        from ..models.config import get_config
+
+        model_cfg = get_config(scfg.model_name)
+    return plan_memory(
+        model_cfg,
+        tp=scfg.tp_size, sp=scfg.sp_size, pp=scfg.pp_size, ep=scfg.ep_size,
+        num_pages=scfg.num_pages, page_size=scfg.page_size,
+        max_pages_per_seq=scfg.max_pages_per_seq, max_batch=scfg.max_batch,
+        prefill_bucket=max(scfg.prefill_buckets),
+        quantize=scfg.quantize,
+        kv_dtype=getattr(scfg, "kv_quantize", "") or "bfloat16",
+        hbm_bytes=hbm_bytes, chip=chip,
+    )

@@ -131,6 +131,35 @@ def build_tpu_provider(cfg: ServingConfig) -> LLMProvider:
         max_new_tokens_default=cfg.max_new_tokens_default,
         cp_strategy=cfg.cp_strategy,
     )
+    # Memory-fit validation (runtime/planner.py): per-device bytes under
+    # the actual sharding rules, against the live device's HBM.  When the
+    # WEIGHTS ALONE exceed the budget — never a false positive, the
+    # activation terms are estimates but the weight bytes are exact — fail
+    # now in milliseconds instead of OOMing after a long checkpoint load.
+    memory_plan = None
+    try:
+        from ..runtime.planner import hbm_for_device, plan_for_serving
+
+        hbm = hbm_for_device(jax.devices()[0])
+        if hbm:
+            memory_plan = plan_for_serving(
+                cfg, hbm_bytes=hbm, model_cfg=model_cfg
+            )
+            if memory_plan.weight_bytes > memory_plan.usable_bytes:
+                raise MemoryError(
+                    f"{model_cfg.name} weights alone need "
+                    f"{memory_plan.weight_bytes / 2**30:.1f} GiB/device, "
+                    f"budget {memory_plan.usable_bytes / 2**30:.1f} GiB: "
+                    f"{memory_plan.summary()} — shard (tp/pp), quantize, "
+                    "or pick a bigger topology"
+                )
+            log = logger.warning if not memory_plan.fits else logger.info
+            log("memory plan: %s", memory_plan.summary())
+    except MemoryError:
+        raise
+    except Exception as e:
+        logger.debug("memory planning skipped: %s", e)
+
     if cfg.dp_size > 1:
         if cfg.pp_size > 1:
             raise ValueError(
@@ -223,7 +252,11 @@ def build_tpu_provider(cfg: ServingConfig) -> LLMProvider:
         for e in engines:
             e.metrics = EngineMetrics()
         logger.info("warmup compile done in %.1fs", _time.monotonic() - t0)
-    return TPULLMProvider(engine, tokenizer, model_name=cfg.model_name)
+    provider = TPULLMProvider(engine, tokenizer, model_name=cfg.model_name)
+    # the startup plan (actual model_cfg, live-device HBM) rides along so
+    # /health reports the numbers this deployment was validated against
+    provider.memory_plan = memory_plan
+    return provider
 
 
 def default_builtin_tools(cfg: ServingConfig) -> List[Tool]:
@@ -714,6 +747,9 @@ async def health(request: web.Request) -> web.Response:
         "status": "ok",
         "kafka_initialized": state["kafka"]._initialized,
     }
+    plan = getattr(llm, "memory_plan", None)  # set by build_tpu_provider
+    if plan is not None:
+        payload["memory_plan"] = plan.summary()
     engine = getattr(llm, "engine", None)
     if engine is not None:
         # DataParallelEngines exposes .engines; a single engine is its own

@@ -1,0 +1,142 @@
+"""Memory-fit planner (runtime/planner.py): the feasibility artifact for
+BASELINE topologies this single-chip environment cannot execute.
+
+Ground truths pinned here were OBSERVED on real hardware in round 4:
+llama-3-8b bf16 does not fit one v5e chip (the server OOMed; COVERAGE.md),
+llama-3-8b int8 does (served at ~540 tok/s).  The unreachable-topology
+numbers (v5e-8, v5p-64) are pure arithmetic over the same placement rules
+parallel/sharding.py applies, so the planner's credibility rests on the
+observed cases matching.
+"""
+
+import math
+
+from kafka_tpu.models.config import get_config
+from kafka_tpu.runtime.planner import (
+    GiB,
+    HBM_BYTES,
+    kv_bytes_per_token,
+    plan_memory,
+    plan_for_serving,
+    weight_bytes_per_device,
+)
+from kafka_tpu.server.config import ServingConfig
+
+
+class TestWeightArithmetic:
+    def test_8b_bf16_weights_match_param_count(self):
+        # 8.03B params * 2 bytes, +- 1% (norms/rounding)
+        cfg = get_config("llama-3-8b")
+        wb = weight_bytes_per_device(cfg)
+        assert math.isclose(wb, 8.03e9 * 2, rel_tol=0.01)
+
+    def test_int8_halves_weight_bytes(self):
+        cfg = get_config("llama-3-8b")
+        bf16 = weight_bytes_per_device(cfg)
+        int8 = weight_bytes_per_device(cfg, quantize="int8")
+        assert 0.50 < int8 / bf16 < 0.53  # 1B/param + f32 scales
+
+    def test_tp_shards_everything_but_embed(self):
+        cfg = get_config("llama-3-8b")
+        full = weight_bytes_per_device(cfg)
+        tp8 = weight_bytes_per_device(cfg, tp=8)
+        embed = cfg.vocab_size * cfg.hidden_size * 2  # replicated
+        # sharded part must divide by ~8
+        assert math.isclose(tp8 - embed, (full - embed) / 8, rel_tol=0.01)
+
+    def test_kv_replication_fallback_when_tp_exceeds_kv_heads(self):
+        # 70B: 8 kv heads, tp=16 -> wk/wv replicated (sharding.py:45-50)
+        cfg = get_config("llama-3-70b")
+        t = kv_bytes_per_token(cfg, tp=16)
+        assert t == kv_bytes_per_token(cfg, tp=1)
+        assert kv_bytes_per_token(cfg, tp=8) == t // 8
+
+    def test_moe_experts_shard_over_ep_and_tp(self):
+        cfg = get_config("mixtral-8x7b")
+        full = weight_bytes_per_device(cfg)
+        ep8 = weight_bytes_per_device(cfg, ep=8)
+        # experts are ~96% of Mixtral's params; ep8 keeps 1/8 of them
+        assert ep8 < 0.2 * full
+        assert weight_bytes_per_device(cfg, ep=8, tp=4) < ep8
+
+
+class TestObservedGroundTruths:
+    """Cases executed on the real chip in round 4 — the planner must agree."""
+
+    def test_8b_bf16_does_not_fit_one_v5e(self):
+        plan = plan_memory(
+            get_config("llama-3-8b"), num_pages=512, page_size=16,
+            max_pages_per_seq=128, max_batch=8,
+        )
+        assert not plan.fits
+        assert plan.weight_bytes > 14 * GiB  # weights alone ~15 GiB
+
+    def test_8b_int8_fits_one_v5e(self):
+        plan = plan_memory(
+            get_config("llama-3-8b"), num_pages=512, page_size=16,
+            max_pages_per_seq=128, max_batch=8, quantize="int8",
+        )
+        assert plan.fits
+        assert plan.headroom_bytes > 4 * GiB
+
+    def test_1b_bf16_fits_with_room(self):
+        plan = plan_memory(
+            get_config("llama-3.2-1b"), num_pages=2048, page_size=16,
+            max_pages_per_seq=512, max_batch=8,
+        )
+        assert plan.fits and plan.headroom_bytes > 8 * GiB
+
+
+class TestBaselineTopologies:
+    """BASELINE configs 3 and 5: the feasibility numbers for topologies
+    this environment cannot reach (VERDICT r4 weak #6)."""
+
+    def test_config3_8b_tp8_v5e8_holds_256_threads_at_2k(self):
+        # 256 concurrent threads, 2048-token windows, 8B bf16 over tp=8
+        plan = plan_memory(
+            get_config("llama-3-8b"), tp=8, num_pages=256 * 128 + 1,
+            page_size=16, max_pages_per_seq=128, max_batch=64,
+            prefill_bucket=2048,
+        )
+        assert plan.fits
+        assert plan.max_concurrent_windows >= 256
+
+    def test_config5_70b_tp16_sp4_v5p64_fits(self):
+        scfg = ServingConfig.profile_32k()
+        plan = plan_for_serving(scfg, chip="v5p")
+        assert plan.fits
+        assert plan.kv_replicated  # tp=16 > 8 kv heads -> replicated pool
+        # the configured pool (4 x 32k windows + trash) leaves room, and
+        # leftover HBM holds at least 7 concurrent full 32k windows
+        assert plan.max_concurrent_windows >= 7
+        # per-device weights ~12.4 GiB: 140 GB of bf16 across tp=16 with
+        # replicated embed + kv projections
+        assert 11 * GiB < plan.weight_bytes < 14 * GiB
+
+    def test_config5_would_not_fit_on_v5e(self):
+        scfg = ServingConfig.profile_32k()
+        assert not plan_for_serving(scfg, chip="v5e").fits
+
+    def test_int8_kv_doubles_32k_capacity(self):
+        cfg = get_config("llama-3-70b")
+        kw = dict(tp=16, sp=4, num_pages=8193, page_size=16,
+                  max_pages_per_seq=2048, max_batch=4, prefill_bucket=4096,
+                  chip="v5p")
+        bf16 = plan_memory(cfg, **kw)
+        int8 = plan_memory(cfg, kv_dtype="int8", **kw)
+        assert int8.max_concurrent_windows >= 2 * bf16.max_concurrent_windows
+
+
+class TestServingIntegration:
+    def test_plan_for_serving_default_config(self):
+        plan = plan_for_serving(ServingConfig())
+        assert plan.fits
+        assert plan.model == "llama-3.2-1b"
+
+    def test_health_reports_plan(self):
+        # summary() is JSON-serializable (health endpoint payload)
+        import json
+
+        s = plan_for_serving(ServingConfig()).summary()
+        json.dumps(s)
+        assert {"fits", "weight_gib", "max_concurrent_windows"} <= set(s)
