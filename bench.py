@@ -441,30 +441,34 @@ def scale_phase(args, base_cfg, base_params) -> dict:
         del eng
         return tps, sps, pb, gbs
 
-    # ---- 1B int8: throughput + greedy match-rate quality check ----------
+    # ---- 1B int8: throughput + LOGIT-LEVEL quality (VERDICT r4 #2) ------
+    # Both variants fit the chip, so the quality claim is measured, not
+    # asserted: max |dlogit| bounds where greedy can flip (only inside the
+    # < 2*dmax top-1 margin band), KL bounds sampling drift.  Random
+    # weights remain the adversarial case for ARGMAX (their margins sit
+    # inside the band — margin_p50 tells that story in the output), but
+    # the logit error itself transfers to real checkpoints.
+    from kafka_tpu.models.quant_quality import logit_quality_metrics
+
     q1 = quantize_params(base_params, base_cfg)
-    bf_eng = mk_engine(base_cfg, base_params, batch=2, gen=40)
-    q_eng = mk_engine(base_cfg, q1, batch=2, gen=40)
-    match = total = 0
-    for i in range(3):
-        p = make_prompt(rng, args.prompt_len, base_cfg.vocab_size)
-        a = bf_eng.generate(p, max_new_tokens=32).output_ids
-        b = q_eng.generate(p, max_new_tokens=32).output_ids
-        total += len(a)
-        match += sum(1 for x, y in zip(a, b) if x == y)
-    del bf_eng, q_eng
+    quality = logit_quality_metrics(
+        base_cfg, base_params, q1,
+        [make_prompt(rng, 48, base_cfg.vocab_size) for _ in range(3)],
+    )
+    log(f"1b int8 logit quality: {quality}")
     tps, sps, pb, gbs = decode_tps(base_cfg, q1, "1b-int8")
     del q1
     out["llama-3.2-1b-int8"] = {
         "decode_tok_s_b8": round(tps, 1),
         "weight_gb": round(pb / 1e9, 2),
         "hbm_gb_s_est": round(gbs, 1),
-        "greedy_match_rate_vs_bf16": round(match / total, 3),
-        "match_note": ("random weights are the adversarial case for "
-                       "argmax stability (near-tied logits); real "
-                       "checkpoints match higher"),
+        "logit_quality_vs_bf16": quality,
+        "quality_note": ("flips are confined to bf16 top-1 margins < "
+                         "2*max_abs_dlogit (analytic bound, gated in "
+                         "tests/test_quant.py on a real-architecture "
+                         "checkpoint)"),
     }
-    log(f"1b int8: {tps:.1f} tok/s, match {match}/{total}")
+    log(f"1b int8: {tps:.1f} tok/s")
 
     # ---- 3B bf16 / 8B int8 ----------------------------------------------
     cfg3 = get_config("llama-3.2-3b")

@@ -187,7 +187,18 @@ def paged_prefill_attention(
     G = Hq // Hkv
     if scale is None:
         scale = D**-0.5
-    qb = min(q_block, S)
+    # Scoped-VMEM bound: the kernel's per-block footprint scales with
+    # rows = q_block * Hq (qx/out pipeline buffers, f32 accumulator, and
+    # the [rows, chunk] softmax temporaries).  rows = 2048 measured
+    # 17.91 MB of scoped VMEM against the 16 MB core limit (Mosaic
+    # stack-OOM at compile, first hit by the 2048-token prefill bucket at
+    # 32 heads); rows <= ~1024 keeps ~9 MB with headroom for the DMA
+    # buffers.  The cap is rounded DOWN to a power of two so it divides
+    # the power-of-two chunk buckets for any head count (1024//24 = 42
+    # would fail S % qb for every bucket).
+    cap = max(8, 1024 // Hq)
+    cap = 1 << (cap.bit_length() - 1)
+    qb = min(q_block, S, cap)
     if S % qb:
         raise ValueError(f"chunk length {S} not divisible by q_block {qb}")
     cp = min(pages_per_chunk, page_row.shape[0])

@@ -118,13 +118,16 @@ class EngineConfig:
     # (all_to_all to head-sharded layout — needs heads/tp % sp == 0).
     cp_strategy: str = "ring"
     # Decode steps fused into one device dispatch (lax.scan) when the batch
-    # is busy and stable — amortizes per-dispatch host/tunnel overhead,
-    # which measures ~1ms/step on tunneled links vs a ~5.7ms device step.
+    # is busy and stable — amortizes per-dispatch host/tunnel overhead.
     # Engages with >=3 active streams, no constrained lanes, and no lane
     # mid-prefill; a waiting queue with every slot busy keeps fusion ON
-    # (admission waits at most k-1 steps, ~35ms — see _pick_multi_step).
-    # 1 disables.
-    multi_step: int = 8
+    # (admission waits at most k-1 steps — see _pick_multi_step).
+    # Depth sweep on the tunneled v5e (scripts/sweep_multistep.py, 1B b8,
+    # end-to-end engine tok/s): depth 8 = 1111, 16 = 1576 (+42%), 24 =
+    # 1621 (+3% more for double the admission latency) — dispatch
+    # overhead, not device compute, was the margin, so the default sits at
+    # 16 where the curve flattens.  1 disables.
+    multi_step: int = 16
     # Off-slot admission: when every decode slot is busy, waiting requests
     # may still prefill and emit their FIRST token ("parked"), then join
     # the decode batch as slots free.  Under oversubscription this bounds

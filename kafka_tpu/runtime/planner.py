@@ -42,6 +42,7 @@ GiB = 1024**3
 HBM_BYTES = {
     "v5e": 16 * GiB,
     "v5p": 95 * GiB,
+    "v6e": 32 * GiB,
     "v4": 32 * GiB,
 }
 
@@ -70,11 +71,13 @@ def hbm_for_device(dev) -> Optional[int]:
     kind = getattr(dev, "device_kind", "").lower()
     if "v5p" in kind:
         return HBM_BYTES["v5p"]
+    if "v6" in kind:
+        return HBM_BYTES["v6e"]
     if "lite" in kind or "v5e" in kind or "v5" in kind:
         return HBM_BYTES["v5e"]  # plain "v5": conservative (lite) budget
     if "v4" in kind:
         return HBM_BYTES["v4"]
-    return None
+    return None  # unknown generation: skip validation, never misjudge it
 
 
 @dataclasses.dataclass(frozen=True)
@@ -200,8 +203,8 @@ def kv_pool_bytes_per_device(
     per = cfg.num_layers // pp * slots * hkv_d // kv_shard
     b = per * _bytes(kv_dtype) * 2
     if kv_dtype == "int8":
-        # per-page f32 scale per (layer, page, k|v) — runtime/kv_cache.py
-        b += cfg.num_layers // pp * num_pages * 2 * 4
+        # per-slot f32 scales, k and v (int8 KV quantization tier)
+        b += cfg.num_layers // pp * slots * 2 * 4
     return b
 
 

@@ -100,26 +100,30 @@ def build_tpu_provider(cfg: ServingConfig) -> LLMProvider:
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
 
+    # Resolve the model's ARCHITECTURE cheaply (config.json / registry —
+    # no weight materialization) so the memory-fit check below can reject
+    # an impossible config in milliseconds, before a multi-GiB checkpoint
+    # load ever touches the device.
     if cfg.checkpoint_dir:
+        import os as _os
+
+        from ..models.config import config_from_hf_json
+
         tokenizer = load_tokenizer(cfg.checkpoint_dir)
-        model_cfg, params = load_checkpoint(cfg.checkpoint_dir)
+        model_cfg = config_from_hf_json(
+            _os.path.join(cfg.checkpoint_dir, "config.json")
+        )
     elif cfg.tiny_model:
         tokenizer = ByteTokenizer()
         model_cfg = get_config("tiny").replace(
             vocab_size=tokenizer.vocab_size, dtype="float32"
         )
-        params = init_params(model_cfg, jax.random.PRNGKey(0))
     else:
         tokenizer = ByteTokenizer()
         model_cfg = get_config(cfg.model_name).replace(
             vocab_size=max(tokenizer.vocab_size, 262), dtype=cfg.dtype
         )
-        params = init_params(model_cfg, jax.random.PRNGKey(0))
-    if cfg.quantize == "int8":
-        from ..models import quantize_params
-
-        params = quantize_params(params, model_cfg)
-    elif cfg.quantize:
+    if cfg.quantize and cfg.quantize != "int8":
         raise ValueError(f"unknown quantize mode {cfg.quantize!r}")
 
     engine_cfg = EngineConfig(
@@ -130,12 +134,13 @@ def build_tpu_provider(cfg: ServingConfig) -> LLMProvider:
         prefill_buckets=cfg.prefill_buckets,
         max_new_tokens_default=cfg.max_new_tokens_default,
         cp_strategy=cfg.cp_strategy,
+        multi_step=cfg.multi_step,
     )
     # Memory-fit validation (runtime/planner.py): per-device bytes under
     # the actual sharding rules, against the live device's HBM.  When the
     # WEIGHTS ALONE exceed the budget — never a false positive, the
     # activation terms are estimates but the weight bytes are exact — fail
-    # now in milliseconds instead of OOMing after a long checkpoint load.
+    # here, before any weights load.
     memory_plan = None
     try:
         from ..runtime.planner import hbm_for_device, plan_for_serving
@@ -159,6 +164,17 @@ def build_tpu_provider(cfg: ServingConfig) -> LLMProvider:
         raise
     except Exception as e:
         logger.debug("memory planning skipped: %s", e)
+
+    # NOW materialize weights (checkpoint load / random init); the
+    # plan-validated model_cfg is the one served
+    if cfg.checkpoint_dir:
+        _, params = load_checkpoint(cfg.checkpoint_dir, model_cfg)
+    else:
+        params = init_params(model_cfg, jax.random.PRNGKey(0))
+    if cfg.quantize == "int8":
+        from ..models import quantize_params
+
+        params = quantize_params(params, model_cfg)
 
     if cfg.dp_size > 1:
         if cfg.pp_size > 1:

@@ -28,6 +28,9 @@ class ServingConfig:
     max_pages_per_seq: int = 512
     prefill_buckets: Tuple[int, ...] = (64, 128, 256, 512, 1024, 2048)
     max_new_tokens_default: int = 1024
+    # fused decode depth (EngineConfig.multi_step): steps per device
+    # dispatch when the batch is busy; 1 disables fusion
+    multi_step: int = 16
     # parallelism (SURVEY §2.2): the server builds its mesh from these.
     #   tp — tensor parallel within each engine (attention heads / MLP)
     #   sp — sequence parallel: ring-sharded chunked prefill for long
@@ -119,6 +122,7 @@ class ServingConfig:
             max_batch=get("MAX_BATCH", cls.max_batch, int),
             num_pages=get("NUM_PAGES", cls.num_pages, int),
             max_pages_per_seq=get("MAX_PAGES_PER_SEQ", cls.max_pages_per_seq, int),
+            multi_step=get("MULTI_STEP", cls.multi_step, int),
             tp_size=get_axis("TP", cls.tp_size),
             sp_size=get_axis("SP", cls.sp_size),
             pp_size=get_axis("PP", cls.pp_size),

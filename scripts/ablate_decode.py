@@ -1,0 +1,179 @@
+"""Attribute the decode step's time to its pieces, device-resident.
+
+Builds the real decode-layer computation at bench shapes (llama-3.2-1b,
+batch 8, ctx ~336 like the r4 roofline table) and times nested variants,
+each as ONE dispatch of REPEAT on-device passes (lax.scan), differencing
+two dispatch counts to cancel the tunnel RTT (scripts/bench_fused_mlp.py
+timing discipline — per-dispatch timing through the tunnel is noise).
+
+Variants:
+  mm    qkv + o + mlp matmuls only (the weight stream)
+  rope  + rotary embedding on q/k
+  attn  + paged attention (pallas kernel) reading the real pool
+  write + KV pool scatter writes
+  head  final-norm + logits head + greedy argmax ([B] out)
+
+Usage: python scripts/ablate_decode.py [--ctx 336] [--batch 8]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.expanduser("~/.cache/kafka_tpu/xla"))
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+
+from kafka_tpu.models import get_config, init_params
+from kafka_tpu.ops.norms import rms_norm
+from kafka_tpu.ops.rope import apply_rope, rope_cos_sin, rope_frequencies
+
+REPEAT = 16
+
+
+def timed(fn, state, args_, n=4, trials=3):
+    """Median-of-trials differenced timing: each trial measures
+    (T(3n) - T(n)) / (2n * REPEAT).  The spread between dispatch counts
+    must dwarf the tunnel's RTT jitter (~100 ms), hence n*REPEAT >= 64
+    device passes per measurement."""
+
+    def run(k):
+        out = fn(state, *args_)
+        np.asarray(jax.tree.leaves(out)[0])
+        t0 = time.monotonic()
+        o = out
+        for _ in range(k):
+            o = fn(o, *args_)
+        np.asarray(jax.tree.leaves(o)[0])
+        return time.monotonic() - t0
+
+    run(1)
+    vals = []
+    for _ in range(trials):
+        t1 = run(n)
+        t2 = run(3 * n)
+        vals.append((t2 - t1) / (2 * n * REPEAT) * 1e3)
+    return float(np.median(vals))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="llama-3.2-1b")
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--ctx", type=int, default=336)
+    ap.add_argument("--page-size", type=int, default=16)
+    args = ap.parse_args()
+
+    cfg = get_config(args.model).replace(attention_backend="pallas")
+    B, ps, ctx = args.batch, args.page_size, args.ctx
+    H, L, D = cfg.hidden_size, cfg.num_layers, cfg.head_dim
+    Hq, Hkv = cfg.num_heads, cfg.num_kv_heads
+    P = -(-(ctx + 4) // ps)  # pages per seq
+    num_pages = B * P + 1
+    print(f"# {cfg.name} B={B} ctx={ctx} pages/seq={P}")
+
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    lp = params["layers"]
+    k_pool = jnp.zeros((L, num_pages * ps, Hkv * D), jnp.bfloat16)
+    v_pool = jnp.zeros((L, num_pages * ps, Hkv * D), jnp.bfloat16)
+    table = jnp.asarray(
+        np.arange(1, num_pages).reshape(B, P).astype(np.int32))
+    seq_lens = jnp.full((B,), ctx, jnp.int32)
+    h0 = jax.random.normal(jax.random.PRNGKey(1), (B, H)).astype(jnp.bfloat16)
+
+    inv_freq = rope_frequencies(cfg)
+
+    def make_stack(mode: str):
+        """(h, layers, k_pool, v_pool, table, seq_lens) -> h after
+        REPEAT passes through all L layers at the given ablation level."""
+
+        def layer(h, lay, kc, vc, cos, sin, positions):
+            x = rms_norm(h, lay["ln_attn"], cfg.rms_norm_eps)
+            q = jnp.einsum("bh,hnd->bnd", x, lay["wq"])
+            k = jnp.einsum("bh,hnd->bnd", x, lay["wk"])
+            v = jnp.einsum("bh,hnd->bnd", x, lay["wv"])
+            if mode in ("rope", "attn", "write"):
+                q = apply_rope(q[:, None], cos, sin)[:, 0]
+                k = apply_rope(k[:, None], cos, sin)[:, 0]
+            if mode == "write":
+                write_page = table[jnp.arange(B), seq_lens // ps]
+                widx = write_page * ps + seq_lens % ps
+                kc = kc.at[widx].set(k.reshape(B, Hkv * D))
+                vc = vc.at[widx].set(v.reshape(B, Hkv * D))
+            if mode in ("attn", "write"):
+                from kafka_tpu.ops.pallas import paged_decode_attention
+
+                o = paged_decode_attention(
+                    q, kc, vc, table, seq_lens, page_size=ps)
+            else:
+                o = q  # stand-in with the same shape
+            h = h + jnp.einsum("bnd,ndh->bh", o.astype(x.dtype), lay["wo"])
+            x2 = rms_norm(h, lay["ln_mlp"], cfg.rms_norm_eps)
+            g = jnp.einsum("bh,hf->bf", x2, lay["wg"])
+            u = jnp.einsum("bh,hf->bf", x2, lay["wu"])
+            return h + jnp.einsum("bf,fh->bh", jax.nn.silu(g) * u,
+                                  lay["wd"]), kc, vc
+
+        @jax.jit
+        def fn(h, layers, k_pool, v_pool, table_, seq_lens_):
+            cos, sin = rope_cos_sin(seq_lens_[:, None], inv_freq)
+
+            def one_pass(carry, _):
+                h, kp, vp = carry
+
+                # thread pools per layer via scan over stacked leaves
+                def body(h, xs):
+                    lay, kc, vc = xs
+                    h, kc, vc = layer(h, lay, kc, vc, cos, sin, seq_lens_)
+                    return h, (kc, vc)
+
+                h, (kp, vp) = jax.lax.scan(body, h, (layers, kp, vp))
+                return (h, kp, vp), None
+
+            (h, kp, vp), _ = jax.lax.scan(
+                one_pass, (h, k_pool, v_pool), None, length=REPEAT)
+            return h, kp, vp
+
+        return fn
+
+    state0 = (h0, k_pool, v_pool)
+
+    for mode in ("mm", "rope", "attn", "write"):
+        fn = make_stack(mode)
+        wrapped = lambda st, layers, t, s, fn=fn: fn(
+            st[0], layers, st[1], st[2], t, s)
+        ms = timed(wrapped, state0, (lp, table, seq_lens))
+        print(f"{mode:5s}: {ms:7.3f} ms/pass")
+
+    # head: final norm + logits + argmax
+    embed = params["embed"]
+
+    @jax.jit
+    def head_fn(h, fn_w, emb):
+        def one(h, _):
+            x = rms_norm(h, fn_w, cfg.rms_norm_eps)
+            logits = jnp.einsum("bh,vh->bv", x, emb,
+                                preferred_element_type=jnp.float32)
+            tok = jnp.argmax(logits, axis=-1)
+            # fold the argmax back so the scan carries a dependency
+            return h + (tok[:, None] % 3).astype(h.dtype) * 1e-6, None
+
+        h, _ = jax.lax.scan(one, h, None, length=REPEAT)
+        return h
+
+    ms = timed(head_fn, h0, (params["final_norm"], embed))
+    print(f"head : {ms:7.3f} ms/pass")
+
+
+if __name__ == "__main__":
+    main()

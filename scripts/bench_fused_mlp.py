@@ -1,0 +1,173 @@
+"""A/B the fused-MLP Pallas kernel vs the XLA 3-einsum formulation.
+
+Times a scan over L stacked layers (the decode step's real structure) at
+Llama shapes, pipelined dispatches with one terminal block (the tunnel
+discipline from scripts/profile_decode.py).
+
+Usage: python scripts/bench_fused_mlp.py [--model llama-3.2-1b] [--batch 8]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+# persistent XLA compile cache (same dir the server/bench use): repeat
+# runs skip the 30-70s-per-program compile through the tunnel
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.expanduser("~/.cache/kafka_tpu/xla"))
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+
+from kafka_tpu.models import get_config
+from kafka_tpu.models.quant import quantize_array
+from kafka_tpu.ops.norms import rms_norm
+from kafka_tpu.ops.pallas.fused_mlp import fused_mlp_block, pick_block_f
+
+
+REPEAT = 16  # on-device repetitions of the full layer stack per dispatch
+
+
+def timed(fn, state, weights, steps=32):
+    """Weights ride as ARGUMENTS: a jitted fn that merely closes over
+    GB-scale device arrays embeds them as HLO constants and the compile
+    never finishes (observed: >10 min for a 16-layer scan).
+
+    Timing discipline for the tunneled chip (all three bites taken this
+    session): block_until_ready is LAZY on axon so only a real fetch
+    (np.asarray) syncs; per-dispatch host overhead is ~1 ms so the
+    repetition must live ON DEVICE (fn scans the whole stack REPEAT
+    times per dispatch, ~40 ms of device work); and the fetch RTT is
+    cancelled by differencing two dispatch counts:
+        ms/stack = (T(2n) - T(n)) / (n * REPEAT)
+    """
+    import numpy as np
+
+    def run(n):
+        out = fn(state, *weights)
+        np.asarray(out)  # warm + sync
+        t0 = time.monotonic()
+        for _ in range(n):
+            out = fn(out, *weights)
+        np.asarray(out)  # force the fetch — the only real sync point
+        return time.monotonic() - t0
+
+    n = max(1, steps // REPEAT)
+    run(1)
+    t1 = run(n)
+    t2 = run(2 * n)
+    return (t2 - t1) / (n * REPEAT) * 1e3
+
+
+def repeat_stack(scan_fn):
+    """Wrap a (h, *weights) -> h layer-stack pass: run it REPEAT times in
+    one dispatch (lax.scan over the repetition axis, device-resident)."""
+
+    @jax.jit
+    def fn(h, *weights):
+        def one(h, _):
+            return scan_fn(h, *weights), None
+
+        h, _ = jax.lax.scan(one, h, None, length=REPEAT)
+        return h
+
+    return fn
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="llama-3.2-1b")
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--steps", type=int, default=64)
+    args = ap.parse_args()
+
+    cfg = get_config(args.model)
+    H, F, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
+    B = args.batch
+    print(f"# {cfg.name}: H={H} F={F} L={L} B={B} "
+          f"block_f(bf16)={pick_block_f(H, F, 2)} "
+          f"block_f(int8)={pick_block_f(H, F, 1)}")
+    keys = jax.random.split(jax.random.PRNGKey(0), 4)
+    wg = (jax.random.normal(keys[0], (L, H, F)) * H**-0.5).astype(jnp.bfloat16)
+    wu = (jax.random.normal(keys[1], (L, H, F)) * H**-0.5).astype(jnp.bfloat16)
+    wd = (jax.random.normal(keys[2], (L, F, H)) * F**-0.5).astype(jnp.bfloat16)
+    ln = jnp.ones((L, H), jnp.bfloat16)
+    h0 = jax.random.normal(keys[3], (B, H), jnp.float32).astype(jnp.bfloat16)
+
+    mlp_gb = 3 * L * H * F * 2 / 1e9
+
+    def xla_scan(h, ln, wg, wu, wd):
+        def body(h, lp):
+            lnw, g_, u_, d_ = lp
+            x = rms_norm(h, lnw, cfg.rms_norm_eps)
+            g = jnp.einsum("bh,hf->bf", x, g_)
+            u = jnp.einsum("bh,hf->bf", x, u_)
+            return h + jnp.einsum("bf,fh->bh", jax.nn.silu(g) * u, d_), None
+
+        h, _ = jax.lax.scan(body, h, (ln, wg, wu, wd))
+        return h
+
+    def pallas_scan(h, ln, wg, wu, wd):
+        def body(h, lp):
+            lnw, g_, u_, d_ = lp
+            return fused_mlp_block(
+                h, lnw, g_, u_, d_, eps=cfg.rms_norm_eps
+            ), None
+
+        h, _ = jax.lax.scan(body, h, (ln, wg, wu, wd))
+        return h
+
+    dense_w = (ln, wg, wu, wd)
+    ms = timed(repeat_stack(xla_scan), h0, dense_w, args.steps)
+    print(f"XLA   3-einsum scan : {ms:7.3f} ms  ({mlp_gb / ms * 1e3:6.1f} GB/s)")
+    ms = timed(repeat_stack(pallas_scan), h0, dense_w, args.steps)
+    print(f"Pallas fused scan   : {ms:7.3f} ms  ({mlp_gb / ms * 1e3:6.1f} GB/s)")
+
+    # int8
+    qg = quantize_array(wg, (1,))
+    qu = quantize_array(wu, (1,))
+    qd = quantize_array(wd, (1,))
+    int8_gb = 3 * L * H * F / 1e9
+
+    def xla_scan_q(h, ln, gq, gs, uq, us, dq, ds):
+        def body(h, lp):
+            lnw, gq_, gs_, uq_, us_, dq_, ds_ = lp
+            x = rms_norm(h, lnw, cfg.rms_norm_eps)
+            g = jnp.einsum("bh,hf->bf", x,
+                           (gq_.astype(jnp.bfloat16) * gs_).astype(jnp.bfloat16))
+            u = jnp.einsum("bh,hf->bf", x,
+                           (uq_.astype(jnp.bfloat16) * us_).astype(jnp.bfloat16))
+            return h + jnp.einsum(
+                "bf,fh->bh", jax.nn.silu(g) * u,
+                (dq_.astype(jnp.bfloat16) * ds_).astype(jnp.bfloat16)
+            ), None
+
+        h, _ = jax.lax.scan(body, h, (ln, gq, gs, uq, us, dq, ds))
+        return h
+
+    def pallas_scan_q(h, ln, gq, gs, uq, us, dq, ds):
+        def body(h, lp):
+            lnw, gq_, gs_, uq_, us_, dq_, ds_ = lp
+            return fused_mlp_block(
+                h, lnw, gq_, uq_, dq_, gs_, us_, ds_, eps=cfg.rms_norm_eps
+            ), None
+
+        h, _ = jax.lax.scan(body, h, (ln, gq, gs, uq, us, dq, ds))
+        return h
+
+    q_w = (ln, qg.q, qg.s, qu.q, qu.s, qd.q, qd.s)
+    ms = timed(repeat_stack(xla_scan_q), h0, q_w, args.steps)
+    print(f"XLA   int8 scan     : {ms:7.3f} ms  ({int8_gb / ms * 1e3:6.1f} GB/s)")
+    ms = timed(repeat_stack(pallas_scan_q), h0, q_w, args.steps)
+    print(f"Pallas int8 scan    : {ms:7.3f} ms  ({int8_gb / ms * 1e3:6.1f} GB/s)")
+
+
+if __name__ == "__main__":
+    main()

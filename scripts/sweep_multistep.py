@@ -1,0 +1,74 @@
+"""Sweep EngineConfig.multi_step through real engine decode throughput.
+
+Per-dispatch host+tunnel overhead is amortized over the fused-step depth;
+this measures the end-to-end tok/s (tokens landed on host over wall time —
+the only tunnel-robust metric) at several depths.
+
+Usage: python scripts/sweep_multistep.py [--depths 8,16,24]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.expanduser("~/.cache/kafka_tpu/xla"))
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+
+from kafka_tpu.models import get_config, init_params
+from kafka_tpu.runtime import EngineConfig, GenRequest, InferenceEngine
+
+from bench import decode_phase, make_prompt  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="llama-3.2-1b")
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--prompt-len", type=int, default=128)
+    ap.add_argument("--gen-len", type=int, default=256)
+    ap.add_argument("--depths", default="8,16,24")
+    args = ap.parse_args()
+
+    cfg = get_config(args.model)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    jax.block_until_ready(params)
+    rng = random.Random(0)
+
+    for depth in [int(d) for d in args.depths.split(",")]:
+        ecfg = EngineConfig(
+            max_batch=args.batch, page_size=16,
+            max_pages_per_seq=-(-(args.prompt_len + args.gen_len + 16) // 16),
+            multi_step=depth,
+        )
+        ecfg.num_pages = args.batch * ecfg.max_pages_per_seq + 1
+        eng = InferenceEngine(cfg, params, ecfg)
+        t0 = time.monotonic()
+        eng.generate(make_prompt(rng, args.prompt_len, cfg.vocab_size),
+                     max_new_tokens=2)
+        for i in range(4):
+            eng.submit(GenRequest(
+                request_id=f"w{depth}-{i}",
+                prompt_ids=make_prompt(rng, args.prompt_len, cfg.vocab_size),
+                max_new_tokens=depth + 4))
+        eng.run_to_completion()
+        print(f"depth {depth:3d}: compile {time.monotonic() - t0:5.1f}s",
+              flush=True)
+        tps, sps = decode_phase(eng, cfg, args.batch, args.prompt_len,
+                                args.gen_len, rng)
+        print(f"depth {depth:3d}: {tps:7.1f} tok/s  {sps:6.1f} steps/s",
+              flush=True)
+        del eng
+
+
+if __name__ == "__main__":
+    main()
