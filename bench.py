@@ -27,6 +27,7 @@ Usage: python bench.py [--model llama-3.2-1b] [--quick]
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import random
 import statistics
@@ -677,26 +678,33 @@ def main() -> None:
     # compiled at its static batch width, so reusing a 32-wide engine for a
     # batch of 8 would measure the wrong program) ------------------------
     sweep = {}
+    def sweep_point(secfg, b, label):
+        """Build + warm (incl. the fused multi-step program) + measure one
+        sweep engine; one warmup protocol for every A/B row."""
+        seng = InferenceEngine(cfg, params, secfg)
+        t0 = time.monotonic()
+        seng.generate(prompt(), max_new_tokens=2)
+        for i in range(min(4, b)):
+            seng.submit(GenRequest(request_id=f"warm-{label}-{i}",
+                                   prompt_ids=prompt(),
+                                   max_new_tokens=secfg.multi_step + 4))
+        seng.run_to_completion()
+        log(f"{label} compile: {time.monotonic() - t0:.1f}s")
+        # gen 256: short sweeps absorb the fixed ~RTT drain tail of the
+        # fetch pipeline into tok/s (measured: b16 varied 1.7-2.9k tok/s
+        # at gen 128 purely with tunnel RTT)
+        tps, sps = decode_phase(seng, cfg, b, args.prompt_len, 256, rng)
+        sb = hbm_traffic_per_step(seng, pbytes, b, args.prompt_len + 128)
+        del seng
+        return tps, sps, sb
+
     for b in [int(x) for x in args.batch_sweep.split(",") if x]:
         secfg = EngineConfig(
             max_batch=b, page_size=16,
             max_pages_per_seq=max(2, -(-(args.prompt_len + 256 + 16) // 16)),
         )
         secfg.num_pages = b * secfg.max_pages_per_seq + 1
-        seng = InferenceEngine(cfg, params, secfg)
-        t0 = time.monotonic()
-        seng.generate(prompt(), max_new_tokens=2)
-        for i in range(min(4, b)):  # compile the fused multi-step program
-            seng.submit(GenRequest(request_id=f"warm-b{b}-{i}",
-                                   prompt_ids=prompt(),
-                                   max_new_tokens=secfg.multi_step + 4))
-        seng.run_to_completion()
-        log(f"batch {b} compile: {time.monotonic() - t0:.1f}s")
-        # gen 256: short sweeps absorb the fixed ~RTT drain tail of the
-        # fetch pipeline into tok/s (measured: b16 varied 1.7-2.9k tok/s
-        # at gen 128 purely with tunnel RTT)
-        tps, sps = decode_phase(seng, cfg, b, args.prompt_len, 256, rng)
-        sb = hbm_traffic_per_step(seng, pbytes, b, args.prompt_len + 128)
+        tps, sps, sb = sweep_point(secfg, b, f"b{b}")
         sweep[str(b)] = {
             "decode_tok_s": round(tps, 1),
             "steps_per_s": round(sps, 1),
@@ -705,7 +713,24 @@ def main() -> None:
         }
         log(f"decode b{b}: {tps:.1f} tok/s "
             f"({100 * sb * sps / 1e9 / bw_nominal:.0f}% HBM)")
-        del seng
+
+        if b == 32:
+            # int8 KV at the largest sweep batch: the KV window gather is
+            # the GROWING share of the step at b32 (roofline note), so
+            # this is where halved KV traffic shows (VERDICT r4 #4)
+            kcfg = dataclasses.replace(secfg, kv_quantize="int8")
+            tps, sps, _ = sweep_point(kcfg, b, "b32-int8kv")
+            sweep["32-int8kv"] = {
+                "decode_tok_s": round(tps, 1),
+                "steps_per_s": round(sps, 1),
+                "note": ("per-slot int8 KV pool, page-granular XLA window "
+                         "gather; HALF the KV bytes -> 2x window capacity "
+                         "(planner).  Compare row '32' (bf16 KV, pallas "
+                         "kernel); dev A/B this round: pallas-bf16 4623, "
+                         "xla-bf16 page-gather 4031, int8 page-gather 3822 "
+                         "tok/s (slot-granular gather was 2385)"),
+            }
+            log(f"decode b32 int8-kv: {tps:.1f} tok/s")
 
     # ---- concurrent-thread req/s (BASELINE metric 3): 4x oversubscribed
     # queue of short thread turns through the continuous batcher ----------

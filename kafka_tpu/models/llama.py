@@ -35,7 +35,7 @@ from ..models.config import ModelConfig
 from ..ops.attention import causal_attention
 from ..ops.norms import rms_norm
 from ..ops.rope import apply_rope, rope_cos_sin, rope_frequencies
-from .quant import QTensor, dequantize
+from .quant import QTensor, dequantize, quantize_array
 
 Params = Dict[str, Any]
 
@@ -45,6 +45,57 @@ def _w(lp: Params, name: str, dtype) -> jnp.ndarray:
     XLA fuses the convert into the matmul's operand read, keeping HBM
     traffic int8-sized)."""
     return dequantize(lp[name], dtype)
+
+
+def _kv_write(cache, idx, rows: jnp.ndarray):
+    """Scatter new KV rows into a pool at flat slot indices.
+
+    Dense pool: cast to the pool dtype.  Int8 pool (QTensor, per-slot
+    symmetric scales — runtime/kv_cache.py): quantize each row against its
+    own abs-max so one outlier token cannot flatten the whole window's
+    resolution, store int8 + f32 scale.  The numerics policy (scale floor,
+    rounding, cast order) is models/quant.py's — one recipe for weights
+    and KV.  rows [..., Hkv*D]."""
+    if isinstance(cache, QTensor):
+        qt = quantize_array(rows, (rows.ndim - 1,))
+        return QTensor(q=cache.q.at[idx].set(qt.q),
+                       s=cache.s.at[idx].set(qt.s))
+    return cache.at[idx].set(rows.astype(cache.dtype))
+
+
+def _kv_read(cache, idx, dtype) -> jnp.ndarray:
+    """Gather pool rows at flat indices, dequantizing int8 pools in-graph
+    (the gather reads int8 — HALF the window traffic — and XLA fuses the
+    convert+scale into the consumer, models/quant.py dequantize rounding)."""
+    if isinstance(cache, QTensor):
+        return dequantize(QTensor(q=cache.q[idx], s=cache.s[idx]), dtype)
+    return cache[idx]
+
+
+def _kv_read_pages(cache, page_table: jnp.ndarray, page_size: int,
+                   dtype) -> jnp.ndarray:
+    """Gather a [B, C, Hkv*D] window by PAGE rather than by slot.
+
+    The slot-granular gather moves B*C separate ~1 KB rows — descriptor-
+    bound on TPU (measured: the b32 XLA decode path ran at half the
+    Pallas kernel's rate with the KV bytes nowhere near the roofline).
+    Page-granular gathering moves B*P contiguous page_size-row blocks,
+    16x fewer descriptors at page_size 16.  page_table: [B, P]."""
+    ps = page_size
+    lead = page_table.shape[:-1]
+    if isinstance(cache, QTensor):
+        slots, hd = cache.q.shape
+        # [pages, ps, hd] view keeps the lane axis separate so a
+        # tp-sharded pool's spec propagates through the gather unchanged
+        q = cache.q.reshape(slots // ps, ps, hd)[page_table]
+        s = cache.s.reshape(slots // ps, ps, 1)[page_table]
+        return dequantize(
+            QTensor(q=q.reshape(*lead, -1, hd), s=s.reshape(*lead, -1, 1)),
+            dtype,
+        )
+    slots, hd = cache.shape
+    win = cache.reshape(slots // ps, ps, hd)[page_table]
+    return win.reshape(*lead, -1, hd)
 
 
 class KVCache(NamedTuple):
@@ -159,14 +210,11 @@ def _attention_block(
     k = apply_rope(k, cos, sin)
 
     if paged is not None:
-        # Paged pool: k_cache/v_cache are [TOTAL_SLOTS, Hkv*D] this layer.
+        # Paged pool: k_cache/v_cache are [TOTAL_SLOTS, Hkv*D] this layer
+        # (dense arrays, or QTensor int8+scales when kv_quantize is on).
         b, s, hkv, d = k.shape
-        k_cache = k_cache.at[paged.write_idx].set(
-            k.reshape(b, s, hkv * d).astype(k_cache.dtype)
-        )
-        v_cache = v_cache.at[paged.write_idx].set(
-            v.reshape(b, s, hkv * d).astype(v_cache.dtype)
-        )
+        k_cache = _kv_write(k_cache, paged.write_idx, k.reshape(b, s, hkv * d))
+        v_cache = _kv_write(v_cache, paged.write_idx, v.reshape(b, s, hkv * d))
         if (
             cfg.attention_backend == "pallas"
             and s == 1
@@ -217,8 +265,8 @@ def _attention_block(
                 raise RuntimeError(
                     "prefill_ring requires the mesh (forward(..., mesh=...))"
                 )
-            k_win = k_cache[paged.read_idx].reshape(b, -1, hkv, d)
-            v_win = v_cache[paged.read_idx].reshape(b, -1, hkv, d)
+            k_win = _kv_read(k_cache, paged.read_idx, dt).reshape(b, -1, hkv, d)
+            v_win = _kv_read(v_cache, paged.read_idx, dt).reshape(b, -1, hkv, d)
             ctx_valid = paged.kv_valid & (paged.kv_positions < positions[:, :1])
             cp = (ulysses_prefill_sharded if cfg.cp_strategy == "ulysses"
                   else ring_prefill_sharded)
@@ -226,9 +274,26 @@ def _attention_block(
                 mesh, q, k, v, positions,
                 k_win, v_win, paged.kv_positions, ctx_valid,
             )
+        elif paged.page_table is not None and paged.page_size is not None:
+            # page-granular window gather (see _kv_read_pages: the
+            # slot-granular form is descriptor-bound)
+            k_win = _kv_read_pages(
+                k_cache, paged.page_table, paged.page_size, dt
+            ).reshape(b, -1, hkv, d)
+            v_win = _kv_read_pages(
+                v_cache, paged.page_table, paged.page_size, dt
+            ).reshape(b, -1, hkv, d)
+            out = causal_attention(
+                q,
+                k_win,
+                v_win,
+                q_positions=positions,
+                kv_positions=paged.kv_positions,
+                kv_valid=paged.kv_valid,
+            )
         else:
-            k_win = k_cache[paged.read_idx].reshape(b, -1, hkv, d)
-            v_win = v_cache[paged.read_idx].reshape(b, -1, hkv, d)
+            k_win = _kv_read(k_cache, paged.read_idx, dt).reshape(b, -1, hkv, d)
+            v_win = _kv_read(v_cache, paged.read_idx, dt).reshape(b, -1, hkv, d)
             out = causal_attention(
                 q,
                 k_win,

@@ -126,8 +126,20 @@ def shard_params(params: Params, cfg: ModelConfig, mesh: Mesh) -> Params:
 
 
 def shard_kv_pool(k_pool, v_pool, cfg: ModelConfig, mesh: Mesh):
+    from ..models.quant import QTensor
+
     sh = NamedSharding(mesh, kv_pool_spec(cfg, mesh))
-    return jax.device_put(k_pool, sh), jax.device_put(v_pool, sh)
+
+    def place(pool):
+        if isinstance(pool, QTensor):
+            # int8 pool: rows follow the kv spec; the per-slot scale's
+            # minor dim is 1 (unshardable) — replicate it
+            s_sh = NamedSharding(mesh, P(None, None, None))
+            return QTensor(q=jax.device_put(pool.q, sh),
+                           s=jax.device_put(pool.s, s_sh))
+        return jax.device_put(pool, sh)
+
+    return place(k_pool), place(v_pool)
 
 
 def replicate(tree, mesh: Mesh):

@@ -111,6 +111,11 @@ class EngineConfig:
     # on single-device TPU (when shapes meet its lane-alignment contract)
     # and to the XLA gather path otherwise; "xla"/"pallas" force.
     attention_backend: str = "auto"
+    # KV-cache quantization: "" (model dtype) or "int8" — per-slot
+    # symmetric scales (runtime/kv_cache.py), halving KV window traffic
+    # and doubling pool capacity.  Resolves attention to the XLA gather
+    # path (the Pallas kernel's DMA contract is dense rows).
+    kv_quantize: str = ""
     # Thread-keyed prefix cache capacity (entries); 0 disables.
     prefix_cache_entries: int = 64
     # Context-parallel strategy for sp>1 chunked prefill: "ring" (KV shards
@@ -359,9 +364,17 @@ class InferenceEngine:
                     "flash-prefill kernel: buckets over 64 must be "
                     "multiples of its 64-row q blocks"
                 )
+        if self.ecfg.kv_quantize and self._pp > 1:
+            raise ValueError(
+                "kv_quantize does not compose with pp stage sharding yet: "
+                "the stage splitter slices dense pool arrays"
+            )
         ps = self.ecfg.page_size
         self.pool = PagePool(self.ecfg.num_pages, ps)
-        k_pool, v_pool = make_kv_pool_arrays(cfg, self.ecfg.num_pages, ps, kv_dtype)
+        k_pool, v_pool = make_kv_pool_arrays(
+            cfg, self.ecfg.num_pages, ps, kv_dtype,
+            quantize=self.ecfg.kv_quantize,
+        )
         if mesh is not None:
             # placement happens for ANY mesh, including a 1-device one —
             # that is how DP replicas pin themselves to their own device
@@ -475,6 +488,16 @@ class InferenceEngine:
         formulation (3B at 3072 x 1024 = 6.3 MB compiles and runs).
         """
         choice = ecfg.attention_backend
+        if ecfg.kv_quantize:
+            # int8 KV rows carry per-slot scales the Pallas kernels'
+            # dense-row DMA contract doesn't know about; the XLA gather
+            # dequantizes in-graph (models/llama.py _kv_read)
+            if choice == "pallas":
+                raise ValueError(
+                    "attention_backend='pallas' is incompatible with "
+                    "kv_quantize: the paged kernels DMA dense rows"
+                )
+            return "xla"
         if choice != "auto":
             return choice
         merged_q = cfg.num_heads * cfg.head_dim

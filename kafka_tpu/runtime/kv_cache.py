@@ -18,7 +18,7 @@ reserved as the trash page — inactive batch slots point their writes at it.
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -108,8 +108,9 @@ class PagePool:
 
 
 def make_kv_pool_arrays(
-    cfg: ModelConfig, num_pages: int, page_size: int, dtype=None
-) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    cfg: ModelConfig, num_pages: int, page_size: int, dtype=None,
+    quantize: str = "",
+) -> Tuple[Any, Any]:
     """Allocate the device-side K and V pools.
 
     Layout is [L, TOTAL_SLOTS, Hkv*D] — heads and head_dim merged into the
@@ -117,6 +118,16 @@ def make_kv_pool_arrays(
     for real model shapes, which the Pallas paged-decode kernel requires for
     its page DMAs (Mosaic slices must be lane-tile aligned); the XLA gather
     path just reshapes gathered rows back to [.., Hkv, D].
+
+    quantize="int8" returns each pool as a models.quant.QTensor pytree
+    node: int8 slot rows plus a per-(layer, slot) f32 scale ([L, SLOTS, 1]).
+    Per-slot symmetric quantization halves the KV window's HBM traffic —
+    the growing share of the step at large batch (COVERAGE roofline) — and
+    doubles how many context windows a pool holds (runtime/planner.py).
+    Writes quantize rows in-graph at the attention layer; reads dequantize
+    inside the gather (models/llama.py).  The QTensor shape rides through
+    every jitted program as an ordinary pytree, so the engine's fns don't
+    change signature.
     """
     dtype = dtype or cfg.activation_dtype
     shape = (
@@ -124,6 +135,18 @@ def make_kv_pool_arrays(
         num_pages * page_size,
         cfg.num_kv_heads * cfg.head_dim,
     )
+    if quantize == "int8":
+        from ..models.quant import QTensor
+
+        def pool():
+            return QTensor(
+                q=jnp.zeros(shape, jnp.int8),
+                s=jnp.zeros((shape[0], shape[1], 1), jnp.float32),
+            )
+
+        return pool(), pool()
+    if quantize:
+        raise ValueError(f"unknown kv quantize mode {quantize!r}")
     return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
 
 

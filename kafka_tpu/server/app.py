@@ -135,6 +135,7 @@ def build_tpu_provider(cfg: ServingConfig) -> LLMProvider:
         max_new_tokens_default=cfg.max_new_tokens_default,
         cp_strategy=cfg.cp_strategy,
         multi_step=cfg.multi_step,
+        kv_quantize=cfg.kv_quantize,
     )
     # Memory-fit validation (runtime/planner.py): per-device bytes under
     # the actual sharding rules, against the live device's HBM.  When the

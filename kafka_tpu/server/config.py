@@ -21,6 +21,10 @@ class ServingConfig:
     # weight-only quantization: "" (bf16) or "int8" (models/quant.py) —
     # halves decode weight traffic and fits Llama-3-8B on one v5e chip
     quantize: str = ""
+    # KV-cache quantization: "" or "int8" (per-slot scales,
+    # runtime/kv_cache.py) — halves KV window traffic and doubles how many
+    # context windows a pool holds; attention runs the XLA gather path
+    kv_quantize: str = ""
     # engine shape
     max_batch: int = 8
     page_size: int = 16
@@ -137,6 +141,7 @@ class ServingConfig:
             tiny_model=get("TINY_MODEL", "0") in ("1", "true", "True"),
             system_prompt=get("SYSTEM_PROMPT", None),
             quantize=get("QUANTIZE", cls.quantize),
+            kv_quantize=get("KV_QUANTIZE", cls.kv_quantize),
             warmup=get("WARMUP", "1") not in ("0", "false", "False"),
             compile_cache_dir=get("COMPILE_CACHE", cls.compile_cache_dir),
         )
