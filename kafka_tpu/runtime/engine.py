@@ -173,6 +173,16 @@ class GenRequest:
     finish_reason: Optional[str] = None
     submit_time: float = 0.0
     first_token_time: Optional[float] = None
+    # TTFT decomposition stamps (VERDICT r4 #5): queue wait ends when the
+    # first prefill chunk dispatches; prefill ends when the first token is
+    # sampled on device; the remainder to first_token_time is fetch/drain
+    # (transfer landing + emission runway) — the tunnel-conditioned part.
+    t_prefill_start: Optional[float] = None
+    t_first_dispatch: Optional[float] = None
+    # Genuine constrained choice points that awaited a device->host round
+    # trip (forced-singleton tokens chain without one) — the number that
+    # turns "tunnel RTT dominates agent calls" into arithmetic.
+    constrained_roundtrips: int = 0
     # tokens sampled on device / processed on host (emission lags dispatch
     # by up to fetch_lag steps)
     dispatched: int = 0
@@ -1028,6 +1038,10 @@ class InferenceEngine:
             self.metrics.record_first_token(
                 req.first_token_time - req.submit_time
             )
+            self.metrics.record_ttft_breakdown(
+                req.submit_time, req.t_prefill_start,
+                req.t_first_dispatch, req.first_token_time,
+            )
         self.metrics.record_token()
         if token in req.stop_token_ids:
             reason = "stop"
@@ -1235,6 +1249,8 @@ class InferenceEngine:
         The lane is masked out of decode (state PREFILLING) until the last
         chunk lands; decode for other lanes proceeds between chunks.
         """
+        if req.t_prefill_start is None:  # keep the FIRST start on resume
+            req.t_prefill_start = time.monotonic()
         req.seq = req.seq or SequencePages(seq_id=req.request_id)
         self.pool.ensure_capacity(req.seq, len(req.prefill_ids) + 1)
         # constrained decoding: the mask depends only on output_ids, which
@@ -1420,6 +1436,8 @@ class InferenceEngine:
         awaiting a slot when it prefilled off-slot)."""
         slot = req.slot
         req.prefill_allowed = None
+        if req.t_first_dispatch is None:
+            req.t_first_dispatch = time.monotonic()
         if slot < 0:
             req.state = PARKED
             if req.resumed:
@@ -1656,6 +1674,12 @@ class InferenceEngine:
                 amb_m, d_act, allowed_arr, full=False
             )
             n_amb_dispatched = n_amb
+            for m in amb_m:
+                if m is not None:
+                    # this lane now awaits a device->host round trip for
+                    # its next mask: a genuine choice point
+                    m.constrained_roundtrips += 1
+                    self.metrics.constrained_roundtrips += 1
         if n_uncon or n_chain or n_amb_dispatched:
             # one scheduler iteration = one TPOT sample / occupancy record,
             # however many dispatch groups it took (group dispatches land

@@ -52,6 +52,52 @@ def engine():
     )
 
 
+class TestTTFTBreakdown:
+    def test_phases_sum_to_ttft_and_export(self, engine):
+        req = engine.generate([5, 9, 23, 4], max_new_tokens=4)
+        assert req.t_prefill_start is not None
+        assert req.t_first_dispatch is not None
+        snap = engine.metrics.snapshot(engine)
+        bd = snap["ttft_breakdown_ms"]
+        assert set(bd) == {"queue_wait", "prefill", "first_fetch"}
+        # the three phases reassemble the recorded TTFT exactly (all four
+        # numbers derive from the same stamps; single request -> p50 is
+        # that request) — catches unit mismatches and swapped stamps
+        total = bd["queue_wait"]["p50"] + bd["prefill"]["p50"] \
+            + bd["first_fetch"]["p50"]
+        assert total == pytest.approx(snap["ttft_ms"]["p50"], abs=0.05)
+
+    def test_missing_stamp_records_nothing(self):
+        m = EngineMetrics()
+        m.record_ttft_breakdown(1.0, None, 2.0, 3.0)
+        assert len(m.ttft_queue_ms) == 0
+
+    def test_forced_grammar_chains_without_roundtrips(self, engine):
+        """A fully-forced grammar (singleton masks) never awaits a round
+        trip; a genuinely ambiguous mask does.  The counter separates
+        them — the arithmetic behind the on-prem latency projection."""
+        rt0 = engine.metrics.constrained_roundtrips
+        forced = [7, 8, 9, 10]
+        req = engine.generate(
+            [3, 5, 2], max_new_tokens=4,
+            logits_mask_fn=lambda out: [forced[len(out)]]
+            if len(out) < 4 else None,
+        )
+        assert req.output_ids == forced
+        assert req.constrained_roundtrips == 0
+        assert engine.metrics.constrained_roundtrips == rt0
+
+        rt0 = engine.metrics.constrained_roundtrips
+        req = engine.generate(
+            [3, 5, 2], max_new_tokens=3,
+            logits_mask_fn=lambda out: [11, 12, 13],  # always ambiguous
+        )
+        # token 1's mask rides the prefill dispatch (no extra trip);
+        # tokens 2 and 3 each await the previous token back — 2 trips
+        assert req.constrained_roundtrips == 2
+        assert engine.metrics.constrained_roundtrips == rt0 + 2
+
+
 class TestEngineRecording:
     def test_generation_populates_counters(self, engine):
         for i in range(3):
