@@ -98,3 +98,43 @@ class DBClient(abc.ABC):
     @abc.abstractmethod
     async def get_or_create_vm_api_key(self, thread_id: str) -> str:
         """Stable per-thread API key injected into sandbox claims."""
+
+    # -- user/session auth (reference: Supabase email sessions,
+    # playground/src/components/auth-provider.tsx; here the user store is
+    # a DB tier concern with the same client split: sqlite locally,
+    # PostgREST remotely).  Non-abstract: a client without a user store
+    # raises and the server's auth endpoints answer 501.
+
+    async def create_user(self, email: str, password_hash: str,
+                          salt: str) -> str:
+        """Create a user; returns user_id.  Raises ValueError if the
+        email is taken."""
+        raise NotImplementedError("this DB client has no user store")
+
+    async def get_user_by_email(self, email: str) -> Optional[Dict[str, Any]]:
+        """{user_id, email, password_hash, salt} or None."""
+        raise NotImplementedError("this DB client has no user store")
+
+    async def create_session(self, user_id: str, token: str,
+                             expires_at: float) -> None:
+        raise NotImplementedError("this DB client has no user store")
+
+    async def get_session_user(self, token: str) -> Optional[str]:
+        """user_id for a live session token, or None (missing/expired)."""
+        raise NotImplementedError("this DB client has no user store")
+
+    async def set_thread_owner(self, thread_id: str, user_id: str) -> None:
+        raise NotImplementedError("this DB client has no user store")
+
+    async def get_thread_owner(self, thread_id: str) -> Optional[str]:
+        raise NotImplementedError("this DB client has no user store")
+
+    async def list_threads_for_user(
+        self, user_id: str
+    ) -> List[Dict[str, Any]]:
+        """Threads owned by user_id (the playground sidebar scope)."""
+        raise NotImplementedError("this DB client has no user store")
+
+    async def list_threads_unowned(self) -> List[Dict[str, Any]]:
+        """Threads with no owner (what anonymous requests may list)."""
+        raise NotImplementedError("this DB client has no user store")

@@ -28,7 +28,7 @@ from typing import Any, Dict, List, Optional
 
 from .base import DBClient
 
-_SCHEMA_VERSION = 1
+_SCHEMA_VERSION = 2
 
 _DDL = """
 CREATE TABLE IF NOT EXISTS threads (
@@ -57,6 +57,19 @@ CREATE TABLE IF NOT EXISTS profiles (
     name TEXT NOT NULL,
     config TEXT NOT NULL DEFAULT '{}',
     created_at REAL NOT NULL
+);
+CREATE TABLE IF NOT EXISTS users (
+    user_id TEXT PRIMARY KEY,
+    email TEXT NOT NULL UNIQUE,
+    password_hash TEXT NOT NULL,
+    salt TEXT NOT NULL,
+    created_at REAL NOT NULL
+);
+CREATE TABLE IF NOT EXISTS sessions (
+    token TEXT PRIMARY KEY,
+    user_id TEXT NOT NULL,
+    created_at REAL NOT NULL,
+    expires_at REAL NOT NULL
 );
 """
 
@@ -88,6 +101,11 @@ class LocalDBClient(DBClient):
         conn.execute("PRAGMA journal_mode=WAL")
         conn.execute("PRAGMA synchronous=NORMAL")
         conn.executescript(_DDL)
+        # v1 -> v2: thread ownership for session auth (nullable — threads
+        # created without a session stay anonymous)
+        cols = {r[1] for r in conn.execute("PRAGMA table_info(threads)")}
+        if "user_id" not in cols:
+            conn.execute("ALTER TABLE threads ADD COLUMN user_id TEXT")
         conn.execute(f"PRAGMA user_version={_SCHEMA_VERSION}")
         conn.commit()
         self._conn = conn
@@ -118,6 +136,16 @@ class LocalDBClient(DBClient):
         return await asyncio.to_thread(self._execute, sql, params, fetch)
 
     # -- threads -------------------------------------------------------
+
+    @staticmethod
+    def _thread_row(r) -> Dict[str, Any]:
+        return {
+            "thread_id": r["thread_id"],
+            "created_at": r["created_at"],
+            "updated_at": r["updated_at"],
+            "metadata": json.loads(r["metadata"]),
+            "sandbox_id": r["sandbox_id"],
+        }
 
     async def create_thread(
         self,
@@ -161,16 +189,7 @@ class LocalDBClient(DBClient):
             "FROM threads ORDER BY updated_at DESC",
             (), "all",
         )
-        return [
-            {
-                "thread_id": r["thread_id"],
-                "created_at": r["created_at"],
-                "updated_at": r["updated_at"],
-                "metadata": json.loads(r["metadata"]),
-                "sandbox_id": r["sandbox_id"],
-            }
-            for r in rows
-        ]
+        return [self._thread_row(r) for r in rows]
 
     async def delete_thread(self, thread_id: str) -> None:
         await self._run("DELETE FROM messages WHERE thread_id=?", (thread_id,))
@@ -316,3 +335,73 @@ class LocalDBClient(DBClient):
             (thread_id,), "one",
         )
         return row["api_key"]
+
+    # -- users / sessions (playground auth; base.py contract) -----------
+
+    async def create_user(self, email: str, password_hash: str,
+                          salt: str) -> str:
+        uid = f"user_{uuid.uuid4().hex[:24]}"
+        try:
+            await self._run(
+                "INSERT INTO users (user_id, email, password_hash, salt, "
+                "created_at) VALUES (?,?,?,?,?)",
+                (uid, email.lower(), password_hash, salt, time.time()),
+            )
+        except sqlite3.IntegrityError:
+            raise ValueError(f"email already registered: {email}")
+        return uid
+
+    async def get_user_by_email(self, email: str):
+        row = await self._run(
+            "SELECT * FROM users WHERE email=?", (email.lower(),), "one"
+        )
+        if row is None:
+            return None
+        return {"user_id": row["user_id"], "email": row["email"],
+                "password_hash": row["password_hash"], "salt": row["salt"]}
+
+    async def create_session(self, user_id: str, token: str,
+                             expires_at: float) -> None:
+        await self._run(
+            "INSERT INTO sessions (token, user_id, created_at, expires_at) "
+            "VALUES (?,?,?,?)",
+            (token, user_id, time.time(), expires_at),
+        )
+
+    async def get_session_user(self, token: str):
+        row = await self._run(
+            "SELECT user_id, expires_at FROM sessions WHERE token=?",
+            (token,), "one",
+        )
+        if row is None or row["expires_at"] < time.time():
+            return None
+        return row["user_id"]
+
+    async def set_thread_owner(self, thread_id: str, user_id: str) -> None:
+        await self._run(
+            "UPDATE threads SET user_id=? WHERE thread_id=?",
+            (user_id, thread_id),
+        )
+
+    async def get_thread_owner(self, thread_id: str):
+        row = await self._run(
+            "SELECT user_id FROM threads WHERE thread_id=?",
+            (thread_id,), "one",
+        )
+        return row["user_id"] if row is not None else None
+
+    async def list_threads_for_user(self, user_id: str):
+        rows = await self._run(
+            "SELECT thread_id, created_at, updated_at, metadata, sandbox_id "
+            "FROM threads WHERE user_id=? ORDER BY updated_at DESC",
+            (user_id,), "all",
+        )
+        return [self._thread_row(r) for r in rows]
+
+    async def list_threads_unowned(self):
+        rows = await self._run(
+            "SELECT thread_id, created_at, updated_at, metadata, sandbox_id "
+            "FROM threads WHERE user_id IS NULL ORDER BY updated_at DESC",
+            (), "all",
+        )
+        return [self._thread_row(r) for r in rows]

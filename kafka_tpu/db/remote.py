@@ -426,3 +426,86 @@ class RemoteDBClient(DBClient):
         except httpx.HTTPError:
             pass  # key still usable for this process
         return key
+
+    # -- users / sessions (db/base.py user-store contract) ---------------
+    # PostgREST tables `users` and `sessions` mirror the local schema
+    # (db/local.py DDL); the reference kept these inside Supabase's auth
+    # service — here they are ordinary rows the same REST dialect reaches.
+
+    async def create_user(self, email: str, password_hash: str,
+                          salt: str) -> str:
+        uid = f"user_{uuid.uuid4().hex[:24]}"
+        try:
+            await self._insert("users", [{
+                "user_id": uid, "email": email.lower(),
+                "password_hash": password_hash, "salt": salt,
+                "created_at": _now_iso(),
+            }])
+        except httpx.HTTPStatusError as e:
+            if e.response.status_code == 409:  # unique(email) violation
+                raise ValueError(f"email already registered: {email}")
+            raise
+        return uid
+
+    async def get_user_by_email(self, email: str):
+        rows = await self._select("users", {"email": email.lower()}, limit=1)
+        if not rows:
+            return None
+        r = rows[0]
+        return {"user_id": r["user_id"], "email": r["email"],
+                "password_hash": r["password_hash"], "salt": r["salt"]}
+
+    async def create_session(self, user_id: str, token: str,
+                             expires_at: float) -> None:
+        # timestamptz columns want ISO (module convention, _now_iso):
+        # convert the contract's epoch float before insert
+        iso = datetime.datetime.fromtimestamp(
+            expires_at, tz=datetime.timezone.utc
+        ).isoformat()
+        await self._insert("sessions", [{
+            "token": token, "user_id": user_id,
+            "created_at": _now_iso(), "expires_at": iso,
+        }])
+
+    async def get_session_user(self, token: str):
+        rows = await self._select("sessions", {"token": token}, limit=1)
+        if not rows:
+            return None
+        raw = rows[0]["expires_at"]
+        try:
+            exp = float(raw)  # double-precision schema
+        except (TypeError, ValueError):
+            exp = datetime.datetime.fromisoformat(
+                str(raw).replace("Z", "+00:00")
+            ).timestamp()
+        if exp < time.time():
+            return None
+        return rows[0]["user_id"]
+
+    async def set_thread_owner(self, thread_id: str, user_id: str) -> None:
+        await self._update(
+            self.threads_table, {"id": thread_id}, {"user_id": user_id}
+        )
+
+    async def get_thread_owner(self, thread_id: str):
+        rows = await self._select(
+            self.threads_table, {"id": thread_id}, select="user_id", limit=1
+        )
+        return rows[0].get("user_id") if rows else None
+
+    async def list_threads_for_user(self, user_id: str):
+        rows = await self._select(
+            self.threads_table, {"user_id": user_id},
+            order="updated_at.desc",
+        )
+        return [self._thread_row(r) for r in rows]
+
+    async def list_threads_unowned(self):
+        # null filter is `is.null`, not `eq.` — built outside _select
+        r = await self._client.get(
+            self._table(self.threads_table),
+            params={"select": "*", "user_id": "is.null",
+                    "order": "updated_at.desc"},
+        )
+        r.raise_for_status()
+        return [self._thread_row(row) for row in r.json()]

@@ -369,21 +369,44 @@ def cors_middleware(origins: str):
 
 
 def auth_middleware(api_token: Optional[str]):
-    """Optional bearer-token auth (ServingConfig.api_token).
+    """Two bearer tiers: the static machine token (ServingConfig.api_token)
+    and per-user SESSION tokens from /v1/auth/login (`sess_…`, stored in
+    the DB tier — db/base.py user-store contract; reference: Supabase
+    email sessions, playground/src/components/auth-provider.tsx:19-40).
 
-    When a token is configured, every /v1/*, /metrics and /debug route
-    requires `Authorization: Bearer <token>`; /health and /playground stay
-    open (the playground page itself prompts for the token and sends it on
-    its API calls — reference playground/src/components/auth-provider.tsx
-    gates the same surface behind Supabase auth).  No token configured =
-    open server, the reference's local-dev default.
+    A valid session resolves request["user_id"] (thread ownership scoping)
+    and also satisfies the api_token gate — humans log in, machines carry
+    the static token.  An invalid/expired session 401s even on an
+    otherwise-open server: a client that presents credentials must not be
+    silently downgraded to anonymous.  /health, /playground and /v1/auth/
+    login stay open; SIGNUP runs under the api_token gate when one is
+    configured (an open signup would mint sessions that bypass the static
+    token — accounts on a closed instance are operator-provisioned, the
+    invite model).  No api_token configured = anonymous access allowed,
+    the reference's local-dev default.
     """
-    open_paths = ("/health", "/playground")
+    open_paths = ("/health", "/playground", "/v1/auth/login")
 
     @web.middleware
     async def mw(request: web.Request, handler):
-        if api_token and request.path not in open_paths:
-            supplied = request.headers.get("Authorization", "")
+        if request.path in open_paths:
+            return await handler(request)
+        supplied = request.headers.get("Authorization", "")
+        if supplied.startswith("Bearer sess_"):
+            token = supplied[len("Bearer "):]
+            try:
+                user_id = await _state(request)["db"].get_session_user(token)
+            except NotImplementedError:
+                user_id = None
+            if user_id is None:
+                return web.json_response(
+                    {"error": {"message": "invalid or expired session",
+                               "type": "authentication_error"}},
+                    status=401,
+                )
+            request["user_id"] = user_id
+            return await handler(request)
+        if api_token:
             # compare as bytes: compare_digest raises TypeError on non-ASCII
             # str inputs, which would turn a malformed credential into a 500
             if not hmac.compare_digest(
@@ -416,6 +439,8 @@ def _add_routes(app: web.Application) -> None:
     r.add_get("/v1/profiles", list_profiles)
     r.add_post("/v1/profiles", create_profile)
     r.add_get("/v1/models", list_models)
+    r.add_post("/v1/auth/signup", auth_signup)
+    r.add_post("/v1/auth/login", auth_login)
     r.add_get("/health", health)
     r.add_get("/metrics", metrics)
     r.add_post("/debug/profile", capture_profile)
@@ -602,6 +627,7 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
 
 async def thread_chat_completions(request: web.Request) -> web.StreamResponse:
     thread_id = request.match_info["thread_id"]
+    await _check_thread_owner(request, thread_id, create=True)
     body = await _parse(request, ChatCompletionRequest)
     events = _agent_events(request, body, thread_id=thread_id)
     if body.stream:
@@ -618,6 +644,7 @@ async def agent_run(request: web.Request) -> web.StreamResponse:
 
 async def thread_agent_run(request: web.Request) -> web.StreamResponse:
     thread_id = request.match_info["thread_id"]
+    await _check_thread_owner(request, thread_id, create=True)
     body = await _parse(request, AgentRunRequest)
     return await sse_response(
         request, _agent_events(request, body, thread_id=thread_id)
@@ -654,6 +681,11 @@ async def create_thread(request: web.Request) -> web.Response:
     tid = await db.create_thread(
         thread_id=body.get("thread_id"), metadata=body.get("metadata")
     )
+    if request.get("user_id") is not None:
+        try:
+            await db.set_thread_owner(tid, request["user_id"])
+        except NotImplementedError:
+            pass
     if profile is not None:
         await db.set_thread_config(
             tid, {**profile["config"], "profile_id": pid}
@@ -700,7 +732,49 @@ async def create_profile(request: web.Request) -> web.Response:
 
 async def list_threads(request: web.Request) -> web.Response:
     db = _state(request)["db"]
-    return web.json_response({"threads": await db.list_threads()})
+    user = request.get("user_id")
+    if user is not None:
+        # per-user sidebar scope (reference: sidebar.tsx:40-80 filters by
+        # the Supabase session user)
+        return web.json_response(
+            {"threads": await db.list_threads_for_user(user)}
+        )
+    try:
+        # anonymous requests see only unowned threads
+        threads = await db.list_threads_unowned()
+    except NotImplementedError:  # backend without a user store: all open
+        threads = await db.list_threads()
+    return web.json_response({"threads": threads})
+
+
+async def _check_thread_owner(request: web.Request, tid: str,
+                              create: bool = False) -> None:
+    """Enforce/establish thread ownership for session users.
+
+    Another user's thread answers 404 (existence is not leaked — the
+    reference's per-user Supabase listing has the same property).  A
+    session user touching an unowned-or-new thread claims it; anonymous
+    requests see only unowned threads.  DB clients without a user store
+    skip enforcement entirely (the pre-auth behavior).
+    """
+    db = _state(request)["db"]
+    user = request.get("user_id")
+    try:
+        owner = await db.get_thread_owner(tid)
+    except NotImplementedError:
+        return
+    if owner is not None and owner != user:
+        raise web.HTTPNotFound(
+            text=f'{{"error": "thread {tid} not found"}}',
+            content_type="application/json",
+        )
+    # claiming happens only on WRITE paths (create=True: chat/agent run) —
+    # a mere GET of an unowned thread must not transfer its ownership away
+    # from the anonymous client that created it
+    if create and user is not None and owner is None:
+        if not await db.thread_exists(tid):
+            await db.create_thread(tid)
+        await db.set_thread_owner(tid, user)
 
 
 async def _require_thread(request: web.Request) -> str:
@@ -711,6 +785,7 @@ async def _require_thread(request: web.Request) -> str:
             text=f'{{"error": "thread {tid} not found"}}',
             content_type="application/json",
         )
+    await _check_thread_owner(request, tid)
     return tid
 
 
@@ -748,6 +823,87 @@ async def set_thread_config(request: web.Request) -> web.Response:
 # ---------------------------------------------------------------------------
 # models / health
 # ---------------------------------------------------------------------------
+
+
+async def _session_response(db, user_id: str, email: str) -> web.Response:
+    from .auth import new_session_token, session_expiry
+
+    token = new_session_token()
+    await db.create_session(user_id, token, session_expiry())
+    return web.json_response(
+        {"token": token, "user_id": user_id, "email": email}
+    )
+
+
+async def _auth_body(request: web.Request) -> tuple:
+    try:
+        body = await request.json()
+        assert isinstance(body, dict)
+    except Exception:
+        raise web.HTTPBadRequest(
+            text='{"error": "invalid JSON body"}',
+            content_type="application/json",
+        )
+    return ((body.get("email") or "").strip().lower(),
+            body.get("password") or "")
+
+
+async def auth_signup(request: web.Request) -> web.Response:
+    """Create a user + open a session (reference: Supabase email signup)."""
+    import asyncio as _asyncio
+
+    from .auth import hash_password, new_salt
+
+    db = _state(request)["db"]
+    email, password = await _auth_body(request)
+    if "@" not in email or len(password) < 6:
+        raise web.HTTPBadRequest(
+            text='{"error": "need a valid email and a password of 6+ chars"}',
+            content_type="application/json",
+        )
+    salt = new_salt()
+    # scrypt is ~50ms of CPU: off the event loop, or every in-flight SSE
+    # stream hiccups for the duration
+    pw_hash = await _asyncio.to_thread(hash_password, password, salt)
+    try:
+        user_id = await db.create_user(email, pw_hash, salt)
+    except ValueError:
+        return web.json_response(
+            {"error": {"message": "email already registered",
+                       "type": "invalid_request_error"}},
+            status=409,
+        )
+    except NotImplementedError:
+        raise web.HTTPNotImplemented(
+            text='{"error": "this DB backend has no user store"}',
+            content_type="application/json",
+        )
+    return await _session_response(db, user_id, email)
+
+
+async def auth_login(request: web.Request) -> web.Response:
+    import asyncio as _asyncio
+
+    from .auth import verify_password
+
+    db = _state(request)["db"]
+    email, password = await _auth_body(request)
+    try:
+        user = await db.get_user_by_email(email)
+    except NotImplementedError:
+        raise web.HTTPNotImplemented(
+            text='{"error": "this DB backend has no user store"}',
+            content_type="application/json",
+        )
+    if user is None or not await _asyncio.to_thread(
+        verify_password, password, user["salt"], user["password_hash"]
+    ):
+        return web.json_response(
+            {"error": {"message": "invalid email or password",
+                       "type": "authentication_error"}},
+            status=401,
+        )
+    return await _session_response(db, user["user_id"], user["email"])
 
 
 async def list_models(request: web.Request) -> web.Response:

@@ -1,0 +1,256 @@
+"""Playground session auth (VERDICT r4 next #8).
+
+Reference: the playground authenticated users via Supabase email sessions
+and listed only the session user's threads
+(playground/src/components/auth-provider.tsx:19-40, sidebar.tsx:40-80).
+Here: /v1/auth/signup + /v1/auth/login against the DB tier's user store
+(scrypt passwords, urlsafe session tokens), session bearers resolving to
+request user, thread ownership binding on touch, and per-user listing.
+"""
+
+import asyncio
+
+import pytest
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from kafka_tpu.db.local import LocalDBClient
+from kafka_tpu.server import ServingConfig, create_app
+from kafka_tpu.server.auth import hash_password, verify_password, new_salt
+
+
+@pytest.fixture()
+def app_client(tmp_path):
+    """Server over a tiny model + local DB; yields an async-callable."""
+
+    async def make():
+        cfg = ServingConfig(
+            tiny_model=True, db_path=str(tmp_path / "auth.db"),
+            max_batch=2, page_size=16, num_pages=160,
+            max_pages_per_seq=64, prefill_buckets=(256,),
+            max_new_tokens_default=4, warmup=False,
+            system_prompt="test",
+        )
+        app = await create_app(cfg=cfg, tools=[], mcp_servers=[])
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        return client
+
+    return make
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+class TestPasswordPrimitives:
+    def test_hash_verify_roundtrip(self):
+        salt = new_salt()
+        h = hash_password("hunter42", salt)
+        assert verify_password("hunter42", salt, h)
+        assert not verify_password("hunter43", salt, h)
+        # per-user salts: same password, different hash
+        assert hash_password("hunter42", new_salt()) != h
+
+
+class TestSessionsOverHTTP:
+    def test_signup_login_and_scoped_threads(self, app_client):
+        async def go():
+            client = await app_client()
+            try:
+                # -- signup opens a session
+                r = await client.post("/v1/auth/signup", json={
+                    "email": "ada@example.com", "password": "lovelace"})
+                assert r.status == 200, await r.text()
+                sess_a = await r.json()
+                assert sess_a["token"].startswith("sess_")
+                ha = {"Authorization": f"Bearer {sess_a['token']}"}
+
+                # -- duplicate email -> 409
+                r = await client.post("/v1/auth/signup", json={
+                    "email": "ada@example.com", "password": "xxxxxx"})
+                assert r.status == 409
+
+                # -- wrong password -> 401
+                r = await client.post("/v1/auth/login", json={
+                    "email": "ada@example.com", "password": "wrong!"})
+                assert r.status == 401
+
+                # -- login works and issues a fresh token
+                r = await client.post("/v1/auth/login", json={
+                    "email": "ada@example.com", "password": "lovelace"})
+                assert r.status == 200
+                assert (await r.json())["token"] != sess_a["token"]
+
+                # -- a second user
+                r = await client.post("/v1/auth/signup", json={
+                    "email": "bob@example.com", "password": "builder"})
+                hb = {"Authorization":
+                      f"Bearer {(await r.json())['token']}"}
+
+                # -- ada creates a thread (bound to her session)
+                r = await client.post("/v1/threads",
+                                      json={"thread_id": "t-ada"},
+                                      headers=ha)
+                assert r.status == 201
+
+                # -- listings are scoped per user
+                r = await client.get("/v1/threads", headers=ha)
+                tids = [t["thread_id"] for t in (await r.json())["threads"]]
+                assert tids == ["t-ada"]
+                r = await client.get("/v1/threads", headers=hb)
+                assert (await r.json())["threads"] == []
+
+                # -- bob cannot see or delete ada's thread (404, unleaked)
+                r = await client.get("/v1/threads/t-ada", headers=hb)
+                assert r.status == 404
+                r = await client.delete("/v1/threads/t-ada", headers=hb)
+                assert r.status == 404
+                r = await client.get("/v1/threads/t-ada", headers=ha)
+                assert r.status == 200
+
+                # -- invalid session token 401s even on an open server
+                r = await client.get("/v1/threads", headers={
+                    "Authorization": "Bearer sess_bogus"})
+                assert r.status == 401
+
+                # -- anonymous requests see only unowned threads
+                r = await client.get("/v1/threads")
+                assert (await r.json())["threads"] == []
+            finally:
+                await client.close()
+
+        run(go())
+
+    def test_read_does_not_claim_anonymous_thread(self, app_client):
+        """A GET by a logged-in user must not transfer ownership of an
+        anonymous client's thread (claiming is write-path only)."""
+        async def go():
+            client = await app_client()
+            try:
+                r = await client.post("/v1/threads",
+                                      json={"thread_id": "t-anon"})
+                assert r.status == 201
+                r = await client.post("/v1/auth/signup", json={
+                    "email": "spy@example.com", "password": "looking"})
+                h = {"Authorization": f"Bearer {(await r.json())['token']}"}
+                r = await client.get("/v1/threads/t-anon", headers=h)
+                assert r.status == 200  # unowned: visible
+                # still unowned — the anonymous creator keeps access
+                r = await client.get("/v1/threads")
+                assert "t-anon" in [
+                    t["thread_id"] for t in (await r.json())["threads"]]
+            finally:
+                await client.close()
+
+        run(go())
+
+    def test_signup_gated_by_api_token_on_closed_instance(self, tmp_path):
+        """With a static api_token configured, open signup would mint
+        sessions that bypass it — signup requires the token (invite
+        model); login stays open."""
+        from kafka_tpu.server import create_app as mk
+
+        async def go():
+            cfg = ServingConfig(
+                tiny_model=True, db_path=str(tmp_path / "closed.db"),
+                max_batch=2, page_size=16, num_pages=160,
+                max_pages_per_seq=64, prefill_buckets=(256,),
+                warmup=False, api_token="machine-secret",
+            )
+            app = await mk(cfg=cfg, tools=[], mcp_servers=[])
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                r = await client.post("/v1/auth/signup", json={
+                    "email": "a@b.c", "password": "longenough"})
+                assert r.status == 401  # no api_token presented
+                r = await client.post(
+                    "/v1/auth/signup",
+                    json={"email": "a@b.c", "password": "longenough"},
+                    headers={"Authorization": "Bearer machine-secret"})
+                assert r.status == 200
+                token = (await r.json())["token"]
+                # the session satisfies the gate (it was minted under it)
+                r = await client.get(
+                    "/v1/threads",
+                    headers={"Authorization": f"Bearer {token}"})
+                assert r.status == 200
+                # login itself stays open (password is the credential)
+                r = await client.post("/v1/auth/login", json={
+                    "email": "a@b.c", "password": "longenough"})
+                assert r.status == 200
+            finally:
+                await client.close()
+
+        run(go())
+
+    def test_chat_binds_thread_to_session_user(self, app_client):
+        async def go():
+            client = await app_client()
+            try:
+                r = await client.post("/v1/auth/signup", json={
+                    "email": "eve@example.com", "password": "streams"})
+                h = {"Authorization": f"Bearer {(await r.json())['token']}"}
+                r = await client.post(
+                    "/v1/threads/t-chat/chat/completions",
+                    json={"model": "tiny", "max_tokens": 3,
+                          "messages": [{"role": "user", "content": "hi"}]},
+                    headers=h,
+                )
+                assert r.status == 200, await r.text()
+                await r.json()
+                r = await client.get("/v1/threads", headers=h)
+                tids = [t["thread_id"] for t in (await r.json())["threads"]]
+                assert "t-chat" in tids
+                # anonymous listing does not include it
+                r = await client.get("/v1/threads")
+                assert "t-chat" not in [
+                    t["thread_id"] for t in (await r.json())["threads"]]
+            finally:
+                await client.close()
+
+        run(go())
+
+
+class TestDBUserStore:
+    def test_local_sessions_expire(self, tmp_path):
+        async def go():
+            db = LocalDBClient(str(tmp_path / "u.db"))
+            await db.initialize()
+            uid = await db.create_user("x@y.z", "h", "s")
+            await db.create_session(uid, "sess_live", 2e12)
+            await db.create_session(uid, "sess_dead", 1.0)
+            assert await db.get_session_user("sess_live") == uid
+            assert await db.get_session_user("sess_dead") is None
+            assert await db.get_session_user("sess_missing") is None
+            await db.close()
+
+        run(go())
+
+    def test_migration_adds_user_id_to_existing_db(self, tmp_path):
+        import sqlite3
+
+        path = str(tmp_path / "old.db")
+        conn = sqlite3.connect(path)
+        conn.execute(
+            "CREATE TABLE threads (thread_id TEXT PRIMARY KEY, "
+            "created_at REAL NOT NULL, updated_at REAL NOT NULL, "
+            "metadata TEXT NOT NULL DEFAULT '{}', sandbox_id TEXT, "
+            "config TEXT)"
+        )
+        conn.execute(
+            "INSERT INTO threads VALUES ('t0', 1.0, 1.0, '{}', NULL, NULL)"
+        )
+        conn.commit()
+        conn.close()
+
+        async def go():
+            db = LocalDBClient(path)
+            await db.initialize()
+            assert await db.get_thread_owner("t0") is None
+            await db.set_thread_owner("t0", "u1")
+            assert await db.get_thread_owner("t0") == "u1"
+            await db.close()
+
+        run(go())
