@@ -98,6 +98,7 @@ class TPULLMProvider(LLMProvider):
         tokenizer: BaseTokenizer,
         model_name: str = "llama",
         worker: Optional[EngineWorker] = None,
+        vision_params: Any = None,
     ):
         self.engine = engine
         self.tokenizer = tokenizer
@@ -105,6 +106,31 @@ class TPULLMProvider(LLMProvider):
         self.worker = worker or EngineWorker(engine)
         self.worker.start()
         self._counter = itertools.count()
+        # Vision tower params (models/vision.py) — present iff the model
+        # config has a VisionConfig; image requests 400 otherwise.
+        self.vision_params = vision_params
+        self._encode_images = None
+        if vision_params is not None and self.model_cfg.vision is not None:
+            import functools as _ft
+
+            import jax as _jax
+
+            from ..models.vision import encode_images as _enc
+
+            # the sentinel scheme requires a tokenizer where NUL is one
+            # token that round-trips (the byte tokenizer's id 0); a
+            # subword checkpoint tokenizer must bring its own native
+            # image token instead of silently mis-splitting the sentinel
+            nul = tokenizer.encode("\x00")
+            if len(nul) != 1 or tokenizer.decode(nul) != "\x00":
+                raise ValueError(
+                    "vision serving requires a tokenizer with a "
+                    "single-token NUL sentinel (byte-level); this "
+                    f"tokenizer encodes NUL as {nul!r}"
+                )
+            self._encode_images = _jax.jit(
+                _ft.partial(_enc, vision_params, self.model_cfg.vision)
+            )
         # pre-build the constrained-decoding vocab index off the event loop
         # so the first tool_choice-constrained request doesn't stall serving
         from .constrained import TokenIndex
@@ -122,8 +148,21 @@ class TPULLMProvider(LLMProvider):
         messages: Sequence[MessageLike],
         tools: Optional[List[Dict[str, Any]]] = None,
     ) -> int:
-        """Token count of the rendered prompt (compaction pre-flight)."""
+        """Token count of the rendered prompt (compaction pre-flight).
+
+        Vision prompts are priced with their expansion: each surviving
+        image costs num_patches placeholder tokens (its 1-token sentinel
+        is replaced), after the same newest-N pruning serving applies."""
         dicts = to_message_dicts(messages)
+        # gate on the SERVING capability (encode fn), not just the config:
+        # pricing must agree with what stream_completion will accept
+        if self._encode_images is not None and count_images(dicts):
+            from .images import sentinelize_images
+            from .utils import prune_images
+
+            dicts, parts = sentinelize_images(prune_images(dicts))
+            n = len(self.tokenizer.encode_chat(dicts, tools=tools))
+            return n + len(parts) * (self.model_cfg.vision.num_patches - 1)
         return len(self.tokenizer.encode_chat(dicts, tools=tools))
 
     @property
@@ -182,15 +221,47 @@ class TPULLMProvider(LLMProvider):
     ) -> AsyncIterator[StreamChunk]:
         self.validate_messages(messages)
         dicts = to_message_dicts(messages)
-        # Text-only engine: reject image parts loudly (typed 400) rather
-        # than silently flattening them — the model must not answer as if
-        # it saw an image it never received.  prune_images (the reference's
-        # newest-19 bookkeeping, llm/utils.py) remains for deployments that
-        # front a vision-capable model.
+        # Image parts: served through the vision tower when the model has
+        # one (Llava-style soft prompt, models/vision.py — newest-19
+        # pruning first, reference src/llm/portkey.py:276); a text-only
+        # model rejects loudly with a typed 400 rather than silently
+        # flattening (the model must not answer as if it saw an image).
         n_images = count_images(dicts)
+        override_pos = override_rows = None
         if n_images:
-            raise UnsupportedContentError(n_images, provider=self.provider_name)
-        prompt_ids = self.tokenizer.encode_chat(dicts, tools=tools)
+            if self._encode_images is None:
+                raise UnsupportedContentError(
+                    n_images, provider=self.provider_name
+                )
+            import numpy as _np
+
+            from .images import expand_placeholders, extract_images
+            from .utils import prune_images
+
+            vcfg = self.model_cfg.vision
+            dicts = prune_images(dicts)
+
+            def _prep():
+                # PIL decode + ViT forward (first call also jit-compiles)
+                # are CPU/TPU-blocking: off the event loop, or every
+                # in-flight stream stalls for the duration
+                d2, pixels = extract_images(dicts, vcfg.image_size)
+                emb = self._encode_images(_np.stack(pixels))
+                return d2, len(pixels), _np.asarray(emb, _np.float32)
+
+            dicts, n_pix, embeds = await asyncio.to_thread(_prep)
+            ids = self.tokenizer.encode_chat(dicts, tools=tools)
+            sentinel_id = self.tokenizer.encode("\x00")[0]
+            prompt_ids, override_pos = expand_placeholders(
+                ids, sentinel_id, self.model_cfg.image_token_id,
+                vcfg.num_patches, n_pix,
+            )
+            override_rows = embeds.reshape(-1, self.model_cfg.hidden_size)
+            # identical placeholder ids for DIFFERENT image bytes must
+            # never share prefix-cached KV (the cache keys on token ids)
+            prefix_key = None
+        else:
+            prompt_ids = self.tokenizer.encode_chat(dicts, tools=tools)
         if len(prompt_ids) > self.max_prompt_tokens:
             raise ContextLengthError(
                 len(prompt_ids), self.max_prompt_tokens, self.provider_name
@@ -209,6 +280,8 @@ class TPULLMProvider(LLMProvider):
             stop_token_ids=tuple(self.tokenizer.stop_ids),
             logits_mask_fn=logits_mask_fn,
             prefix_key=prefix_key,
+            override_pos=override_pos,
+            override_rows=override_rows,
         )
         loop = asyncio.get_running_loop()
         events = self.worker.submit(req, loop)

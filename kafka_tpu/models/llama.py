@@ -385,6 +385,8 @@ def forward(
     cache_positions: Optional[jnp.ndarray] = None,
     paged: Optional[PagedView] = None,
     mesh=None,
+    embed_override: Optional[jnp.ndarray] = None,
+    override_on: Optional[jnp.ndarray] = None,
 ) -> Tuple[jnp.ndarray, Optional[KVCache]]:
     """Run the decoder.
 
@@ -395,6 +397,10 @@ def forward(
         `paged` is given): k/v [L, TOTAL_SLOTS, Hkv*D] (heads merged into
         the minor axis, runtime/kv_cache.py), reads/writes follow the
         PagedView index plan.
+    embed_override [B, S, H] + override_on [B, S] bool: positions whose
+        input embedding is REPLACED (image patches entering as soft-prompt
+        tokens, models/vision.py; the reference forwarded images to remote
+        vision models, src/llm/portkey.py:276).
     Returns (logits [B, S, vocab] float32, updated cache or None).
     """
     embed = params["embed"]
@@ -406,6 +412,11 @@ def forward(
         )
     else:
         x = embed[token_ids].astype(cfg.activation_dtype)
+    if embed_override is not None:
+        x = jnp.where(
+            override_on[..., None],
+            embed_override.astype(cfg.activation_dtype), x,
+        )
     inv_freq = rope_frequencies(cfg)
     cos, sin = rope_cos_sin(positions, inv_freq)
 

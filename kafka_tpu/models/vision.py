@@ -1,0 +1,133 @@
+"""Vision encoder: ViT + projector for image inputs (Llava-style).
+
+The reference forwarded image content parts to vision-capable provider
+models and kept the newest 19 per conversation (src/llm/portkey.py:276,
+src/llm/utils.py:85-130).  A local TPU engine has to RUN the vision path,
+and the TPU-first choice is soft-prompt multimodality (the public
+Llava recipe): a ViT encodes each image into `num_patches` embedding
+vectors, a projector maps them into the decoder's hidden space, and they
+enter the sequence as ordinary token positions (placeholder ids whose
+embeddings are overridden at prefill — models/llama.py forward's
+embed-override lane).  Everything downstream — paged KV, chunked prefill,
+continuous batching, ring/Ulysses context parallelism — works on image
+tokens unchanged, because after the override they ARE tokens.  The
+alternative (Flamingo-style cross-attention) would thread a second
+attention path through every engine program for no serving benefit at
+this scale.
+
+Functional JAX, mirroring models/llama.py's conventions: init fn +
+forward fn over a param dict, bf16/f32 dtype follows the text model.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.norms import rms_norm
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class VisionConfig:
+    """ViT hyperparameters.  Frozen/hashable: rides inside ModelConfig
+    (a static jit argument and an engine program-cache key)."""
+
+    image_size: int = 32
+    patch_size: int = 8
+    hidden_size: int = 64       # ViT width
+    num_layers: int = 2
+    num_heads: int = 4
+    mlp_ratio: int = 4
+    projector_hidden: int = 128  # Llava-style 2-layer MLP projector
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def patch_dim(self) -> int:
+        return self.patch_size * self.patch_size * 3
+
+
+def vision_init_params(vcfg: VisionConfig, text_hidden: int,
+                       key: jax.Array, dtype=jnp.float32) -> Params:
+    d, L = vcfg.hidden_size, vcfg.num_layers
+    keys = jax.random.split(key, 8)
+
+    def norm01(k, shape, fan_in):
+        return (jax.random.normal(k, shape, jnp.float32)
+                * (fan_in**-0.5)).astype(dtype)
+
+    m = vcfg.mlp_ratio * d
+    layers = {
+        "ln1": jnp.ones((L, d), dtype),
+        "ln2": jnp.ones((L, d), dtype),
+        "wqkv": norm01(keys[0], (L, d, 3 * d), d),
+        "wo": norm01(keys[1], (L, d, d), d),
+        "w1": norm01(keys[2], (L, d, m), d),
+        "w2": norm01(keys[3], (L, m, d), m),
+    }
+    return {
+        "patch_embed": norm01(keys[4], (vcfg.patch_dim, d), vcfg.patch_dim),
+        "pos_embed": norm01(keys[5], (vcfg.num_patches, d), d) * 0.02,
+        "final_ln": jnp.ones((d,), dtype),
+        "layers": layers,
+        # projector: ViT width -> text hidden (Llava mlp2x_gelu)
+        "proj_w1": norm01(keys[6], (d, vcfg.projector_hidden), d),
+        "proj_w2": norm01(
+            keys[7], (vcfg.projector_hidden, text_hidden),
+            vcfg.projector_hidden,
+        ),
+    }
+
+
+def patchify(vcfg: VisionConfig, pixels: jnp.ndarray) -> jnp.ndarray:
+    """[N, S, S, 3] float (0..1) -> [N, num_patches, patch_dim]."""
+    n, s, _, _ = pixels.shape
+    p = vcfg.patch_size
+    g = s // p
+    x = pixels.reshape(n, g, p, g, p, 3)
+    x = x.transpose(0, 1, 3, 2, 4, 5)  # [N, g, g, p, p, 3]
+    return x.reshape(n, g * g, p * p * 3)
+
+
+def encode_images(params: Params, vcfg: VisionConfig,
+                  pixels: jnp.ndarray) -> jnp.ndarray:
+    """[N, S, S, 3] float (0..1) -> [N, num_patches, text_hidden].
+
+    Pre-LN ViT with full (non-causal) attention over patches, scanned
+    over stacked layer params like the text decoder.
+    """
+    dt = params["patch_embed"].dtype
+    x = patchify(vcfg, pixels).astype(dt)
+    x = jnp.einsum("npd,dh->nph", x, params["patch_embed"])
+    x = x + params["pos_embed"][None]
+    nh = vcfg.num_heads
+    hd = vcfg.hidden_size // nh
+
+    def block(x, lp):
+        h = rms_norm(x, lp["ln1"])
+        qkv = jnp.einsum("nph,hk->npk", h, lp["wqkv"])
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        n, p, _ = q.shape
+        q = q.reshape(n, p, nh, hd)
+        k = k.reshape(n, p, nh, hd)
+        v = v.reshape(n, p, nh, hd)
+        s = jnp.einsum("nphd,nqhd->nhpq", q, k,
+                       preferred_element_type=jnp.float32) * hd**-0.5
+        a = jax.nn.softmax(s, axis=-1).astype(dt)
+        o = jnp.einsum("nhpq,nqhd->nphd", a, v).reshape(n, p, -1)
+        x = x + jnp.einsum("nph,hk->npk", o, lp["wo"])
+        h = rms_norm(x, lp["ln2"])
+        h = jax.nn.gelu(jnp.einsum("nph,hm->npm", h, lp["w1"]))
+        return x + jnp.einsum("npm,mh->nph", h, lp["w2"]), None
+
+    x, _ = jax.lax.scan(block, x, params["layers"])
+    x = rms_norm(x, params["final_ln"])
+    h = jax.nn.gelu(jnp.einsum("npd,dh->nph", x, params["proj_w1"]))
+    return jnp.einsum("nph,hd->npd", h, params["proj_w2"])

@@ -220,6 +220,13 @@ class GenRequest:
     # None for resumed parked lanes — their pending token is host-known
     # (output_ids[-1]).
     pending_tok: Optional[Any] = None
+    # Vision soft-prompt (models/vision.py): projected image-patch rows
+    # replacing the prompt's image_token_id placeholders at prefill.
+    # override_pos are ABSOLUTE prompt positions, so chunked prefill,
+    # prefix-hit resume, and preemption re-prefill all recompute the same
+    # per-chunk slices.  None = text-only request.
+    override_pos: Optional[Any] = None   # np [K] int32
+    override_rows: Optional[Any] = None  # np [K, H] float
 
     @property
     def cached_len(self) -> int:
@@ -294,6 +301,11 @@ class InferenceEngine:
                 raise ValueError(
                     "pp stage sharding does not support MoE models yet: "
                     "use ep x tp meshes for Mixtral-class serving"
+                )
+            if cfg.vision is not None:
+                raise ValueError(
+                    "pp stage sharding does not support vision models "
+                    "yet: the stage-0 embed has no override lane"
                 )
             from ..models.quant import QTensor
 
@@ -430,6 +442,9 @@ class InferenceEngine:
         self._requests: Dict[str, GenRequest] = {}
         self._step_count = 0
         self._prefill_fns: Dict[int, Callable] = {}
+        # device-resident all-zero override buffers (vision engines,
+        # text-only chunks) — see _zero_override
+        self._zero_ov_cache: Dict[Tuple, Tuple[Any, Any]] = {}
         self._decode_fn = self._build_decode_fn()
         self._counter = itertools.count()
         # device-resident decode control state (see module docstring)
@@ -626,7 +641,9 @@ class InferenceEngine:
             return _FN_CACHE[cache_key]
 
         def fn(params, k_pool, v_pool, page_rows, chunks, starts,
-               chunk_lens, temps, top_ks, top_ps, seeds, lane_active):
+               chunk_lens, temps, top_ks, top_ps, seeds, lane_active,
+               *vis):
+            # vis = (ov [W, S, H], ov_on [W, S]) iff cfg.vision
             S, W = bucket, width
             local = jnp.arange(S)[None, :]
             pos = starts[:, None] + local  # [W, S]
@@ -649,6 +666,8 @@ class InferenceEngine:
             logits, cache = forward(
                 params, cfg, chunks, pos,
                 kv_cache=KVCache(k_pool, v_pool), paged=paged, mesh=mesh,
+                embed_override=vis[0] if vis else None,
+                override_on=vis[1] if vis else None,
             )
             last = jnp.clip(chunk_lens - 1, 0, S - 1)
             final_logits = jnp.take_along_axis(
@@ -710,9 +729,11 @@ class InferenceEngine:
             return _FN_CACHE[cache_key]
 
         def fn(params, k_pool, v_pool, page_row, chunk, start, chunk_len,
-               temp, top_k, top_p, seed, allowed_mask):
+               temp, top_k, top_p, seed, allowed_mask, *vis):
             # [1, S] shapes throughout; `start` supports chunked prefill and
-            # prefix-cache hits (resume mid-prompt).
+            # prefix-cache hits (resume mid-prompt).  `vis` = (ov [S, H],
+            # ov_on [S]) embed-override arrays, present iff cfg.vision —
+            # per-engine the arity is constant, so one compile either way.
             S = bucket
             local = jnp.arange(S)
             positions = (start + local)[None, :]
@@ -742,6 +763,8 @@ class InferenceEngine:
                 logits, cache = forward(
                     params, cfg, chunk[None, :], positions,
                     kv_cache=KVCache(k_pool, v_pool), paged=paged, mesh=mesh,
+                    embed_override=vis[0][None] if vis else None,
+                    override_on=vis[1][None] if vis else None,
                 )
             last = jnp.clip(chunk_len - 1, 0, S - 1)
             final_logits = logits[0, last][None, :]  # [1, V]
@@ -1354,12 +1377,28 @@ class InferenceEngine:
             top_ps[i] = req.top_p
             seeds[i] = req.seed
             lane_active[i] = True
+        vis = ()
+        if self.cfg.vision is not None:
+            chunk_ovs = [
+                self._chunk_override(req, int(starts[i]), bucket)
+                for i, req in enumerate(reqs)
+            ]
+            if all(co is None for co in chunk_ovs):
+                vis = self._zero_override((W, bucket))
+            else:
+                ovs = np.zeros((W, bucket, self.cfg.hidden_size), np.float32)
+                ons = np.zeros((W, bucket), bool)
+                for i, co in enumerate(chunk_ovs):
+                    if co is not None:
+                        ovs[i], ons[i] = co
+                vis = (self._arg(ovs), self._arg(ons))
         fn = self._get_batched_prefill_fn(bucket, W)
         self.k_pool, self.v_pool, toks = fn(
             self.params, self.k_pool, self.v_pool,
             self._arg(page_rows), self._arg(chunks), self._arg(starts),
             self._arg(chunk_lens), self._arg(temps), self._arg(top_ks),
             self._arg(top_ps), self._arg(seeds), self._arg(lane_active),
+            *vis,
         )
         items: List[Optional[GenRequest]] = [None] * W
         finals_row: List[Optional[str]] = [None] * W
@@ -1402,6 +1441,40 @@ class InferenceEngine:
                 if req is not None and fin is not None:
                     self._to_draining(req)
 
+    def _zero_override(self, shape: Tuple[int, ...]) -> Tuple[Any, Any]:
+        """Device-resident all-zero (ov, ov_on) pair, cached per shape.
+
+        Vision engines pass override args on EVERY prefill dispatch (one
+        compiled program, constant arity); for text-only chunks a fresh
+        host zeros array would ship bucket*H floats per chunk for
+        nothing — the cached device buffers upload once."""
+        key = ("zov", shape)
+        if key not in self._zero_ov_cache:
+            self._zero_ov_cache[key] = (
+                self._dev(np.zeros(shape + (self.cfg.hidden_size,),
+                                   np.float32)),
+                self._dev(np.zeros(shape, bool)),
+            )
+        return self._zero_ov_cache[key]
+
+    def _chunk_override(self, req: GenRequest, start: int,
+                        bucket: int) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        """Per-chunk (ov [S, H], ov_on [S]) embed-override slices for the
+        prompt span [start, start+bucket); None when the span holds no
+        override rows (caller substitutes the cached device zeros)."""
+        if req.override_pos is None:
+            return None
+        sel = (req.override_pos >= start) & (req.override_pos < start + bucket)
+        if not sel.any():
+            return None
+        H = self.cfg.hidden_size
+        ov = np.zeros((bucket, H), np.float32)
+        on = np.zeros((bucket,), bool)
+        idx = req.override_pos[sel] - start
+        ov[idx] = req.override_rows[sel]
+        on[idx] = True
+        return ov, on
+
     def _advance_prefill(self, req: GenRequest) -> None:
         """Dispatch ONE prefill chunk; the final chunk activates the lane."""
         ecfg = self.ecfg
@@ -1415,6 +1488,13 @@ class InferenceEngine:
         chunk[:chunk_len] = prompt[start : start + chunk_len]
         page_row = np.full(ecfg.max_pages_per_seq, TRASH_PAGE, np.int32)
         page_row[: len(req.seq.pages)] = req.seq.pages
+        vis = ()
+        if self.cfg.vision is not None:
+            co = self._chunk_override(req, start, bucket)
+            if co is None:
+                vis = self._zero_override((bucket,))
+            else:
+                vis = (self._arg(co[0]), self._arg(co[1]))
         fn = self._get_prefill_fn(bucket)
         self.k_pool, self.v_pool, tok = fn(
             self.params, self.k_pool, self.v_pool,
@@ -1425,6 +1505,7 @@ class InferenceEngine:
             self._arg(np.float32(req.top_p)),
             self._arg(np.asarray([req.seed], np.uint32)),
             req.prefill_allowed,
+            *vis,
         )
         req.seq.length = start + chunk_len
         if req.seq.length < total:

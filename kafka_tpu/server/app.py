@@ -120,9 +120,12 @@ def build_tpu_provider(cfg: ServingConfig) -> LLMProvider:
         )
     else:
         tokenizer = ByteTokenizer()
-        model_cfg = get_config(cfg.model_name).replace(
-            vocab_size=max(tokenizer.vocab_size, 262), dtype=cfg.dtype
-        )
+        base = get_config(cfg.model_name)
+        vocab = max(tokenizer.vocab_size, 262)
+        if base.image_token_id is not None:
+            # the reserved image-placeholder id must stay in-vocab
+            vocab = max(vocab, base.image_token_id + 1)
+        model_cfg = base.replace(vocab_size=vocab, dtype=cfg.dtype)
     if cfg.quantize and cfg.quantize != "int8":
         raise ValueError(f"unknown quantize mode {cfg.quantize!r}")
 
@@ -269,7 +272,21 @@ def build_tpu_provider(cfg: ServingConfig) -> LLMProvider:
         for e in engines:
             e.metrics = EngineMetrics()
         logger.info("warmup compile done in %.1fs", _time.monotonic() - t0)
-    provider = TPULLMProvider(engine, tokenizer, model_name=cfg.model_name)
+    vision_params = None
+    if model_cfg.vision is not None:
+        # vision tower (models/vision.py).  Random-init like the text
+        # params when no checkpoint supplies one; a Llava checkpoint's
+        # tower would load here through the same seam.
+        from ..models.vision import vision_init_params
+
+        vision_params = vision_init_params(
+            model_cfg.vision, model_cfg.hidden_size, jax.random.PRNGKey(7),
+            dtype=model_cfg.activation_dtype,
+        )
+    provider = TPULLMProvider(
+        engine, tokenizer, model_name=cfg.model_name,
+        vision_params=vision_params,
+    )
     # the startup plan (actual model_cfg, live-device HBM) rides along so
     # /health reports the numbers this deployment was validated against
     provider.memory_plan = memory_plan

@@ -1,0 +1,314 @@
+"""Vision serving path (VERDICT r4 #7): Llava-style soft-prompt images.
+
+Reference behavior being replaced: image content parts forwarded to
+vision-capable provider models with newest-19 pruning
+(src/llm/portkey.py:276, src/llm/utils.py:85-130).  Here the ViT +
+projector (models/vision.py) runs in-process and its patch embeddings
+enter the decoder as overridden token positions (models/llama.py), so the
+whole serving stack (paged KV, chunked prefill, batching) is unchanged.
+"""
+
+import base64
+import io
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from kafka_tpu.llm.images import (
+    IMAGE_SENTINEL,
+    ImageDecodeError,
+    decode_image,
+    expand_placeholders,
+    sentinelize_images,
+)
+from kafka_tpu.models import get_config, init_params
+from kafka_tpu.models.vision import (
+    VisionConfig,
+    encode_images,
+    patchify,
+    vision_init_params,
+)
+from kafka_tpu.runtime import EngineConfig, InferenceEngine
+
+
+def png_data_url(seed=0, size=16, solid=None) -> str:
+    from PIL import Image
+
+    if solid is not None:
+        arr = np.full((size, size, 3), solid, np.uint8)
+    else:
+        rng = np.random.RandomState(seed)
+        arr = rng.randint(0, 255, (size, size, 3), np.uint8)
+    img = Image.fromarray(arr)
+    buf = io.BytesIO()
+    img.save(buf, format="PNG")
+    b64 = base64.b64encode(buf.getvalue()).decode()
+    return f"data:image/png;base64,{b64}"
+
+
+def image_part(seed=0, solid=None):
+    return {"type": "image_url",
+            "image_url": {"url": png_data_url(seed, solid=solid)}}
+
+
+class TestEncoder:
+    def test_patchify_roundtrip_geometry(self):
+        vcfg = VisionConfig(image_size=8, patch_size=4)
+        px = jnp.arange(8 * 8 * 3, dtype=jnp.float32).reshape(1, 8, 8, 3)
+        p = patchify(vcfg, px)
+        assert p.shape == (1, 4, 48)
+        # first patch is the top-left 4x4 block
+        np.testing.assert_array_equal(
+            np.asarray(p[0, 0]).reshape(4, 4, 3), np.asarray(px[0, :4, :4])
+        )
+
+    def test_encode_shapes_and_determinism(self):
+        vcfg = VisionConfig()
+        params = vision_init_params(vcfg, 64, jax.random.PRNGKey(0))
+        px = jax.random.uniform(jax.random.PRNGKey(1), (2, 32, 32, 3))
+        e1 = encode_images(params, vcfg, px)
+        e2 = encode_images(params, vcfg, px)
+        assert e1.shape == (2, vcfg.num_patches, 64)
+        np.testing.assert_array_equal(np.asarray(e1), np.asarray(e2))
+        # different images produce different embeddings
+        px2 = jax.random.uniform(jax.random.PRNGKey(2), (2, 32, 32, 3))
+        assert float(jnp.abs(encode_images(params, vcfg, px2) - e1).max()) > 1e-3
+
+
+class TestImageParts:
+    def test_decode_data_url(self):
+        px = decode_image(image_part(0), image_size=32)
+        assert px.shape == (32, 32, 3)
+        assert 0.0 <= px.min() and px.max() <= 1.0
+
+    def test_bad_base64_is_client_error(self):
+        with pytest.raises(ImageDecodeError) as e:
+            decode_image(
+                {"type": "image_url",
+                 "image_url": {"url": "data:image/png;base64,@@@"}}, 32)
+        assert e.value.status_code == 400
+
+    def test_remote_url_rejected(self):
+        with pytest.raises(ImageDecodeError, match="egress"):
+            decode_image(
+                {"type": "image_url",
+                 "image_url": {"url": "https://example.com/cat.png"}}, 32)
+
+    def test_sentinelize_preserves_structure(self):
+        msgs = [
+            {"role": "user", "content": [
+                {"type": "text", "text": "look: "},
+                image_part(0),
+                {"type": "text", "text": " and "},
+                image_part(1),
+            ]},
+            {"role": "assistant", "content": "plain text"},
+        ]
+        out, parts = sentinelize_images(msgs)
+        assert len(parts) == 2
+        assert out[1] is msgs[1]
+        texts = [p["text"] for p in out[0]["content"]]
+        assert texts == ["look: ", IMAGE_SENTINEL, " and ", IMAGE_SENTINEL]
+
+    def test_expand_placeholders_positions(self):
+        ids, pos = expand_placeholders(
+            [5, 0, 9, 0, 7], sentinel_id=0, image_token_id=99,
+            num_patches=3, n_images=2,
+        )
+        assert ids == [5, 99, 99, 99, 9, 99, 99, 99, 7]
+        np.testing.assert_array_equal(pos, [1, 2, 3, 5, 6, 7])
+
+    def test_expand_mismatch_raises(self):
+        with pytest.raises(ImageDecodeError):
+            expand_placeholders([5, 9], 0, 99, 3, n_images=1)
+
+
+@pytest.fixture(scope="module")
+def vision_engine():
+    cfg = get_config("tiny-vision").replace(dtype="float32")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    eng = InferenceEngine(
+        cfg, params,
+        EngineConfig(max_batch=2, page_size=8, num_pages=96,
+                     max_pages_per_seq=12, prefill_buckets=(8, 32, 64)),
+        kv_dtype=jnp.float32,
+    )
+    vparams = vision_init_params(cfg.vision, cfg.hidden_size,
+                                 jax.random.PRNGKey(1))
+    return cfg, eng, vparams
+
+
+class TestEngineOverride:
+    def test_image_changes_output_and_chunked_prefill_matches(
+        self, vision_engine
+    ):
+        cfg, eng, vparams = vision_engine
+        P = cfg.vision.num_patches
+        pix = jax.random.uniform(jax.random.PRNGKey(2), (1, 32, 32, 3))
+        rows = np.asarray(
+            encode_images(vparams, cfg.vision, pix)[0], np.float32)
+        prompt = [5, 9] + [cfg.image_token_id] * P + [7, 3, 11]
+        pos = np.arange(2, 2 + P, dtype=np.int32)
+
+        r_img = eng.generate(list(prompt), max_new_tokens=8,
+                             override_pos=pos, override_rows=rows)
+        r_txt = eng.generate(list(prompt), max_new_tokens=8)
+        assert r_img.output_ids != r_txt.output_ids
+
+        # multi-chunk prefill (bucket 8 over a 21-token prompt) must be
+        # token-exact vs the single-chunk result above
+        cfg2 = cfg
+        eng2 = InferenceEngine(
+            cfg2, eng.params,
+            EngineConfig(max_batch=2, page_size=8, num_pages=96,
+                         max_pages_per_seq=12, prefill_buckets=(8,)),
+            kv_dtype=jnp.float32,
+        )
+        r_chunked = eng2.generate(list(prompt), max_new_tokens=8,
+                                  override_pos=pos, override_rows=rows)
+        assert r_chunked.output_ids == r_img.output_ids
+
+    def test_two_images_differ(self, vision_engine):
+        cfg, eng, vparams = vision_engine
+        P = cfg.vision.num_patches
+        prompt = [5] + [cfg.image_token_id] * P + [7]
+        pos = np.arange(1, 1 + P, dtype=np.int32)
+        outs = []
+        for seed in (3, 4):
+            pix = jax.random.uniform(jax.random.PRNGKey(seed), (1, 32, 32, 3))
+            rows = np.asarray(
+                encode_images(vparams, cfg.vision, pix)[0], np.float32)
+            outs.append(eng.generate(
+                list(prompt), max_new_tokens=8,
+                override_pos=pos, override_rows=rows).output_ids)
+        assert outs[0] != outs[1]
+
+
+class TestServedVision:
+    """The served image round-trip the verdict asked for: an image part
+    through HTTP answers from a vision-equipped engine."""
+
+    def test_http_image_roundtrip(self, tmp_path):
+        import asyncio
+
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from kafka_tpu.server import ServingConfig, create_app
+
+        async def go():
+            cfg = ServingConfig(
+                model_name="tiny-vision", dtype="float32",
+                db_path=str(tmp_path / "v.db"),
+                max_batch=2, page_size=16, num_pages=256,
+                max_pages_per_seq=96, prefill_buckets=(256, 1024),
+                warmup=False, system_prompt="describe",
+            )
+            app = await create_app(cfg=cfg, tools=[], mcp_servers=[])
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                async def ask(content):
+                    r = await client.post("/v1/chat/completions", json={
+                        "model": "tiny-vision", "max_tokens": 24,
+                        "temperature": 0.0,
+                        "messages": [{"role": "user", "content": content}]})
+                    assert r.status == 200, await r.text()
+                    d = await r.json()
+                    return (d["choices"][0]["message"]["content"],
+                            d["usage"]["prompt_tokens"])
+
+                with_img, n_img_toks = await ask([
+                    {"type": "text", "text": "what is this? "},
+                    image_part(solid=0),
+                ])
+                text_only, n_txt_toks = await ask("what is this? ")
+                assert isinstance(with_img, str) and with_img
+                # STRUCTURAL proof the image entered the sequence: the
+                # served prompt grew by exactly num_patches placeholder
+                # tokens (the sentinel's 1 token became 16 patches).
+                # That the patch EMBEDDINGS condition generation is pinned
+                # at the engine level (TestEngineOverride: outputs differ
+                # by image) — a 2-layer random model under the full chat
+                # template collapses into the same greedy attractor, so
+                # text comparisons here would test the toy model, not the
+                # serving path.
+                vcfg = get_config("tiny-vision").vision
+                assert n_img_toks == n_txt_toks + vcfg.num_patches
+
+                # malformed image -> typed 400, not a 500
+                r = await client.post("/v1/chat/completions", json={
+                    "model": "tiny-vision", "max_tokens": 4,
+                    "messages": [{"role": "user", "content": [
+                        {"type": "image_url",
+                         "image_url": {"url": "data:image/png;base64,@@"}},
+                    ]}]})
+                assert r.status == 400
+            finally:
+                await client.close()
+
+        asyncio.new_event_loop().run_until_complete(go())
+
+    def test_text_only_model_still_rejects_images(self, tmp_path):
+        import asyncio
+
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from kafka_tpu.server import ServingConfig, create_app
+
+        async def go():
+            cfg = ServingConfig(
+                tiny_model=True, db_path=str(tmp_path / "t.db"),
+                max_batch=2, page_size=16, num_pages=160,
+                max_pages_per_seq=64, prefill_buckets=(256,),
+                warmup=False,
+            )
+            app = await create_app(cfg=cfg, tools=[], mcp_servers=[])
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                r = await client.post("/v1/chat/completions", json={
+                    "model": "tiny", "max_tokens": 4,
+                    "messages": [{"role": "user", "content": [
+                        image_part(0),
+                    ]}]})
+                assert r.status == 400
+                body = await r.json()
+                assert "unsupported_content" in str(body)
+            finally:
+                await client.close()
+
+        asyncio.new_event_loop().run_until_complete(go())
+
+
+class TestTokenAccounting:
+    def test_count_prompt_tokens_prices_patches(self, tmp_path):
+        from kafka_tpu.llm.tpu_provider import TPULLMProvider
+        from kafka_tpu.models.tokenizer import ByteTokenizer
+
+        cfg = get_config("tiny-vision").replace(dtype="float32")
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        eng = InferenceEngine(
+            cfg, params,
+            EngineConfig(max_batch=2, page_size=8, num_pages=96,
+                         max_pages_per_seq=12, prefill_buckets=(8,)),
+            kv_dtype=jnp.float32,
+        )
+        vparams = vision_init_params(cfg.vision, cfg.hidden_size,
+                                     jax.random.PRNGKey(1))
+        provider = TPULLMProvider(eng, ByteTokenizer(),
+                                  model_name="tiny-vision",
+                                  vision_params=vparams)
+        try:
+            base = provider.count_prompt_tokens(
+                [{"role": "user", "content": "hi"}])
+            with_img = provider.count_prompt_tokens(
+                [{"role": "user", "content": [
+                    {"type": "text", "text": "hi"},
+                    image_part(0),
+                ]}])
+            assert with_img == base + cfg.vision.num_patches
+        finally:
+            provider.worker.stop()
