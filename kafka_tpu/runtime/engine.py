@@ -127,11 +127,13 @@ class EngineConfig:
     # Engages with >=3 active streams, no constrained lanes, and no lane
     # mid-prefill; a waiting queue with every slot busy keeps fusion ON
     # (admission waits at most k-1 steps — see _pick_multi_step).
-    # Depth sweep on the tunneled v5e (scripts/sweep_multistep.py, 1B b8,
-    # end-to-end engine tok/s): depth 8 = 1111, 16 = 1576 (+42%), 24 =
-    # 1621 (+3% more for double the admission latency) — dispatch
-    # overhead, not device compute, was the margin, so the default sits at
-    # 16 where the curve flattens.  1 disables.
+    # Depth measurements on the tunneled v5e (scripts/sweep_multistep.py +
+    # bench fused_depth_ablation, 1B b8 end-to-end tok/s) are
+    # LINK-DEPENDENT: on a degraded link depth 8 = 1111 vs 16 = 1576
+    # (+42% — dispatch overhead was the margin); on a calm link 8 = 1540
+    # vs 16 = 1514 (-2% — dispatch already amortized).  16 is the default
+    # as link-weather insurance: it trades <=2% best-case for +32-42%
+    # worst-case, i.e. throughput variance collapses.  1 disables.
     multi_step: int = 16
     # Off-slot admission: when every decode slot is busy, waiting requests
     # may still prefill and emit their FIRST token ("parked"), then join
