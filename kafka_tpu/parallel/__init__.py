@@ -8,7 +8,14 @@ from .expert import (
     moe_mlp_sharded,
     shard_moe_params,
 )
-from .mesh import AXIS_ORDER, MeshConfig, make_mesh, single_device_mesh
+from .mesh import (
+    AXIS_ORDER,
+    MeshConfig,
+    factor_tp_for_kv,
+    make_mesh,
+    resolve_tensor_axes,
+    single_device_mesh,
+)
 from .pipeline import pp_forward, pp_param_specs, shard_params_pp
 from .ring_attention import (
     ring_attention,
@@ -36,7 +43,9 @@ __all__ = [
     "pp_param_specs",
     "shard_params_pp",
     "MeshConfig",
+    "factor_tp_for_kv",
     "make_mesh",
+    "resolve_tensor_axes",
     "single_device_mesh",
     "ring_attention",
     "ring_prefill_sharded",

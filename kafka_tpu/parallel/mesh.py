@@ -5,6 +5,13 @@ The framework uses one fixed axis vocabulary everywhere (SURVEY §2.2):
   dp  — data parallel: batch-dim sharding of the decode step
   tp  — tensor parallel: attention heads / MLP hidden, Megatron-style;
         collectives ride ICI within a slice
+  tq  — the kv-replica factor of the tensor axis (grouped GQA sharding):
+        when the requested tensor degree exceeds num_kv_heads, the tensor
+        axis is factorized tp*tq with tp | num_kv_heads; q heads / MLP /
+        vocab shard over BOTH ("tp","tq") while kv params and the KV pool
+        shard over "tp" alone and replicate across the tq groups — per-chip
+        KV is 1/tp of the pool instead of a full copy.  tq == 1 on every
+        mesh whose tensor degree divides the kv head count.
   sp  — sequence/context parallel: activation seq dim (long-context
         prefill, ring attention)
   pp  — pipeline parallel: layer stages across DCN-connected slices
@@ -20,13 +27,14 @@ tensor fabric is XLA collectives over ICI/DCN inserted by GSPMD/shard_map.
 from __future__ import annotations
 
 import dataclasses
+import math
 from typing import Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 from jax.sharding import Mesh
 
-AXIS_ORDER = ("dp", "pp", "sp", "tp", "ep")
+AXIS_ORDER = ("dp", "pp", "sp", "tp", "tq", "ep")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -35,14 +43,51 @@ class MeshConfig:
     pp: int = 1
     sp: int = 1
     tp: int = 1
+    tq: int = 1
     ep: int = 1
 
     @property
     def total_devices(self) -> int:
-        return self.dp * self.pp * self.sp * self.tp * self.ep
+        return self.dp * self.pp * self.sp * self.tp * self.tq * self.ep
 
     def axis_sizes(self) -> Tuple[int, ...]:
-        return (self.dp, self.pp, self.sp, self.tp, self.ep)
+        return (self.dp, self.pp, self.sp, self.tp, self.tq, self.ep)
+
+
+def factor_tp_for_kv(tensor_degree: int, num_kv_heads: int) -> Tuple[int, int]:
+    """Factorize a requested tensor-parallel degree into (tp, tq).
+
+    The kv sub-axis `tp` is the largest divisor of `tensor_degree` that
+    also divides `num_kv_heads`; `tq` carries the rest as kv replication
+    groups.  tensor_degree | num_kv_heads -> (tensor_degree, 1), the clean
+    Megatron split.  70B (8 kv heads) at degree 16 -> (8, 2): each kv head
+    lives on 2 chips instead of all 16 (the grouped head-sharing layout the
+    memory planner charges for, runtime/planner.py)."""
+    if tensor_degree <= 1:
+        return max(tensor_degree, 1), 1
+    kv = math.gcd(tensor_degree, num_kv_heads)
+    return kv, tensor_degree // kv
+
+
+def resolve_tensor_axes(
+    tensor_degree: int,
+    num_kv_heads: int,
+    *,
+    cp_strategy: str = "ring",
+    sp: int = 1,
+    pp: int = 1,
+) -> Tuple[int, int]:
+    """The ONE place the (tp, tq) split is decided for a serving config.
+
+    Grouped factorization applies unless a composition that assumes the
+    plain tensor axis is in play: ulysses CP (its all_to_all head scatter
+    counts heads per plain-tp shard) and pp stage sharding (pipeline.py's
+    specs/psums speak plain "tp"; _check_pp_divisibility validates the
+    split).  Those keep tq=1.  Server, DP router, and the memory planner
+    all call this, so the plan charges exactly what the engine places."""
+    if (cp_strategy == "ulysses" and sp > 1) or pp > 1:
+        return tensor_degree, 1
+    return factor_tp_for_kv(tensor_degree, num_kv_heads)
 
 
 def make_mesh(

@@ -176,21 +176,42 @@ def _prefill_sharded(
     over sp, and heads additionally shard over the mesh's tp axis when it
     divides BOTH head counts (the same rule as sharding.py's
     kv_pool_spec) — so on a tp x sp mesh each device holds 1/(tp*sp) of
-    the chunk and 1/tp of the context window."""
+    the chunk and 1/tp of the context window.
+
+    Grouped-GQA meshes (parallel/mesh.py: tensor degree factorized into
+    tp*tq with tp | Hkv) shard q heads over BOTH ("tp","tq") and kv heads
+    over "tp" alone — each shard then sees Hq/(tp*tq) queries against its
+    Hkv/tp kv heads, and the per-shard GQA repeat factor stays an integer
+    because contiguous q-head blocks map onto their own kv head (the same
+    head-order invariant sharding.py's decode path relies on)."""
     tp = mesh.shape.get("tp", 1)
+    tq = mesh.shape.get("tq", 1)
     hq, hkv = q.shape[2], k_chunk.shape[2]
-    head_ax = "tp" if (tp > 1 and hkv % tp == 0 and hq % tp == 0) else None
-    spec_a = P(None, axis_name, head_ax, None)
+    kv_ax = "tp" if (tp > 1 and hkv % tp == 0 and hq % tp == 0) else None
+    # The grouped split is sound only when each shard holds exactly ONE kv
+    # head: ring_attention's local q->kv map is m // n_rep, which assumes
+    # the shard's q heads all share its first kv head — true for one local
+    # kv head, wrong for several (shard (i,j>0) would need an offset).
+    # factor_tp_for_kv picks tp == Hkv whenever Hkv | degree, so real
+    # grouped meshes hit this branch; odd gcd splits fall back to the
+    # plain tp head split (q and kv both over "tp", replicated over tq).
+    if kv_ax is not None and tq > 1 and hkv // tp == 1 \
+            and hq % (tp * tq) == 0 and (hq // hkv) % tq == 0:
+        q_ax = ("tp", "tq")
+    else:
+        q_ax = kv_ax
+    spec_q = P(None, axis_name, q_ax, None)
+    spec_kv = P(None, axis_name, kv_ax, None)
     spec_p = P(None, axis_name)
-    rep_a = P(None, None, head_ax, None)
+    rep_kv = P(None, None, kv_ax, None)
     rep_p = P(None, None)
 
     fn = jax.shard_map(
         per_shard,
         mesh=mesh,
-        in_specs=(spec_a, spec_a, spec_a, spec_p,
-                  rep_a, rep_a, rep_p, rep_p),
-        out_specs=spec_a,
+        in_specs=(spec_q, spec_kv, spec_kv, spec_p,
+                  rep_kv, rep_kv, rep_p, rep_p),
+        out_specs=spec_q,
     )
     return fn(q, k_chunk, v_chunk, q_positions,
               k_ctx, v_ctx, ctx_positions, ctx_valid)

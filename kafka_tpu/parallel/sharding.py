@@ -22,12 +22,19 @@ The leading L axis carries "pp" when a pipeline axis is used (stage split =
 contiguous layer ranges); kept None here — PP slicing happens above these
 rules, not inside them.
 
-GQA note: tp must divide num_kv_heads for the clean head split. When it
-does not (e.g. 70B with 8 kv heads on 16-way tp), the fallback here is FULL
-replication of kv params and the KV pool on every chip (`_kv_axis` -> None)
-— simple and correct, but per-chip KV memory is num_kv_heads/ceil(kv/tp)
-times the grouped-replication layout (groups of tp/num_kv_heads chips
-sharing one head), which is the upgrade path if 70B HBM budgets demand it.
+GQA note: the clean head split needs the tensor degree to divide
+num_kv_heads.  When it does not (e.g. 70B with 8 kv heads at degree 16),
+the mesh factorizes the tensor axis into ("tp","tq") with tp | num_kv_heads
+(parallel/mesh.py factor_tp_for_kv): q heads / MLP hidden / vocab shard
+over BOTH axes (full degree), kv params and the KV pool shard over "tp"
+alone — each kv head lives on tq chips (grouped head-sharing) instead of
+every chip.  The decode attention einsums then shard with ZERO extra
+collectives: q reshaped [B,S,Hkv,G,D] carries ("tp" on Hkv, "tq" on G), k
+carries "tp" on Hkv, and the scores/output einsums contract only D, so
+GSPMD keeps everything local until wo's row-parallel psum over
+("tp","tq") — the same all-reduce the clean split already pays.  If the
+degree shares no factor with num_kv_heads at all, tp=1 and the pool is
+fully replicated (the old fallback, now the last resort).
 """
 
 from __future__ import annotations
@@ -50,16 +57,25 @@ def _kv_axis(cfg: ModelConfig, mesh: Mesh) -> Optional[str]:
     return None
 
 
+def _tensor_axes(mesh: Mesh):
+    """The full-degree tensor axes: ("tp","tq") on grouped-GQA meshes,
+    plain "tp" on meshes without a tq axis (legacy/test meshes)."""
+    if mesh.shape.get("tq", 1) > 1:
+        return ("tp", "tq")
+    return "tp" if "tp" in mesh.axis_names else None
+
+
 def param_specs(cfg: ModelConfig, mesh: Mesh) -> Params:
     """PartitionSpec pytree congruent with init_params' tree."""
     kv = _kv_axis(cfg, mesh)
+    tx = _tensor_axes(mesh)
     layers: Params = {
         "ln_attn": P(),
         "ln_mlp": P(),
-        "wq": P(None, None, "tp", None),
+        "wq": P(None, None, tx, None),
         "wk": P(None, None, kv, None),
         "wv": P(None, None, kv, None),
-        "wo": P(None, "tp", None, None),
+        "wo": P(None, tx, None, None),
     }
     if cfg.is_moe:
         # MoE (models/llama.py:_moe_block): experts over "ep", per-expert
@@ -71,20 +87,20 @@ def param_specs(cfg: ModelConfig, mesh: Mesh) -> Params:
             and cfg.num_experts % mesh.shape["ep"] == 0
         ) else None
         layers["router"] = P()
-        layers["wg"] = P(None, ep, None, "tp")   # [L, E, H, F]
-        layers["wu"] = P(None, ep, None, "tp")
-        layers["wd"] = P(None, ep, "tp", None)   # [L, E, F, H]
+        layers["wg"] = P(None, ep, None, tx)     # [L, E, H, F]
+        layers["wu"] = P(None, ep, None, tx)
+        layers["wd"] = P(None, ep, tx, None)     # [L, E, F, H]
     else:
-        layers["wg"] = P(None, None, "tp")
-        layers["wu"] = P(None, None, "tp")
-        layers["wd"] = P(None, "tp", None)
+        layers["wg"] = P(None, None, tx)
+        layers["wu"] = P(None, None, tx)
+        layers["wd"] = P(None, tx, None)
     specs: Params = {
         "embed": P(),
         "final_norm": P(),
         "layers": layers,
     }
     if not cfg.tie_word_embeddings:
-        specs["lm_head"] = P(None, "tp")
+        specs["lm_head"] = P(None, tx)
     return specs
 
 

@@ -31,7 +31,7 @@ from typing import Any, Dict, List, Optional
 import jax
 
 from ..models.config import ModelConfig
-from ..parallel import MeshConfig, make_mesh
+from ..parallel import MeshConfig, make_mesh, resolve_tensor_axes
 from .engine import EngineConfig, GenRequest, InferenceEngine, TokenEvent
 
 logger = logging.getLogger("kafka_tpu.dp")
@@ -66,7 +66,11 @@ class DataParallelEngines:
             # a mesh over exactly this replica's devices pins its params
             # and KV pool there (the engine places for any provided mesh);
             # sp>1 replicas run ring-sharded chunked prefill internally
-            mesh = make_mesh(MeshConfig(sp=sp, tp=tp, ep=ep),
+            tpk, tq = resolve_tensor_axes(
+                tp, cfg.num_kv_heads,
+                cp_strategy=engine_cfg.cp_strategy, sp=sp,
+            )
+            mesh = make_mesh(MeshConfig(sp=sp, tp=tpk, tq=tq, ep=ep),
                              devices=slice_devices)
             self.engines.append(
                 InferenceEngine(

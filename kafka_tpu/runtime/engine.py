@@ -287,6 +287,23 @@ class InferenceEngine:
         self._sp = sp
         self._pp = mesh.shape.get("pp", 1) if mesh is not None else 1
         self._ep = mesh.shape.get("ep", 1) if mesh is not None else 1
+        # grouped-GQA kv replica factor (parallel/mesh.py factor_tp_for_kv):
+        # q heads/MLP shard over tp*tq, kv params + pool over tp alone
+        self._tq = mesh.shape.get("tq", 1) if mesh is not None else 1
+        if self._tq > 1:
+            if self._pp > 1:
+                raise ValueError(
+                    "grouped GQA sharding (tq>1) does not compose with pp "
+                    "stage sharding: pipeline specs assume the plain tp "
+                    "head split — pick a tensor degree dividing "
+                    f"num_kv_heads ({cfg.num_kv_heads}) for pp meshes"
+                )
+            if (self.ecfg.cp_strategy == "ulysses") and sp > 1:
+                raise ValueError(
+                    "grouped GQA sharding (tq>1) composes with "
+                    "cp_strategy='ring' only: the ulysses all_to_all "
+                    "head scatter assumes the plain tp head split"
+                )
         if self._ep > 1:
             if not cfg.is_moe:
                 raise ValueError(

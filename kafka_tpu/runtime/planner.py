@@ -7,12 +7,16 @@ TPU engine does.  This module computes per-device HBM bytes for a
 (ModelConfig, engine shape, mesh) triple using THE SAME placement rules the
 engine actually applies:
 
-* weights follow parallel/sharding.py's PartitionSpecs — including the GQA
-  fallback that REPLICATES kv projections and the KV pool when tp does not
-  divide num_kv_heads (sharding.py:45-50), which dominates the 70B budget;
+* weights follow parallel/sharding.py's PartitionSpecs — including the
+  grouped-GQA factorization (parallel/mesh.py factor_tp_for_kv) that shards
+  kv projections and the KV pool over the largest common divisor of the
+  tensor degree and num_kv_heads, replicating each kv head only across its
+  tq-group (70B at degree 16: 8-way kv shard, 2 chips per head — 8x less
+  per-chip KV than the full replication this planner charged before);
 * the KV pool is the [L, num_pages * page_size, Hkv*D] pair of
   runtime/kv_cache.py, k and v, layer axis split over pp
-  (parallel/pipeline.py stages), head axis over tp iff tp | Hkv;
+  (parallel/pipeline.py stages), head axis over gcd(tp, Hkv) — the
+  grouped-GQA kv sub-axis (tq groups replicate);
 * int8 weight quantization (models/quant.py) stores 1 byte/param + an f32
   scale per output channel; int8 KV halves pool bytes + per-page f32 scales.
 
@@ -53,10 +57,23 @@ def _bytes(dtype: str) -> int:
     return _DTYPE_BYTES[dtype]
 
 
-def _kv_shard(cfg: ModelConfig, tp: int) -> int:
-    """kv-head shard factor — mirrors parallel/sharding.py _kv_axis: kv
-    projections and the pool replicate when tp does not divide Hkv."""
-    return tp if (tp > 1 and cfg.num_kv_heads % tp == 0) else 1
+def _kv_shard(cfg: ModelConfig, tp: int, kv_shard: Optional[int] = None) -> int:
+    """kv-head shard factor — delegates to parallel/mesh.py
+    factor_tp_for_kv so the plan charges exactly what the engine places:
+    the tensor degree factorizes into tp_kv * tq with tp_kv =
+    gcd(degree, Hkv); kv params and the pool shard tp_kv-ways and
+    replicate only across the tq groups (grouped GQA head-sharing).  A
+    degree sharing no factor with Hkv degrades to full replication.
+
+    `kv_shard` overrides the grouped default for configs where the mesh
+    keeps the plain tensor axis (ulysses CP, pp stages) —
+    plan_for_serving resolves it via the SAME resolve_tensor_axes call
+    the server uses, so plan and placement cannot drift."""
+    if kv_shard is not None:
+        return kv_shard
+    from ..parallel.mesh import factor_tp_for_kv
+
+    return factor_tp_for_kv(tp, cfg.num_kv_heads)[0]
 
 
 def hbm_for_device(dev) -> Optional[int]:
@@ -91,7 +108,9 @@ class MemoryPlan:
     weight_bytes: int                 # per device
     kv_pool_bytes: int                # per device (both k and v)
     activation_bytes: int             # estimated peak live activations
-    kv_replicated: bool               # GQA fallback engaged (tp !| Hkv)
+    kv_replicated: bool               # kv not sharded the full tensor
+                                      # degree (gcd(tp, Hkv) < tp): pool
+                                      # replicated across tq groups
     kv_bytes_per_token: int           # per device, k+v, all layers
     window_tokens: int                # configured attention window
     notes: str = ""
@@ -147,6 +166,7 @@ def weight_bytes_per_device(
     pp: int = 1,
     ep: int = 1,
     quantize: str = "",
+    kv_shard: Optional[int] = None,
 ) -> int:
     """Per-device weight bytes under parallel/sharding.py's rules."""
     h, f, d = cfg.hidden_size, cfg.intermediate_size, cfg.head_dim
@@ -161,7 +181,7 @@ def weight_bytes_per_device(
         n = rows * cols // shard
         return n + (cols // shard) * 4 if int8 else n * wb
 
-    kv_shard = _kv_shard(cfg, tp)
+    kv_shard = _kv_shard(cfg, tp, kv_shard)
 
     per_layer = (
         mat(h, hq * d, tp)            # wq
@@ -195,10 +215,11 @@ def kv_pool_bytes_per_device(
     tp: int = 1,
     pp: int = 1,
     kv_dtype: str = "bfloat16",
+    kv_shard: Optional[int] = None,
 ) -> int:
     """Both pool arrays (k + v), [L/pp, num_pages*page_size, Hkv*D]."""
     hkv_d = cfg.num_kv_heads * cfg.head_dim
-    kv_shard = _kv_shard(cfg, tp)
+    kv_shard = _kv_shard(cfg, tp, kv_shard)
     slots = num_pages * page_size
     per = cfg.num_layers // pp * slots * hkv_d // kv_shard
     b = per * _bytes(kv_dtype) * 2
@@ -209,9 +230,10 @@ def kv_pool_bytes_per_device(
 
 
 def kv_bytes_per_token(
-    cfg: ModelConfig, *, tp: int = 1, pp: int = 1, kv_dtype: str = "bfloat16"
+    cfg: ModelConfig, *, tp: int = 1, pp: int = 1,
+    kv_dtype: str = "bfloat16", kv_shard: Optional[int] = None,
 ) -> int:
-    kv_shard = _kv_shard(cfg, tp)
+    kv_shard = _kv_shard(cfg, tp, kv_shard)
     return (
         cfg.num_layers // pp
         * cfg.num_kv_heads * cfg.head_dim // kv_shard
@@ -267,10 +289,12 @@ def plan_memory(
     hbm_bytes: Optional[int] = None,
     chip: str = "v5e",
     reserve_frac: float = 0.08,
+    kv_shard: Optional[int] = None,
 ) -> MemoryPlan:
     if hbm_bytes is None:
         hbm_bytes = HBM_BYTES[chip]
-    kv_replicated = tp > 1 and _kv_shard(cfg, tp) == 1
+    kv_shard = _kv_shard(cfg, tp, kv_shard)
+    kv_replicated = tp > 1 and kv_shard < tp
     window = max_pages_per_seq * page_size
     plan = MemoryPlan(
         model=cfg.name,
@@ -278,11 +302,11 @@ def plan_memory(
         hbm_bytes=hbm_bytes,
         reserve_frac=reserve_frac,
         weight_bytes=weight_bytes_per_device(
-            cfg, tp=tp, pp=pp, ep=ep, quantize=quantize
+            cfg, tp=tp, pp=pp, ep=ep, quantize=quantize, kv_shard=kv_shard
         ),
         kv_pool_bytes=kv_pool_bytes_per_device(
             cfg, num_pages=num_pages, page_size=page_size, tp=tp, pp=pp,
-            kv_dtype=kv_dtype,
+            kv_dtype=kv_dtype, kv_shard=kv_shard,
         ),
         activation_bytes=activation_bytes_estimate(
             cfg, max_batch=max_batch, prefill_bucket=prefill_bucket,
@@ -290,14 +314,21 @@ def plan_memory(
         ),
         kv_replicated=kv_replicated,
         kv_bytes_per_token=kv_bytes_per_token(
-            cfg, tp=tp, pp=pp, kv_dtype=kv_dtype
+            cfg, tp=tp, pp=pp, kv_dtype=kv_dtype, kv_shard=kv_shard
         ),
         window_tokens=window,
         notes=(
-            "kv params+pool replicated per chip: tp does not divide "
-            f"num_kv_heads ({cfg.num_kv_heads} % {tp}); grouped "
-            "head-sharing (tp/Hkv chips per head) is the documented "
-            "upgrade path (parallel/sharding.py:25-30), not implemented"
+            (
+                f"grouped GQA layout: tensor degree {tp} factorizes "
+                f"tp={kv_shard} x tq={tp // kv_shard}; kv params+pool "
+                f"shard {kv_shard}-ways, each kv head replicated on "
+                f"{tp // kv_shard} chips (parallel/mesh.py "
+                "factor_tp_for_kv)"
+                if kv_shard > 1 else
+                "kv params+pool fully replicated per chip: the mesh "
+                f"keeps the plain tensor axis (degree {tp}) and it does "
+                f"not divide num_kv_heads ({cfg.num_kv_heads})"
+            )
             if kv_replicated else ""
         ),
     )
@@ -316,6 +347,17 @@ def plan_for_serving(scfg, hbm_bytes: Optional[int] = None,
         from ..models.config import get_config
 
         model_cfg = get_config(scfg.model_name)
+    # resolve (tp, tq) the way the server will build the mesh — ulysses/pp
+    # configs keep the plain axis and fall back to full kv replication,
+    # and the plan must charge for THAT, not the grouped layout
+    from ..parallel.mesh import resolve_tensor_axes
+
+    tpk, tq = resolve_tensor_axes(
+        scfg.tp_size, model_cfg.num_kv_heads,
+        cp_strategy=getattr(scfg, "cp_strategy", "ring"),
+        sp=scfg.sp_size, pp=scfg.pp_size,
+    )
+    kv_shard = tpk if (tq > 1 or model_cfg.num_kv_heads % tpk == 0) else 1
     return plan_memory(
         model_cfg,
         tp=scfg.tp_size, sp=scfg.sp_size, pp=scfg.pp_size, ep=scfg.ep_size,
@@ -324,5 +366,5 @@ def plan_for_serving(scfg, hbm_bytes: Optional[int] = None,
         prefill_bucket=max(scfg.prefill_buckets),
         quantize=scfg.quantize,
         kv_dtype=getattr(scfg, "kv_quantize", "") or "bfloat16",
-        hbm_bytes=hbm_bytes, chip=chip,
+        hbm_bytes=hbm_bytes, chip=chip, kv_shard=kv_shard,
     )

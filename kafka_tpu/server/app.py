@@ -205,10 +205,20 @@ def build_tpu_provider(cfg: ServingConfig) -> LLMProvider:
         mesh = None
         if (cfg.tp_size > 1 or cfg.sp_size > 1 or cfg.pp_size > 1
                 or cfg.ep_size > 1):
-            from ..parallel import MeshConfig, make_mesh
+            from ..parallel import MeshConfig, make_mesh, resolve_tensor_axes
 
+            # grouped GQA: a tensor degree beyond num_kv_heads factorizes
+            # into tp*tq so the KV pool shards over tp instead of fully
+            # replicating; ulysses/pp keep the plain axis (see
+            # parallel/mesh.py resolve_tensor_axes — shared with the
+            # memory planner so the plan matches placement)
+            tpk, tq = resolve_tensor_axes(
+                cfg.tp_size, model_cfg.num_kv_heads,
+                cp_strategy=cfg.cp_strategy, sp=cfg.sp_size,
+                pp=cfg.pp_size,
+            )
             mesh = make_mesh(MeshConfig(
-                pp=cfg.pp_size, sp=cfg.sp_size, tp=cfg.tp_size,
+                pp=cfg.pp_size, sp=cfg.sp_size, tp=tpk, tq=tq,
                 ep=cfg.ep_size,
             ))
         engine = InferenceEngine(model_cfg, params, engine_cfg, mesh=mesh)

@@ -16,6 +16,7 @@ from kafka_tpu.models import ModelConfig, init_params
 from kafka_tpu.ops.attention import causal_attention
 from kafka_tpu.parallel import (
     MeshConfig,
+    factor_tp_for_kv,
     make_mesh,
     param_specs,
     ring_attention_sharded,
@@ -119,11 +120,124 @@ class TestTPSharding:
             assert got[rid].output_ids == want[rid].output_ids, rid
 
     def test_kv_head_replication_when_tp_exceeds_kv(self, model):
+        """A raw mesh whose tp axis exceeds Hkv still degrades to kv
+        replication (the last-resort fallback callers get when they skip
+        factor_tp_for_kv)."""
         cfg, params = model  # 4 kv heads
-        mesh = make_mesh(MeshConfig(tp=8))  # tp > kv heads
+        mesh = make_mesh(MeshConfig(tp=8))  # tp > kv heads, no tq split
         specs = param_specs(cfg, mesh)
         assert specs["layers"]["wk"] == P(None, None, None, None)  # replicated kv
         assert specs["layers"]["wq"] == P(None, None, "tp", None)
+
+    def test_grouped_gqa_specs_and_placement(self, model):
+        """factor_tp_for_kv(8, Hkv=4) -> (tp=4, tq=2): q heads shard the
+        full degree over ("tp","tq"), kv params shard over "tp" alone —
+        each kv head lives on tq=2 chips instead of all 8."""
+        cfg, params = model  # Hq=8, Hkv=4
+        assert factor_tp_for_kv(8, cfg.num_kv_heads) == (4, 2)
+        mesh = make_mesh(MeshConfig(tp=4, tq=2))
+        specs = param_specs(cfg, mesh)
+        assert specs["layers"]["wq"] == P(None, None, ("tp", "tq"), None)
+        assert specs["layers"]["wk"] == P(None, None, "tp", None)
+        assert specs["layers"]["wd"] == P(None, ("tp", "tq"), None)
+        sharded = shard_params(params, cfg, mesh)
+        # full-degree q split: 8 heads over 8 chips
+        assert sharded["layers"]["wq"].addressable_shards[0].data.shape[2] == 1
+        # kv split 4-ways only: 1 head per shard, replicated over tq
+        assert sharded["layers"]["wk"].addressable_shards[0].data.shape[2] == 1
+        assert len({
+            s.device.id for s in sharded["layers"]["wk"].addressable_shards
+        }) == 8
+
+    def test_grouped_gqa_engine_matches_single_device(self, model):
+        """The grouped layout (tp=4 x tq=2 over 8 devices, Hkv=4) serves
+        token-exact vs the unsharded engine — the BASELINE config-5 70B
+        layout (degree 16 over 8 kv heads) at test shape."""
+        cfg, params = model
+        ecfg = dict(max_batch=2, page_size=8, num_pages=32,
+                    max_pages_per_seq=8, prefill_buckets=(8, 16))
+        base = InferenceEngine(cfg, params, EngineConfig(**ecfg),
+                               kv_dtype=jnp.float32)
+        prompt = [5, 99, 23, 4, 17, 42]
+        want = base.generate(prompt, max_new_tokens=10).output_ids
+
+        mesh = make_mesh(MeshConfig(tp=4, tq=2))
+        eng = InferenceEngine(cfg, params, EngineConfig(**ecfg),
+                              kv_dtype=jnp.float32, mesh=mesh)
+        got = eng.generate(prompt, max_new_tokens=10).output_ids
+        assert got == want
+
+    def test_grouped_gqa_ring_prefill_matches(self):
+        """sp x tp x tq: ring chunked prefill with the grouped head split
+        engaged (one kv head per shard, q heads over ("tp","tq")) is
+        token-exact vs the single-device engine."""
+        cfg = ModelConfig(name="par-ring-grouped", vocab_size=128,
+                          hidden_size=64, intermediate_size=128,
+                          num_layers=2, num_heads=8, num_kv_heads=2,
+                          head_dim=8, dtype="float32")
+        params = init_params(cfg, jax.random.PRNGKey(7))
+        assert factor_tp_for_kv(4, cfg.num_kv_heads) == (2, 2)
+        ecfg = dict(max_batch=2, page_size=8, num_pages=32,
+                    max_pages_per_seq=8, prefill_buckets=(8, 16))
+        prompt = [3, 17, 92, 5, 44, 8, 29, 61, 7, 12, 90, 2]  # > bucket/sp
+        base = InferenceEngine(cfg, params, EngineConfig(**ecfg),
+                               kv_dtype=jnp.float32)
+        want = base.generate(prompt, max_new_tokens=6).output_ids
+        mesh = make_mesh(MeshConfig(sp=2, tp=2, tq=2))
+        eng = InferenceEngine(cfg, params, EngineConfig(**ecfg),
+                              kv_dtype=jnp.float32, mesh=mesh)
+        assert eng.cfg.prefill_ring
+        got = eng.generate(prompt, max_new_tokens=6).output_ids
+        assert got == want
+
+    def test_grouped_ring_falls_back_with_multiple_kv_heads_per_shard(self):
+        """When the kv sub-axis leaves >1 kv head per shard (gcd split,
+        e.g. Hkv=6 at degree 4 -> tp=2 x tq=2, 3 heads/shard), the ring
+        must NOT engage the grouped q split — ring_attention's local
+        m // n_rep head map assumes one kv head per shard.  The fallback
+        (q and kv both plain-"tp", replicated over tq) stays token-exact."""
+        cfg = ModelConfig(name="par-ring-gcd", vocab_size=128,
+                          hidden_size=96, intermediate_size=128,
+                          num_layers=2, num_heads=12, num_kv_heads=6,
+                          head_dim=8, dtype="float32")
+        params = init_params(cfg, jax.random.PRNGKey(8))
+        assert factor_tp_for_kv(4, cfg.num_kv_heads) == (2, 2)
+        ecfg = dict(max_batch=2, page_size=8, num_pages=32,
+                    max_pages_per_seq=8, prefill_buckets=(8, 16))
+        prompt = [3, 17, 92, 5, 44, 8, 29, 61, 7, 12, 90, 2]
+        base = InferenceEngine(cfg, params, EngineConfig(**ecfg),
+                               kv_dtype=jnp.float32)
+        want = base.generate(prompt, max_new_tokens=6).output_ids
+        mesh = make_mesh(MeshConfig(sp=2, tp=2, tq=2))
+        eng = InferenceEngine(cfg, params, EngineConfig(**ecfg),
+                              kv_dtype=jnp.float32, mesh=mesh)
+        assert eng.cfg.prefill_ring
+        got = eng.generate(prompt, max_new_tokens=6).output_ids
+        assert got == want
+
+    def test_grouped_gqa_with_int8_weights_and_kv(self, model):
+        """Grouped layout composed with BOTH quantization tiers: int8
+        QTensor params place under tuple ("tp","tq") specs (the scale
+        follows with contraction dims unsharded) and the int8 KV pool
+        shards over "tp" alone.  Token-exact vs the same-quantized
+        unsharded engine."""
+        from kafka_tpu.models import quantize_params
+
+        cfg, params = model
+        qp = quantize_params(params, cfg)
+        ecfg = dict(max_batch=2, page_size=8, num_pages=32,
+                    max_pages_per_seq=8, prefill_buckets=(8, 16),
+                    kv_quantize="int8")
+        base = InferenceEngine(cfg, qp, EngineConfig(**ecfg),
+                               kv_dtype=jnp.float32)
+        prompt = [5, 99, 23, 4, 17, 42]
+        want = base.generate(prompt, max_new_tokens=10).output_ids
+
+        mesh = make_mesh(MeshConfig(tp=4, tq=2))
+        eng = InferenceEngine(cfg, qp, EngineConfig(**ecfg),
+                              kv_dtype=jnp.float32, mesh=mesh)
+        got = eng.generate(prompt, max_new_tokens=10).output_ids
+        assert got == want
 
 
 class TestRingAttention:

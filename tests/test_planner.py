@@ -44,12 +44,16 @@ class TestWeightArithmetic:
         # sharded part must divide by ~8
         assert math.isclose(tp8 - embed, (full - embed) / 8, rel_tol=0.01)
 
-    def test_kv_replication_fallback_when_tp_exceeds_kv_heads(self):
-        # 70B: 8 kv heads, tp=16 -> wk/wv replicated (sharding.py:45-50)
+    def test_grouped_kv_shard_when_tp_exceeds_kv_heads(self):
+        # 70B: 8 kv heads, degree 16 -> grouped layout (tp=8 x tq=2):
+        # per-chip KV is 1/8 of the pool, NOT a full copy
+        # (parallel/mesh.py factor_tp_for_kv)
         cfg = get_config("llama-3-70b")
-        t = kv_bytes_per_token(cfg, tp=16)
-        assert t == kv_bytes_per_token(cfg, tp=1)
-        assert kv_bytes_per_token(cfg, tp=8) == t // 8
+        full = kv_bytes_per_token(cfg, tp=1)
+        assert kv_bytes_per_token(cfg, tp=16) == full // 8
+        assert kv_bytes_per_token(cfg, tp=8) == full // 8
+        # a degree sharing no factor with Hkv degrades to full replication
+        assert kv_bytes_per_token(cfg, tp=3) == full
 
     def test_moe_experts_shard_over_ep_and_tp(self):
         cfg = get_config("mixtral-8x7b")
@@ -105,13 +109,32 @@ class TestBaselineTopologies:
         scfg = ServingConfig.profile_32k()
         plan = plan_for_serving(scfg, chip="v5p")
         assert plan.fits
-        assert plan.kv_replicated  # tp=16 > 8 kv heads -> replicated pool
-        # the configured pool (4 x 32k windows + trash) leaves room, and
-        # leftover HBM holds at least 7 concurrent full 32k windows
-        assert plan.max_concurrent_windows >= 7
-        # per-device weights ~12.4 GiB: 140 GB of bf16 across tp=16 with
-        # replicated embed + kv projections
-        assert 11 * GiB < plan.weight_bytes < 14 * GiB
+        # degree 16 over 8 kv heads -> grouped layout (tp=8 x tq=2): the
+        # pool shards 8-ways, each head on 2 chips — partially replicated
+        assert plan.kv_replicated
+        assert "tp=8 x tq=2" in plan.notes
+        # grouped sharding holds 61 concurrent full 32k windows in leftover
+        # HBM (the fully-replicated fallback held 7)
+        assert plan.max_concurrent_windows >= 61
+        # per-device weights ~10.2 GiB: 140 GB of bf16 across tp=16 with
+        # replicated embed; kv projections now 8-way sharded
+        assert 9 * GiB < plan.weight_bytes < 12 * GiB
+
+    def test_ulysses_config_charges_full_replication(self):
+        """cp_strategy='ulysses' keeps the plain tensor axis (the engine
+        rejects tq>1 with the all_to_all head scatter), so the plan must
+        charge FULL kv replication — not the grouped layout the server
+        would build for ring CP.  Plan and placement resolve through the
+        same resolve_tensor_axes call (parallel/mesh.py)."""
+        import dataclasses
+
+        ring = ServingConfig.profile_32k()
+        uly = dataclasses.replace(ring, cp_strategy="ulysses")
+        ring_plan = plan_for_serving(ring, chip="v5p")
+        uly_plan = plan_for_serving(uly, chip="v5p")
+        # 70B, 8 kv heads, degree 16: grouped shards kv 8-ways
+        assert uly_plan.kv_bytes_per_token == 8 * ring_plan.kv_bytes_per_token
+        assert "plain tensor axis" in uly_plan.notes
 
     def test_config5_would_not_fit_on_v5e(self):
         scfg = ServingConfig.profile_32k()
