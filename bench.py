@@ -806,12 +806,16 @@ def main() -> None:
             sweep[f"{b}-int8kv"] = {
                 "decode_tok_s": round(tps, 1),
                 "steps_per_s": round(sps, 1),
-                "note": ("per-slot int8 KV pool, page-granular XLA window "
-                         "gather; HALF the KV bytes -> 2x window capacity "
-                         "(planner).  Compare row '32' (bf16 KV, pallas "
-                         "kernel); dev A/B this round: pallas-bf16 4623, "
-                         "xla-bf16 page-gather 4031, int8 page-gather 3822 "
-                         "tok/s (slot-granular gather was 2385)"),
+                "note": ("per-slot int8 KV pool; on TPU 'auto' now "
+                         "resolves to the int8 pallas kernel "
+                         "(paged_decode_attention_int8: int8 page DMAs — "
+                         "half the KV bytes — with the per-slot dequant "
+                         "fused into scores/probs).  HALF the KV bytes -> "
+                         "2x window capacity (planner).  Same-link A/B at "
+                         "b32 1B: int8-pallas 4667, int8-xla-gather 3455, "
+                         "bf16-pallas 4756 tok/s — int8 KV costs ~2% vs "
+                         "bf16 now, not the r5-early 17% (xla-gather "
+                         "3822 vs 4623; slot-granular gather was 2385)"),
             }
             log(f"decode b{b} int8-kv: {tps:.1f} tok/s")
 

@@ -220,21 +220,64 @@ def _attention_block(
             and s == 1
             and paged.page_table is not None
         ):
-            from ..ops.pallas import paged_decode_attention
+            interp = jax.default_backend() != "tpu"
+            on_mesh = mesh is not None and mesh.size > 1
+            if isinstance(k_cache, QTensor):
+                # int8 pool: the int8 kernel DMAs half the bytes and
+                # fuses the per-slot dequant into scores/probabilities
+                from ..ops.pallas import (
+                    paged_decode_attention_int8,
+                    paged_decode_attention_int8_sharded,
+                )
 
-            out = paged_decode_attention(
-                q[:, 0],  # [B, Hq, D]
-                k_cache,
-                v_cache,
-                paged.page_table,
-                paged.seq_lens,
-                page_size=paged.page_size,
-                interpret=jax.default_backend() != "tpu",
-            )[:, None]  # [B, 1, Hq, D]
+                if on_mesh:
+                    out = paged_decode_attention_int8_sharded(
+                        mesh, q[:, 0],
+                        k_cache.q, k_cache.s, v_cache.q, v_cache.s,
+                        paged.page_table, paged.seq_lens,
+                        page_size=paged.page_size, interpret=interp,
+                    )[:, None]
+                else:
+                    out = paged_decode_attention_int8(
+                        q[:, 0],
+                        k_cache.q, k_cache.s, v_cache.q, v_cache.s,
+                        paged.page_table, paged.seq_lens,
+                        page_size=paged.page_size, interpret=interp,
+                    )[:, None]
+            elif on_mesh:
+                # per-shard kernel over the tp(/tq) head split: shard_map
+                # runs the custom call GSPMD cannot partition (engine
+                # validates pallas_mesh_ok at construction)
+                from ..ops.pallas import paged_decode_attention_sharded
+
+                out = paged_decode_attention_sharded(
+                    mesh,
+                    q[:, 0],  # [B, Hq, D]
+                    k_cache,
+                    v_cache,
+                    paged.page_table,
+                    paged.seq_lens,
+                    page_size=paged.page_size,
+                    interpret=interp,
+                )[:, None]
+            else:
+                from ..ops.pallas import paged_decode_attention
+
+                out = paged_decode_attention(
+                    q[:, 0],  # [B, Hq, D]
+                    k_cache,
+                    v_cache,
+                    paged.page_table,
+                    paged.seq_lens,
+                    page_size=paged.page_size,
+                    interpret=interp,
+                )[:, None]  # [B, 1, Hq, D]
         elif (
             cfg.attention_backend == "pallas"
             and s > 1
             and b == 1
+            and (mesh is None or mesh.size == 1)
+            and not isinstance(k_cache, QTensor)
             and paged.page_table is not None
             and paged.start is not None
         ):

@@ -5,15 +5,31 @@ behind remote gateways); these kernels are the TPU-native equivalent of the
 CUDA kernels a GPU serving stack would carry.  Each kernel is validated
 against the XLA reference formulation in ops/attention.py, which remains the
 numerics ground truth and the portable fallback (CPU tests, non-TPU
-platforms, and sharded meshes where GSPMD cannot partition a custom call).
+platforms, and mesh layouts the per-shard kernel cannot express).
 
 Selection is driven by `ModelConfig.attention_backend`:
-  "auto"   — pallas on single-device TPU paged decode, xla otherwise
+  "auto"   — pallas for paged decode on single-device TPU AND on pure
+             tp(/tq) meshes whose head split lines up per-shard
+             (pallas_mesh_ok: shard_map runs the kernel per device);
+             xla otherwise
   "pallas" — force the kernels (interpret mode off-TPU; tests use this)
   "xla"    — force the reference path
 """
 
 from .flash_prefill import paged_prefill_attention
-from .paged_attention import paged_decode_attention
+from .paged_attention import (
+    paged_decode_attention,
+    paged_decode_attention_int8,
+    paged_decode_attention_int8_sharded,
+    paged_decode_attention_sharded,
+    pallas_mesh_ok,
+)
 
-__all__ = ["paged_decode_attention", "paged_prefill_attention"]
+__all__ = [
+    "paged_decode_attention",
+    "paged_decode_attention_int8",
+    "paged_decode_attention_int8_sharded",
+    "paged_decode_attention_sharded",
+    "paged_prefill_attention",
+    "pallas_mesh_ok",
+]

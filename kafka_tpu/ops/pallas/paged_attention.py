@@ -244,3 +244,322 @@ def paged_decode_attention(
     )(page_table, seq_lens, qx, k_pages, v_pages)
     # each query row's result lives in its own kv head's lane block
     return out_wide.reshape(B, Hq, Hkv, D)[:, jnp.arange(Hq), kv_of_q]
+
+
+def pallas_mesh_ok(mesh, num_heads: int, num_kv_heads: int) -> bool:
+    """Can the decode kernel run per-shard on this mesh via shard_map?
+
+    GSPMD cannot partition a Pallas custom call, but shard_map runs it
+    per device on local shards.  The head split must line up with
+    parallel/sharding.py's layout:
+
+    * only the tensor axes may be >1 (dp/sp/pp/ep shard things the
+      kernel's per-shard view cannot express);
+    * kv heads split over "tp" (tp | Hkv), q heads over ("tp","tq");
+    * per-shard GQA must keep the kernel's contiguous q->kv map: any
+      local kv-head count works when tq == 1 (plain Megatron split), but
+      a grouped mesh (tq > 1) needs exactly ONE kv head per shard — the
+      same invariant ring_attention's _prefill_sharded enforces.
+    """
+    if mesh is None or mesh.size == 1:
+        return True
+    tp = mesh.shape.get("tp", 1)
+    tq = mesh.shape.get("tq", 1)
+    if tp * tq != mesh.size or tp <= 1:
+        return False
+    if num_kv_heads % tp or num_heads % (tp * tq):
+        return False
+    if (num_heads // num_kv_heads) % tq:
+        return False
+    return tq == 1 or num_kv_heads // tp == 1
+
+
+def paged_decode_attention_sharded(
+    mesh,
+    q: jnp.ndarray,            # [B, Hq, D]
+    k_pool: jnp.ndarray,       # [TOTAL_SLOTS, Hkv*D]
+    v_pool: jnp.ndarray,
+    page_table: jnp.ndarray,   # [B, P]
+    seq_lens: jnp.ndarray,     # [B]
+    *,
+    page_size: int,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """The decode kernel on a tp(/tq) mesh: one kernel per device over its
+    local head shard, zero collectives (heads are embarrassingly parallel
+    in attention; the surrounding wo einsum pays the existing psum).
+
+    q heads ride ("tp","tq") and the pool's merged kv axis rides "tp",
+    matching the engine's placement (parallel/sharding.py), so shard_map
+    introduces no resharding.  check_vma is off: pallas_call's out_shape
+    carries no varying-axes metadata.  Caller must have passed
+    pallas_mesh_ok.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    q_ax = ("tp", "tq") if mesh.shape.get("tq", 1) > 1 else "tp"
+    fn = jax.shard_map(
+        functools.partial(
+            paged_decode_attention, page_size=page_size, interpret=interpret
+        ),
+        mesh=mesh,
+        in_specs=(P(None, q_ax, None), P(None, "tp"), P(None, "tp"),
+                  P(None, None), P(None)),
+        out_specs=P(None, q_ax, None),
+        check_vma=False,
+    )
+    return fn(q, k_pool, v_pool, page_table, seq_lens)
+
+
+def _decode_kernel_int8(
+    # scalar prefetch
+    page_table_ref,  # [B, P] i32
+    seq_lens_ref,    # [B] i32
+    # inputs
+    q_ref,        # [1, Hq, Hkv*D] VMEM — block-diagonal expanded q
+    ksw_ref,      # [1, NC, chunk] f32 — k per-slot scales, chunk-major
+    vsw_ref,      # [1, NC, chunk] f32 — v per-slot scales
+    k_pages_hbm,  # [num_pages, ps, Hkv*D] int8 in HBM/ANY
+    v_pages_hbm,  # [num_pages, ps, Hkv*D] int8
+    out_ref,      # [1, Hq, Hkv*D] VMEM
+    # scratch
+    kbuf,     # [2, CP*ps, Hkv*D] int8
+    vbuf,     # [2, CP*ps, Hkv*D] int8
+    ksem,
+    vsem,
+    m_ref,
+    l_ref,
+    acc_ref,
+    *,
+    page_size: int,
+    pages_per_chunk: int,
+    scale: float,
+):
+    """Int8-KV variant of _decode_kernel: pages DMA as int8 (HALF the HBM
+    traffic of the bf16 kernel — the whole point), and the per-slot
+    dequant scales fold into the math instead of materializing dequantized
+    K/V: score[h,j] = (qx . k_q^T)[h,j] * s_k[j] and the PV product uses
+    pexp * s_v — exactly runtime/kv_cache.py's `q * s` dequant, fused.
+    The scales arrive pre-gathered in LOGICAL window order (chunk-major
+    [NC, chunk] so chunk c is one static-shape sublane row — Mosaic-safe
+    dynamic indexing, no in-kernel reshape across tiles)."""
+    b = pl.program_id(0)
+    ps, cp = page_size, pages_per_chunk
+    chunk = cp * ps
+    n_valid = seq_lens_ref[b] + 1
+    n_pages = pl.cdiv(n_valid, ps)
+    n_chunks = pl.cdiv(n_pages, cp)
+
+    def issue(c, slot):
+        for j in range(cp):
+            @pl.when(c * cp + j < n_pages)
+            def _():
+                page = page_table_ref[b, c * cp + j]
+                pltpu.make_async_copy(
+                    k_pages_hbm.at[page],
+                    kbuf.at[slot, pl.ds(j * ps, ps)],
+                    ksem.at[slot, j],
+                ).start()
+                pltpu.make_async_copy(
+                    v_pages_hbm.at[page],
+                    vbuf.at[slot, pl.ds(j * ps, ps)],
+                    vsem.at[slot, j],
+                ).start()
+
+    def wait(c, slot):
+        for j in range(cp):
+            @pl.when(c * cp + j < n_pages)
+            def _():
+                page = page_table_ref[b, c * cp + j]
+                pltpu.make_async_copy(
+                    k_pages_hbm.at[page],
+                    kbuf.at[slot, pl.ds(j * ps, ps)],
+                    ksem.at[slot, j],
+                ).wait()
+                pltpu.make_async_copy(
+                    v_pages_hbm.at[page],
+                    vbuf.at[slot, pl.ds(j * ps, ps)],
+                    vsem.at[slot, j],
+                ).wait()
+
+    m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+    l_ref[...] = jnp.zeros_like(l_ref)
+    acc_ref[...] = jnp.zeros_like(acc_ref)
+    issue(0, 0)
+
+    def body(c, carry):
+        slot = jax.lax.rem(c, 2)
+
+        @pl.when(c + 1 < n_chunks)
+        def _():
+            issue(c + 1, jax.lax.rem(c + 1, 2))
+
+        wait(c, slot)
+
+        remaining = n_valid - c * chunk
+        local = jax.lax.broadcasted_iota(jnp.int32, (1, chunk), dimension=1)
+        slot_mask = local < remaining
+        local_col = jax.lax.broadcasted_iota(jnp.int32, (chunk, 1), dimension=0)
+        col_mask = local_col < remaining
+
+        ksw = ksw_ref[0, c, :][None, :]  # [1, chunk] f32
+        vsw = vsw_ref[0, c, :][None, :]
+        kc = kbuf[slot].astype(jnp.float32)  # int8 -> f32
+        # never-DMA'd rows hold stale int8 garbage, but int8 cannot be
+        # NaN/inf: K garbage is masked to NEG_INF scores, V garbage is
+        # zeroed like the dense kernel
+        vc = jnp.where(col_mask, vbuf[slot].astype(jnp.float32), 0.0)
+        qx = q_ref[0].astype(jnp.float32)
+        s = (
+            jax.lax.dot_general(
+                qx, kc,
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        ) * ksw  # fused per-slot k dequant
+        s = jnp.where(slot_mask, s, NEG_INF)
+
+        m_prev = m_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        pexp = jnp.exp(s - m_new)
+        pexp = jnp.where(slot_mask, pexp, 0.0)
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(pexp, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            pexp * vsw, vc,  # fused per-slot v dequant
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[...] = m_new
+        return carry
+
+    jax.lax.fori_loop(0, n_chunks, body, 0)
+    denom = jnp.maximum(l_ref[...], 1e-30)
+    out_ref[0, :, :] = (acc_ref[...] / denom).astype(out_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("page_size", "pages_per_chunk", "scale", "interpret"),
+)
+def paged_decode_attention_int8(
+    q: jnp.ndarray,            # [B, Hq, D]
+    k_q: jnp.ndarray,          # [TOTAL_SLOTS, Hkv*D] int8 rows
+    k_s: jnp.ndarray,          # [TOTAL_SLOTS, 1] f32 per-slot scales
+    v_q: jnp.ndarray,
+    v_s: jnp.ndarray,
+    page_table: jnp.ndarray,   # [B, P]
+    seq_lens: jnp.ndarray,     # [B]
+    *,
+    page_size: int,
+    pages_per_chunk: int = 8,
+    scale: float | None = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Decode attention straight off the int8-quantized paged pool
+    (runtime/kv_cache.py kv_quantize="int8": QTensor rows + per-slot
+    scales).  The kernel streams HALF the KV bytes of the bf16 kernel;
+    the scales ride as an XLA page-granular pre-gather (4 B/slot — noise
+    next to the row bytes) shaped chunk-major for Mosaic-safe indexing.
+    Same contract as paged_decode_attention otherwise."""
+    B, Hq, D = q.shape
+    HD = k_q.shape[1]
+    Hkv = HD // D
+    G = Hq // Hkv
+    P = page_table.shape[1]
+    if scale is None:
+        scale = D**-0.5
+    cp = min(pages_per_chunk, P)
+    nc = -(-P // cp)  # chunks per window
+    k_pages = k_q.reshape(-1, page_size, HD)
+    v_pages = v_q.reshape(-1, page_size, HD)
+
+    def window_scales(s):
+        # [SLOTS, 1] -> [B, NC, chunk] in logical window order: page-
+        # granular gather (16x fewer descriptors than per-slot), pages
+        # padded up to nc*cp so every chunk row is full width
+        sp = s.reshape(-1, page_size)[page_table]      # [B, P, ps]
+        pad = nc * cp - P
+        if pad:
+            sp = jnp.pad(sp, ((0, 0), (0, pad), (0, 0)))
+        return sp.reshape(B, nc, cp * page_size).astype(jnp.float32)
+
+    ksw = window_scales(k_s)
+    vsw = window_scales(v_s)
+
+    kv_of_q = jnp.repeat(jnp.arange(Hkv), G)
+    qx = jnp.zeros((B, Hq, Hkv, D), q.dtype)
+    qx = qx.at[:, jnp.arange(Hq), kv_of_q].set(q)
+    qx = qx.reshape(B, Hq, HD)
+
+    chunk = cp * page_size
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec((1, Hq, HD), lambda b, pt, sl: (b, 0, 0)),
+            pl.BlockSpec((1, nc, chunk), lambda b, pt, sl: (b, 0, 0)),
+            pl.BlockSpec((1, nc, chunk), lambda b, pt, sl: (b, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, Hq, HD), lambda b, pt, sl: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, chunk, HD), k_q.dtype),
+            pltpu.VMEM((2, chunk, HD), v_q.dtype),
+            pltpu.SemaphoreType.DMA((2, cp)),
+            pltpu.SemaphoreType.DMA((2, cp)),
+            pltpu.VMEM((Hq, 1), jnp.float32),
+            pltpu.VMEM((Hq, 1), jnp.float32),
+            pltpu.VMEM((Hq, HD), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _decode_kernel_int8,
+        page_size=page_size,
+        pages_per_chunk=cp,
+        scale=scale,
+    )
+    out_wide = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Hq, HD), q.dtype),
+        interpret=interpret,
+    )(page_table, seq_lens, qx, ksw, vsw, k_pages, v_pages)
+    return out_wide.reshape(B, Hq, Hkv, D)[:, jnp.arange(Hq), kv_of_q]
+
+
+def paged_decode_attention_int8_sharded(
+    mesh,
+    q: jnp.ndarray,
+    k_q: jnp.ndarray,
+    k_s: jnp.ndarray,
+    v_q: jnp.ndarray,
+    v_s: jnp.ndarray,
+    page_table: jnp.ndarray,
+    seq_lens: jnp.ndarray,
+    *,
+    page_size: int,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Int8 kernel on a tp(/tq) mesh — same layout contract as
+    paged_decode_attention_sharded; the per-slot scales are head-agnostic
+    ([SLOTS, 1]) and ride replicated."""
+    from jax.sharding import PartitionSpec as P
+
+    q_ax = ("tp", "tq") if mesh.shape.get("tq", 1) > 1 else "tp"
+    fn = jax.shard_map(
+        functools.partial(
+            paged_decode_attention_int8,
+            page_size=page_size, interpret=interpret,
+        ),
+        mesh=mesh,
+        in_specs=(P(None, q_ax, None),
+                  P(None, "tp"), P(None, None),
+                  P(None, "tp"), P(None, None),
+                  P(None, None), P(None)),
+        out_specs=P(None, q_ax, None),
+        check_vma=False,
+    )
+    return fn(q, k_q, k_s, v_q, v_s, page_table, seq_lens)

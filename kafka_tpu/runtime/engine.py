@@ -381,20 +381,29 @@ class InferenceEngine:
             and mesh is not None
             and mesh.size > 1
         ):
-            raise ValueError(
-                "attention_backend='pallas' cannot run on a multi-device "
-                "mesh: GSPMD cannot partition a Pallas custom call — use "
-                "'auto' or 'xla' with TP/SP meshes"
-            )
+            from ..ops.pallas import pallas_mesh_ok
+
+            if not pallas_mesh_ok(mesh, cfg.num_heads, cfg.num_kv_heads):
+                raise ValueError(
+                    "attention_backend='pallas' needs a pure tp(/tq) mesh "
+                    "whose head split lines up per-shard (tp | kv heads; "
+                    "grouped meshes need one kv head per shard) — this "
+                    f"mesh is {dict(mesh.shape)} over Hq={cfg.num_heads}/"
+                    f"Hkv={cfg.num_kv_heads}: use 'auto' or 'xla'"
+                )
         self.cfg = cfg.replace(
             attention_backend=self._resolve_backend(cfg, self.ecfg, mesh),
             prefill_ring=sp > 1,
             cp_strategy=self.ecfg.cp_strategy,
         )
-        if self.cfg.attention_backend == "pallas":
+        if self.cfg.attention_backend == "pallas" and (
+            mesh is None or mesh.size == 1
+        ) and not self.ecfg.kv_quantize:
             # flash prefill tiles chunks into q_block=64 rows (ops/pallas/
             # flash_prefill.py); catch the misconfiguration at construction
-            # rather than as an opaque trace-time error
+            # rather than as an opaque trace-time error.  Mesh engines and
+            # int8-KV engines keep prefill on the XLA path (llama.py), so
+            # the constraint is single-device dense-pool only.
             bad = [
                 b for b in self.ecfg.prefill_buckets
                 if b > 64 and b % 64
@@ -521,9 +530,11 @@ class InferenceEngine:
         """Pick the decode attention backend (EngineConfig "auto" rule).
 
         The Pallas kernel needs: a real TPU (it runs in slow interpret mode
-        anywhere else), no multi-device mesh (GSPMD cannot partition a
-        custom call — the TP path keeps the XLA formulation), a merged KV
-        row that is lane-tile aligned (Hkv*D % 128), page rows aligned
+        anywhere else), a mesh whose head split the per-shard kernel can
+        express (single device, or a pure tp/tq mesh passing
+        pallas_mesh_ok — shard_map runs the custom call GSPMD cannot
+        partition), a merged KV row that is lane-tile aligned
+        (Hkv*D % 128, per shard on meshes), page rows aligned
         to the bf16 sublane tile (page_size % 16), and head geometry whose
         kernel intermediates fit scoped VMEM: the flash-prefill kernel
         stacks a [Hq*D, Hkv*D]-shaped bf16 working set, which at
@@ -533,22 +544,58 @@ class InferenceEngine:
         """
         choice = ecfg.attention_backend
         if ecfg.kv_quantize:
-            # int8 KV rows carry per-slot scales the Pallas kernels'
-            # dense-row DMA contract doesn't know about; the XLA gather
-            # dequantizes in-graph (models/llama.py _kv_read)
-            if choice == "pallas":
-                raise ValueError(
-                    "attention_backend='pallas' is incompatible with "
-                    "kv_quantize: the paged kernels DMA dense rows"
+            # int8 KV: decode runs the int8 kernel (int8 page DMAs — half
+            # the bf16 kernel's HBM traffic — with the per-slot dequant
+            # fused into scores/probabilities, paged_attention.py);
+            # prefill keeps the XLA dequantizing gather (llama.py gates
+            # the flash kernel off QTensor pools).
+            if choice != "auto":
+                return choice
+            merged_kv = cfg.num_kv_heads * cfg.head_dim
+            if mesh is not None and mesh.size > 1:
+                from ..ops.pallas import pallas_mesh_ok
+
+                tp = mesh.shape.get("tp", 1)
+                ok = (
+                    jax.default_backend() == "tpu"
+                    and pallas_mesh_ok(
+                        mesh, cfg.num_heads, cfg.num_kv_heads
+                    )
+                    and (merged_kv // tp) % 128 == 0
+                    and ecfg.page_size % 16 == 0
                 )
-            return "xla"
+                return "pallas" if ok else "xla"
+            ok = (
+                jax.default_backend() == "tpu"
+                and merged_kv % 128 == 0
+                and ecfg.page_size % 16 == 0
+            )
+            return "pallas" if ok else "xla"
         if choice != "auto":
             return choice
         merged_q = cfg.num_heads * cfg.head_dim
         merged_kv = cfg.num_kv_heads * cfg.head_dim
+        if mesh is not None and mesh.size > 1:
+            # mesh path: the decode kernel runs per-shard via shard_map
+            # (paged_decode_attention_sharded); prefill keeps the XLA
+            # formulation (models/llama.py gates the flash kernel to
+            # single-device), so only the decode kernel's per-shard
+            # geometry matters: the pool's LOCAL merged row must stay
+            # lane-tile aligned.  VMEM is no constraint — decode scratch
+            # is a few chunk buffers, not flash-prefill's [Hq*D, Hkv*D]
+            # working set.
+            from ..ops.pallas import pallas_mesh_ok
+
+            tp = mesh.shape.get("tp", 1)
+            ok = (
+                jax.default_backend() == "tpu"
+                and pallas_mesh_ok(mesh, cfg.num_heads, cfg.num_kv_heads)
+                and (merged_kv // tp) % 128 == 0
+                and ecfg.page_size % 16 == 0
+            )
+            return "pallas" if ok else "xla"
         ok = (
             jax.default_backend() == "tpu"
-            and (mesh is None or mesh.size == 1)
             and merged_kv % 128 == 0
             and ecfg.page_size % 16 == 0
             and merged_q * merged_kv * 2 <= 7 * 1024 * 1024
@@ -614,6 +661,7 @@ class InferenceEngine:
                 logits, cache = forward(
                     params, cfg, last_tokens[:, None], positions,
                     kv_cache=KVCache(k_pool, v_pool), paged=paged,
+                    mesh=mesh,
                 )
             logits = logits[:, 0]
             keys = jax.vmap(

@@ -75,8 +75,17 @@ class ServingConfig:
     # so the first real request doesn't pay the 20-40s XLA compile
     warmup: bool = True
     # persistent XLA compilation cache: warm reboots reuse compiled
-    # programs from disk instead of recompiling every bucket ("" disables)
-    compile_cache_dir: str = "~/.cache/kafka_tpu/xla"
+    # programs from disk instead of recompiling every bucket ("" disables).
+    # The default honors KAFKA_TPU_COMPILE_CACHE at CONSTRUCTION time (not
+    # just via from_env): the test suite points it at a fresh per-run dir
+    # because a shared on-disk cache can hold executables AOT-compiled on
+    # a different host of a migrating environment, and XLA hard-aborts
+    # (uncatchably) loading one with mismatched machine features.
+    compile_cache_dir: str = dataclasses.field(
+        default_factory=lambda: os.environ.get(
+            "KAFKA_TPU_COMPILE_CACHE", "~/.cache/kafka_tpu/xla"
+        )
+    )
 
     @classmethod
     def profile_32k(cls, **overrides) -> "ServingConfig":
@@ -143,6 +152,7 @@ class ServingConfig:
             quantize=get("QUANTIZE", cls.quantize),
             kv_quantize=get("KV_QUANTIZE", cls.kv_quantize),
             warmup=get("WARMUP", "1") not in ("0", "false", "False"),
-            compile_cache_dir=get("COMPILE_CACHE", cls.compile_cache_dir),
+            # compile_cache_dir omitted: its default_factory already reads
+            # KAFKA_TPU_COMPILE_CACHE
         )
         return dataclasses.replace(cfg, **overrides)

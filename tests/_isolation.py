@@ -1,0 +1,52 @@
+"""Subprocess isolation for the suite's heaviest compile-load tests.
+
+Root cause this defends against (diagnosed, not guessed): every
+JIT-compiled XLA:CPU executable holds process memory mappings; the full
+suite compiles thousands and the per-process mapping count crosses
+vm.max_map_count near the end of the run, at which point mmap fails and
+XLA dies with an uncatchable segfault/abort at whatever compile runs next
+— observed four times at a shifting late-suite test (cache write, cache
+read, plain compile of a jnp.ones).  conftest.py raises the sysctl when
+privileged and purges executables between modules; the tests here —
+interpret-mode Pallas kernels inside shard_map engines, which compile
+large 8-device SPMD programs — additionally run in a fresh child
+interpreter so their mapping load never lands on the parent at all.
+Correctness is still asserted (the child's pass/fail propagates).
+
+On real TPU hardware the kernels compile through Mosaic and none of this
+applies; it is purely test-process resource containment.
+"""
+
+from __future__ import annotations
+
+import os
+import pathlib
+import subprocess
+import sys
+
+ISOLATED_FLAG = "KAFKA_TPU_TEST_ISOLATED"
+_REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def isolated(test_id: str) -> bool:
+    """Return True when the caller should run its real body (we are the
+    child); otherwise spawn the child for `test_id`, assert it passed,
+    and return False so the caller exits immediately."""
+    if os.environ.get(ISOLATED_FLAG):
+        return True
+    env = dict(os.environ)
+    env[ISOLATED_FLAG] = "1"
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "-x", "-q", "-p", "no:cacheprovider",
+         test_id],
+        capture_output=True,
+        text=True,
+        env=env,
+        cwd=_REPO,
+        timeout=900,
+    )
+    assert proc.returncode == 0, (
+        f"isolated run of {test_id} failed (rc={proc.returncode}):\n"
+        f"{proc.stdout[-4000:]}\n{proc.stderr[-2000:]}"
+    )
+    return False

@@ -21,10 +21,53 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 # Hermetic suite: never dial the default remote MCP server from tests
 # (individual tests override this to exercise the config parser).
 os.environ.setdefault("KAFKA_TPU_MCP_SERVERS", "[]")
+# NO persistent compile cache in tests: server boots would enable it
+# (ServingConfig.compile_cache_dir), but serializing/deserializing CPU
+# SPMD executables segfaults/aborts INSIDE XLA in this environment —
+# observed three times at suite scale, in both put_executable_and_time
+# (write) and get_executable_and_time (read, machine-feature mismatch
+# from a migrated host).  An in-process crash is uncatchable and kills
+# the whole run, so tests disable the cache outright ("" = off,
+# server/app.py); the TPU serving path keeps it — TPU executable
+# serialization has been exercised for rounds without incident.
+os.environ["KAFKA_TPU_COMPILE_CACHE"] = ""
+
+# The root cause of full-suite crashes (segfault/abort inside XLA:CPU
+# compile, detonating at a shifting late-suite test): every JIT-compiled
+# executable holds process memory mappings, the suite compiles thousands,
+# and the count crosses vm.max_map_count (65530 default) near the end —
+# mmap starts failing and LLVM/XLA dies uncatchably.  Measured: ~42k maps
+# six minutes into the run, growing ~5k/min.  Two defenses: raise the
+# sysctl when permitted (containers often run as root), and drop compiled
+# executables between test modules (fixture below).
+try:
+    with open("/proc/sys/vm/max_map_count") as _f:
+        _cur = int(_f.read())
+    if _cur < 262144:
+        with open("/proc/sys/vm/max_map_count", "w") as _f:
+            _f.write("262144")
+except (OSError, ValueError):
+    pass  # not privileged / not Linux: the per-module purge still applies
+
+import gc  # noqa: E402
+
+import pytest  # noqa: E402
 
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _drop_xla_executables():
+    """Per-module XLA executable purge (see max_map_count note above).
+
+    Engines and jitted helpers from a finished module are garbage;
+    clearing jax's caches and collecting frees their code mappings.  Live
+    objects from module-scoped fixtures simply recompile on next use."""
+    yield
+    jax.clear_caches()
+    gc.collect()
 # DEFAULT matmul precision runs f32 einsums through a reduced-precision fast
 # path (bf16 passes on TPU MXU, oneDNN on CPU) whose rounding is
 # shape-dependent — decode-vs-full-forward token comparisons then flip on

@@ -30,12 +30,14 @@ def model():
     return cfg, params
 
 
-def make_engine(cfg, params, kv_quantize="", mesh=None):
+def make_engine(cfg, params, kv_quantize="", mesh=None,
+                attention_backend="auto"):
     return InferenceEngine(
         cfg, params,
         EngineConfig(max_batch=2, page_size=8, num_pages=64,
                      max_pages_per_seq=8, prefill_buckets=(8, 16, 32),
-                     kv_quantize=kv_quantize),
+                     kv_quantize=kv_quantize,
+                     attention_backend=attention_backend),
         kv_dtype=jnp.float32, mesh=mesh,
     )
 
@@ -119,17 +121,21 @@ class TestQuantizedKVServing:
             p2, max_new_tokens=6)
         assert r2.output_ids == ref.output_ids
 
-    def test_forced_pallas_rejected(self, model):
+    def test_forced_pallas_int8_matches_xla_int8(self, model):
+        """The int8 decode kernel (paged_decode_attention_int8: int8 page
+        DMAs + fused per-slot dequant) through the engine matches the XLA
+        dequantizing-gather path token-for-token — both read the SAME
+        quantized pool, so the kernels must agree."""
         cfg, params = model
-        with pytest.raises(ValueError, match="pallas"):
-            InferenceEngine(
-                cfg, params,
-                EngineConfig(max_batch=2, page_size=8, num_pages=64,
-                             max_pages_per_seq=8, prefill_buckets=(8,),
-                             kv_quantize="int8",
-                             attention_backend="pallas"),
-                kv_dtype=jnp.float32,
-            )
+        outs = {}
+        for backend in ("xla", "pallas"):
+            eng = make_engine(cfg, params, kv_quantize="int8",
+                              attention_backend=backend)
+            assert eng.cfg.attention_backend == backend
+            outs[backend] = eng.generate(
+                [3, 17, 92, 5, 44, 8, 29], max_new_tokens=12
+            ).output_ids
+        assert outs["pallas"] == outs["xla"]
 
 
 @pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
@@ -145,6 +151,30 @@ class TestQuantizedKVTP:
         want = base.generate(prompt, max_new_tokens=10).output_ids
         got = eng.generate(prompt, max_new_tokens=10).output_ids
         assert got == want
+
+    def test_tp_pallas_int8_matches_xla(self, model):
+        """The sharded int8 kernel (shard_map per-shard DMAs, scales
+        replicated) through a tp mesh engine matches the xla int8 mesh
+        engine token-for-token.  Child-isolated (tests/_isolation.py)."""
+        from _isolation import isolated
+
+        if not isolated(
+            "tests/test_kv_quant.py::TestQuantizedKVTP::"
+            "test_tp_pallas_int8_matches_xla"
+        ):
+            return
+        from kafka_tpu.parallel import MeshConfig, make_mesh
+
+        cfg, params = model
+        outs = {}
+        for backend in ("xla", "pallas"):
+            eng = make_engine(cfg, params, kv_quantize="int8",
+                              attention_backend=backend,
+                              mesh=make_mesh(MeshConfig(tp=2)))
+            outs[backend] = eng.generate(
+                [5, 99, 23, 4, 17], max_new_tokens=10
+            ).output_ids
+        assert outs["pallas"] == outs["xla"]
 
 
 class TestConfigWiring:

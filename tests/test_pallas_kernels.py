@@ -100,6 +100,35 @@ class TestPagedDecodeAttention:
         )
         np.testing.assert_allclose(np.asarray(out), ref, atol=2e-5, rtol=2e-5)
 
+    @pytest.mark.parametrize("seed", [0, 4])
+    def test_int8_kernel_matches_dequantized_reference(self, seed):
+        """paged_decode_attention_int8 (int8 page DMAs + fused per-slot
+        dequant) == the XLA path on the explicitly dequantized window:
+        score[h,j] = (qx . k_q^T)[h,j] * s_k[j] and pexp * s_v must equal
+        attention over q*s exactly (up to f32 associativity)."""
+        from kafka_tpu.models.quant import quantize_array
+        from kafka_tpu.ops.pallas import paged_decode_attention_int8
+
+        q, k_pool, v_pool, table, seq_lens = make_paged_case(
+            seed, B=4, P=6, ps=8, Hq=8, Hkv=4, D=32, num_pages=32
+        )
+        HD = k_pool.shape[1] * k_pool.shape[2]
+        kq = quantize_array(jnp.asarray(k_pool).reshape(-1, HD), (1,))
+        vq = quantize_array(jnp.asarray(v_pool).reshape(-1, HD), (1,))
+        # reference attends the DEQUANTIZED values — the kernel's fused
+        # scale application must match it, not the original f32 pool
+        kd = np.asarray(kq.q, np.float32).reshape(k_pool.shape) * \
+            np.asarray(kq.s)[:, None]
+        vd = np.asarray(vq.q, np.float32).reshape(v_pool.shape) * \
+            np.asarray(vq.s)[:, None]
+        ref = xla_reference(q, kd, vd, table, seq_lens, ps=8)
+        out = paged_decode_attention_int8(
+            jnp.asarray(q), kq.q, kq.s, vq.q, vq.s,
+            jnp.asarray(table), jnp.asarray(seq_lens),
+            page_size=8, interpret=True,
+        )
+        np.testing.assert_allclose(np.asarray(out), ref, atol=2e-5, rtol=2e-5)
+
     def test_bf16_pools(self):
         q, k_pool, v_pool, table, seq_lens = make_paged_case(
             11, B=2, P=4, ps=8, Hq=8, Hkv=4, D=32, num_pages=16
@@ -144,3 +173,146 @@ class TestEnginePallasBackend:
             )
             outs[backend] = eng.generate(prompt, max_new_tokens=6).output_ids
         assert outs["pallas"] == outs["xla"]
+
+    @pytest.mark.skipif(len(jax.devices()) < 8,
+                        reason="needs 8 virtual devices")
+    @pytest.mark.parametrize("mesh_axes", [
+        {"tp": 2},            # plain Megatron split (1 kv head/shard)
+        {"tp": 2, "tq": 2},   # grouped GQA (q over tp*tq, kv over tp)
+    ])
+    def test_engine_pallas_on_mesh_matches_xla(self, mesh_axes):
+        """Forced-pallas engine ON A MESH (decode kernel per-shard via
+        shard_map, prefill on the XLA path) is token-exact vs the forced-
+        xla mesh engine AND the single-device engine — the capability
+        GSPMD alone cannot provide (it cannot partition a custom call).
+
+        Runs in a child interpreter: shard_map-wrapped interpret-mode
+        kernels destabilize the shared test process (tests/_isolation.py).
+        """
+        from _isolation import isolated
+
+        pid = "mesh_axes1" if "tq" in mesh_axes else "mesh_axes0"
+        if not isolated(
+            "tests/test_pallas_kernels.py::TestEnginePallasBackend::"
+            f"test_engine_pallas_on_mesh_matches_xla[{pid}]"
+        ):
+            return
+        from kafka_tpu.models import ModelConfig, init_params
+        from kafka_tpu.parallel import MeshConfig, make_mesh
+        from kafka_tpu.runtime import EngineConfig, InferenceEngine
+
+        cfg = ModelConfig(name="pallas-mesh", vocab_size=128,
+                          hidden_size=64, intermediate_size=128,
+                          num_layers=2, num_heads=8, num_kv_heads=2,
+                          head_dim=16, dtype="float32")
+        params = init_params(cfg, jax.random.PRNGKey(13))
+        prompt = list(np.random.RandomState(3).randint(1, 128, size=21))
+        ecfg = dict(max_batch=2, page_size=16, num_pages=32,
+                    max_pages_per_seq=8, prefill_buckets=(16,))
+        want = InferenceEngine(
+            cfg, params, EngineConfig(**ecfg), kv_dtype=jnp.float32
+        ).generate(prompt, max_new_tokens=6).output_ids
+        outs = {}
+        for backend in ("xla", "pallas"):
+            eng = InferenceEngine(
+                cfg, params,
+                EngineConfig(**ecfg, attention_backend=backend),
+                kv_dtype=jnp.float32,
+                mesh=make_mesh(MeshConfig(**mesh_axes)),
+            )
+            outs[backend] = eng.generate(prompt, max_new_tokens=6).output_ids
+        assert outs["pallas"] == outs["xla"] == want
+
+    def test_pallas_mesh_ok_gates(self):
+        from kafka_tpu.ops.pallas import pallas_mesh_ok
+        from kafka_tpu.parallel import MeshConfig, make_mesh
+
+        if len(jax.devices()) < 8:
+            pytest.skip("needs 8 virtual devices")
+        # plain tp over dividing kv heads: ok at any local kv count
+        assert pallas_mesh_ok(make_mesh(MeshConfig(tp=2)), 8, 4)
+        assert pallas_mesh_ok(make_mesh(MeshConfig(tp=2)), 8, 2)
+        # grouped: exactly one kv head per shard required
+        assert pallas_mesh_ok(make_mesh(MeshConfig(tp=2, tq=2)), 8, 2)
+        assert not pallas_mesh_ok(make_mesh(MeshConfig(tp=2, tq=2)), 8, 4)
+        # tp must divide kv heads
+        assert not pallas_mesh_ok(make_mesh(MeshConfig(tp=4)), 8, 2)
+        # non-tensor axes exclude the per-shard kernel
+        assert not pallas_mesh_ok(make_mesh(MeshConfig(sp=2, tp=2)), 8, 2)
+        assert not pallas_mesh_ok(make_mesh(MeshConfig(dp=2, tp=2)), 8, 2)
+
+    @pytest.mark.skipif(len(jax.devices()) < 8,
+                        reason="needs 8 virtual devices")
+    def test_explicit_pallas_on_bad_mesh_raises(self):
+        from kafka_tpu.models import ModelConfig, init_params
+        from kafka_tpu.parallel import MeshConfig, make_mesh
+        from kafka_tpu.runtime import EngineConfig, InferenceEngine
+
+        cfg = ModelConfig(name="pallas-badmesh", vocab_size=128,
+                          hidden_size=64, intermediate_size=128,
+                          num_layers=2, num_heads=8, num_kv_heads=2,
+                          head_dim=16, dtype="float32")
+        params = init_params(cfg, jax.random.PRNGKey(13))
+        with pytest.raises(ValueError, match="pure tp"):
+            InferenceEngine(
+                cfg, params,
+                EngineConfig(max_batch=2, page_size=16, num_pages=32,
+                             max_pages_per_seq=8, prefill_buckets=(16,),
+                             attention_backend="pallas"),
+                kv_dtype=jnp.float32,
+                mesh=make_mesh(MeshConfig(tp=4)),  # 4 !| 2 kv heads
+            )
+
+    @pytest.mark.skipif(len(jax.devices()) < 8,
+                        reason="needs 8 virtual devices")
+    def test_engine_pallas_mesh_fused_multistep_matches(self):
+        """The serving default wraps the decode body in a fused lax.scan
+        (multi_step) — the per-shard pallas kernel must compose with the
+        scan on a mesh.  Token-exact vs the single-step xla mesh engine,
+        and the fused dispatch must actually engage.  Child-isolated
+        (tests/_isolation.py)."""
+        from _isolation import isolated
+
+        if not isolated(
+            "tests/test_pallas_kernels.py::TestEnginePallasBackend::"
+            "test_engine_pallas_mesh_fused_multistep_matches"
+        ):
+            return
+        from kafka_tpu.models import ModelConfig, init_params
+        from kafka_tpu.parallel import MeshConfig, make_mesh
+        from kafka_tpu.runtime import (
+            EngineConfig, GenRequest, InferenceEngine,
+        )
+
+        cfg = ModelConfig(name="pallas-mesh-fused", vocab_size=128,
+                          hidden_size=64, intermediate_size=128,
+                          num_layers=2, num_heads=8, num_kv_heads=2,
+                          head_dim=16, dtype="float32")
+        params = init_params(cfg, jax.random.PRNGKey(13))
+        ecfg = dict(max_batch=4, page_size=16, num_pages=64,
+                    max_pages_per_seq=8, prefill_buckets=(16,))
+        base = InferenceEngine(
+            cfg, params, EngineConfig(**ecfg, multi_step=1),
+            kv_dtype=jnp.float32,
+        )
+        eng = InferenceEngine(
+            cfg, params,
+            EngineConfig(**ecfg, multi_step=4, attention_backend="pallas"),
+            kv_dtype=jnp.float32,
+            mesh=make_mesh(MeshConfig(tp=2, tq=2)),
+        )
+        fused = []
+        orig = eng._dispatch_multi
+        eng._dispatch_multi = lambda k: (fused.append(k), orig(k))[1]
+        prompts = {"a": [3, 9, 27, 81], "b": [100] * 11,
+                   "c": [7, 6, 5], "d": [1, 2]}
+        for rid, p in prompts.items():
+            base.submit(GenRequest(request_id=rid, prompt_ids=p,
+                                   max_new_tokens=12))
+            eng.submit(GenRequest(request_id=rid, prompt_ids=p,
+                                  max_new_tokens=12))
+        want = base.run_to_completion()
+        got = eng.run_to_completion()
+        assert fused and set(fused) == {4}
+        for rid in prompts:
+            assert got[rid].output_ids == want[rid].output_ids, rid

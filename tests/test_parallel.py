@@ -240,6 +240,28 @@ class TestTPSharding:
         assert got == want
 
 
+class TestTensorAxisResolution:
+    def test_factorization_cases(self):
+        # (degree, Hkv) -> (tp, tq)
+        assert factor_tp_for_kv(16, 8) == (8, 2)    # 70B BASELINE config 5
+        assert factor_tp_for_kv(8, 8) == (8, 1)     # clean split
+        assert factor_tp_for_kv(4, 8) == (4, 1)     # degree divides Hkv
+        assert factor_tp_for_kv(4, 6) == (2, 2)     # gcd split
+        assert factor_tp_for_kv(3, 8) == (1, 3)     # coprime -> replicate
+        assert factor_tp_for_kv(1, 8) == (1, 1)
+
+    def test_resolver_keeps_plain_axis_for_ulysses_and_pp(self):
+        from kafka_tpu.parallel import resolve_tensor_axes
+
+        assert resolve_tensor_axes(16, 8) == (8, 2)
+        assert resolve_tensor_axes(
+            16, 8, cp_strategy="ulysses", sp=4) == (16, 1)
+        # ulysses WITHOUT sp is not context parallelism — grouped applies
+        assert resolve_tensor_axes(
+            16, 8, cp_strategy="ulysses", sp=1) == (8, 2)
+        assert resolve_tensor_axes(16, 8, pp=2) == (16, 1)
+
+
 class TestRingAttention:
     def _qkv(self, B=2, S=32, H=4, Hkv=2, D=16, seed=0):
         ks = jax.random.split(jax.random.PRNGKey(seed), 3)
