@@ -263,6 +263,28 @@ class ContextLengthError(LLMProviderError):
         self.max_context = max_context
 
 
+class ServerOverloadedError(LLMProviderError):
+    """Admission rejected: the engine's bounded waiting queue is full.
+
+    Maps to HTTP 429 with a Retry-After header derived from current
+    decode throughput (engine.retry_after_estimate).  Raised by the
+    serving-side admission gate (server/app.py) and by the provider when
+    the engine-thread backstop rejects a submit that raced past the gate.
+    """
+
+    def __init__(self, retry_after_s: float = 5.0, provider: str = "tpu",
+                 message: Optional[str] = None):
+        super().__init__(
+            message or (
+                "server overloaded: request queue is full, retry in "
+                f"~{retry_after_s:.0f}s (server_overloaded)"
+            ),
+            status_code=429,
+            provider=provider,
+        )
+        self.retry_after_s = float(retry_after_s)
+
+
 class UnsupportedContentError(LLMProviderError):
     """A request carries content parts the served model cannot consume.
 

@@ -33,6 +33,7 @@ from ..core.types import (
     CompletionResponse,
     ContextLengthError,
     LLMProviderError,
+    ServerOverloadedError,
     StreamChunk,
     UnsupportedContentError,
     Usage,
@@ -170,6 +171,82 @@ class TPULLMProvider(LLMProvider):
         """Largest admissible prompt (engine window, minus 1 for decode)."""
         return min(self.engine.ecfg.max_window, self.model_cfg.max_context) - 1
 
+    # -- lifecycle hardening (server/app.py admission gate + drain) ------
+
+    def _replicas(self):
+        """The engine as a replica list (DataParallelEngines unwraps to
+        its .engines; a single engine is its own one-element set)."""
+        return getattr(self.engine, "engines", [self.engine])
+
+    def admission_check(self) -> Optional[float]:
+        """None = admit; else a Retry-After estimate in seconds.
+
+        Reads the engine thread's queue length without synchronization —
+        torn reads only make the gate a step stale, and the engine-side
+        submit bound (EngineConfig.max_waiting) is the authoritative
+        backstop for the race.  With DP replicas, admit while ANY replica
+        has room (the router picks per-thread).
+        """
+        limit = self.engine.ecfg.max_waiting
+        if limit <= 0:
+            return None
+        replicas = self._replicas()
+        if any(len(e.waiting) < limit for e in replicas):
+            return None
+        return min(e.retry_after_estimate() for e in replicas)
+
+    def record_rejection(self) -> None:
+        """Count a gate-level HTTP 429 in requests.rejected (the engine
+        backstop counts its own; without this, sustained overload — where
+        the gate catches nearly everything — would show ~0 rejections).
+        Cross-thread int increment: GIL-atomic enough for a counter."""
+        self._replicas()[0].metrics.record_rejected()
+
+    async def drain(self, timeout_s: float = 30.0) -> bool:
+        """Graceful drain: let in-flight requests finish, then cancel.
+
+        Returns True when everything completed within the timeout.  The
+        caller (server shutdown) has already stopped admitting, so
+        has_work is monotone-decreasing except for requests racing through
+        the worker inbox — those get their terminal events either by
+        finishing or by the cancel sweep below.
+        """
+        deadline = time.monotonic() + timeout_s
+        replicas = self._replicas()
+        while time.monotonic() < deadline:
+            if not any(e.has_work for e in replicas):
+                return True
+            await asyncio.sleep(0.05)
+
+        def _ids(d):
+            # the engine thread mutates its _requests dict concurrently;
+            # list(dict) can raise "dictionary changed size" mid-copy —
+            # retry like metrics._copy_samples (torn reads are fine, a
+            # request finishing during the copy no longer needs a cancel)
+            for _ in range(8):
+                try:
+                    return list(d)
+                except RuntimeError:
+                    continue
+            return []
+
+        leftover = [rid for e in replicas for rid in _ids(e._requests)]
+        if leftover:
+            logger.warning(
+                "drain timeout after %.1fs: cancelling %d in-flight "
+                "request(s)", timeout_s, len(leftover),
+            )
+            for rid in leftover:
+                self.worker.cancel(rid)
+            # give the engine thread a moment to process the cancels so
+            # every stream sees its terminal event before teardown
+            settle = time.monotonic() + min(2.0, timeout_s)
+            while time.monotonic() < settle and any(
+                e.has_work for e in replicas
+            ):
+                await asyncio.sleep(0.02)
+        return not leftover
+
     def get_model_info(self, model: Optional[str] = None) -> Dict[str, Any]:
         return {
             "id": model or self.model_name,
@@ -298,6 +375,19 @@ class TPULLMProvider(LLMProvider):
         try:
             while True:
                 ev: TokenEvent = await events.get()
+                if ev.finish_reason and ev.finish_reason.startswith(
+                    "rejected:"
+                ):
+                    # engine-thread admission backstop (queue filled
+                    # between the server gate's check and our submit)
+                    parts = ev.finish_reason.split(":", 2)
+                    try:
+                        retry = float(parts[1])
+                    except (IndexError, ValueError):
+                        retry = 5.0
+                    raise ServerOverloadedError(
+                        retry, provider=self.provider_name
+                    )
                 if ev.finish_reason and ev.finish_reason.startswith("error:"):
                     raise LLMProviderError(
                         ev.finish_reason[len("error:") :],

@@ -30,7 +30,8 @@ import threading
 from dataclasses import dataclass, field
 from typing import Dict, Optional, Tuple
 
-from ..runtime.engine import GenRequest, InferenceEngine, TokenEvent
+from ..runtime.engine import AdmissionError, GenRequest, InferenceEngine, TokenEvent
+from ..runtime.failpoints import failpoint
 
 logger = logging.getLogger("kafka_tpu.llm.worker")
 
@@ -53,6 +54,9 @@ class EngineWorker:
         self._thread: Optional[threading.Thread] = None
         self._stopped = threading.Event()
         self._lock = threading.Lock()  # guards _routes (submit vs dispatch)
+        # terminal events whose dispatch failed, awaiting a paced retry
+        # (worker-thread only; see _dispatch_guarded/_retry_redispatches)
+        self._redispatches: list = []
 
     # -- lifecycle -----------------------------------------------------
 
@@ -116,25 +120,99 @@ class EngineWorker:
                 pass
             if self._stopped.is_set():
                 break
+            # paced retry of parked terminal events: one attempt per loop
+            # iteration (the blocking inbox get above bounds idle-engine
+            # pacing at ~1s/round), placed before the idle `continue` so
+            # an idle engine still drains its redispatch backlog
+            self._retry_redispatches()
             if not self.engine.has_work:
                 continue
             try:
                 events = self.engine.step()
             except Exception:
-                logger.exception("engine step failed; failing active requests")
-                events = self._fail_all()
+                # Recovery ladder: rebuild a servable engine (fail started
+                # requests, keep waiting ones, repair page accounting); if
+                # recovery ITSELF dies, fall back to failing everything —
+                # "every request gets a terminal event" must hold even
+                # when the engine is beyond repair.
+                logger.exception("engine step failed; recovering")
+                try:
+                    events = self.engine.recover_from_failure()
+                except Exception:
+                    logger.exception(
+                        "engine recovery failed; failing all requests"
+                    )
+                    events = self._fail_all()
             for ev in events:
-                self._dispatch(ev)
+                self._dispatch_guarded(ev)
         logger.info("engine worker stopped")
+
+    def _dispatch_guarded(self, ev: TokenEvent, attempts: int = 0) -> None:
+        """Dispatch one event without letting a bad route (or an armed
+        worker.dispatch failpoint) take down the worker loop or lose a
+        terminal event.  Terminal events are load-bearing — a consumer
+        awaits them forever — so a failed terminal dispatch is parked and
+        retried once per loop iteration (_retry_redispatches paces the
+        budget across real time, so bounded nth/count fault rules expire
+        within it); when the budget is spent, a last-resort delivery runs
+        with the failpoint bypassed — only a genuinely dead route loses
+        its terminal event."""
+        try:
+            self._dispatch(ev)
+        except Exception:
+            logger.exception("event dispatch failed for %s", ev.request_id)
+            if not ev.finished:
+                return  # one lost token; the stream continues
+            if attempts < 8:
+                self._redispatches.append((ev, attempts + 1))
+                return
+            logger.error(
+                "terminal event for %s still undeliverable after %d "
+                "attempts; trying once more without fault injection",
+                ev.request_id, attempts,
+            )
+            try:
+                self._deliver(ev)
+            except Exception:
+                logger.exception(
+                    "final delivery failed for %s; dropping its route",
+                    ev.request_id,
+                )
+                with self._lock:
+                    self._routes.pop(ev.request_id, None)
+
+    def _retry_redispatches(self) -> None:
+        """One retry round per loop iteration: each parked terminal event
+        gets a single fresh attempt (re-parking itself on failure).  A
+        list swap, not in-place iteration — _dispatch_guarded appends."""
+        if not self._redispatches:
+            return
+        pending, self._redispatches = self._redispatches, []
+        for ev, attempts in pending:
+            self._dispatch_guarded(ev, attempts=attempts)
 
     def _handle(self, kind: str, payload: object) -> None:
         if kind == "submit":
             try:
                 self.engine.submit(payload)  # type: ignore[arg-type]
-            except Exception as e:  # surfaced to the consumer as an error event
+            except AdmissionError as e:
+                # queue-full backstop behind the server's admission gate
+                # (the race where the queue fills between the gate's check
+                # and this thread's submit): a distinct reason prefix so
+                # the provider maps it to HTTP 429, not a 500
                 req: GenRequest = payload  # type: ignore[assignment]
+                logger.warning("submit rejected for %s: %s",
+                               req.request_id, e)
+                self._dispatch_guarded(
+                    TokenEvent(
+                        req.request_id, None, finished=True,
+                        finish_reason=f"rejected:{e.retry_after_s:.0f}:{e}",
+                    )
+                )
+            except Exception as e:  # surfaced to the consumer as an error event
+                req = payload  # type: ignore[assignment]
                 logger.warning("submit rejected for %s: %s", req.request_id, e)
-                self._dispatch(
+                self._dispatch_guarded(
                     TokenEvent(
                         req.request_id, None, finished=True,
                         finish_reason=f"error:{e}",
@@ -143,7 +221,7 @@ class EngineWorker:
         elif kind == "cancel":
             rid: str = payload  # type: ignore[assignment]
             if self.engine.cancel(rid):
-                self._dispatch(
+                self._dispatch_guarded(
                     TokenEvent(rid, None, finished=True, finish_reason="cancelled")
                 )
             else:
@@ -155,17 +233,33 @@ class EngineWorker:
         """Device-step failure: every in-flight request gets a terminal event."""
         events = []
         for rid in list(self.engine._requests):
-            self.engine.cancel(rid)
+            # reason matches the event below so metrics count these as
+            # engine failures (requests.failed), not client cancels
+            self.engine.cancel(rid, reason="error:engine")
             events.append(
                 TokenEvent(rid, None, finished=True, finish_reason="error:engine")
             )
         return events
 
+    def check_routes(self) -> list:
+        """Route-table consistency probe (chaos tests): ids with a live
+        route but no engine-side request.  Call only at quiescence — a
+        just-submitted request's route legitimately precedes its engine
+        registration while the submit command sits in the inbox."""
+        with self._lock:
+            routed = list(self._routes)
+        known = self.engine._requests
+        return [rid for rid in routed if rid not in known]
+
     def _dispatch(self, ev: TokenEvent) -> None:
+        failpoint("worker.dispatch")
+        self._deliver(ev)
+
+    def _deliver(self, ev: TokenEvent) -> None:
+        """Route one event to its consumer queue (no fault injection —
+        _dispatch_guarded's last-resort path calls this directly)."""
         with self._lock:
             route = self._routes.get(ev.request_id)
-            if ev.finished:
-                self._routes.pop(ev.request_id, None)
         if route is None:
             return
         try:
@@ -176,3 +270,9 @@ class EngineWorker:
             if not ev.finished and not route.dropped:
                 route.dropped = True
                 self._inbox.put(("cancel", ev.request_id))
+        # the route is released only after the delivery attempt ran to
+        # completion: an injected fault upstream must leave it intact so
+        # the redispatch path can still deliver the terminal event
+        if ev.finished:
+            with self._lock:
+                self._routes.pop(ev.request_id, None)

@@ -219,8 +219,8 @@ def paged_prefill_attention(
         grid=(S // qb,),
         in_specs=[
             pl.BlockSpec((rows, HD), lambda b, pr, bd: (b, 0)),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
         ],
         out_specs=pl.BlockSpec((rows, HD), lambda b, pr, bd: (b, 0)),
         scratch_shapes=[

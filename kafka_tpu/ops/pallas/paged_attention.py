@@ -216,8 +216,8 @@ def paged_decode_attention(
         grid=(B,),
         in_specs=[
             pl.BlockSpec((1, Hq, HD), lambda b, pt, sl: (b, 0, 0)),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
         ],
         out_specs=pl.BlockSpec((1, Hq, HD), lambda b, pt, sl: (b, 0, 0)),
         scratch_shapes=[
@@ -501,8 +501,8 @@ def paged_decode_attention_int8(
             pl.BlockSpec((1, Hq, HD), lambda b, pt, sl: (b, 0, 0)),
             pl.BlockSpec((1, nc, chunk), lambda b, pt, sl: (b, 0, 0)),
             pl.BlockSpec((1, nc, chunk), lambda b, pt, sl: (b, 0, 0)),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
         ],
         out_specs=pl.BlockSpec((1, Hq, HD), lambda b, pt, sl: (b, 0, 0)),
         scratch_shapes=[

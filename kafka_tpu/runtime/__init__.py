@@ -2,16 +2,20 @@
 
 from .dp_router import DataParallelEngines
 from .engine import (
+    AdmissionError,
     EngineConfig,
     GenRequest,
     InferenceEngine,
     TokenEvent,
 )
+from .failpoints import FailpointError
 from .kv_cache import OutOfPagesError, PagePool, SequencePages, TRASH_PAGE
 
 __all__ = [
+    "AdmissionError",
     "DataParallelEngines",
     "EngineConfig",
+    "FailpointError",
     "GenRequest",
     "InferenceEngine",
     "TokenEvent",

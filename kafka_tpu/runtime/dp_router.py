@@ -82,6 +82,9 @@ class DataParallelEngines:
         # long evicted shouldn't stay pinned (or leak memory) forever
         self._affinity: "OrderedDict[str, int]" = OrderedDict()
         self._affinity_cap = 4096
+        # which replica raised out of step(), so recovery targets it alone
+        self._failed_replica: Optional[int] = None
+        self._pre_failure_events: List[TokenEvent] = []
 
     # -- engine-like surface (llm/worker.EngineWorker compatible) --------
 
@@ -125,17 +128,25 @@ class DataParallelEngines:
             while len(self._affinity) > self._affinity_cap:
                 self._affinity.popitem(last=False)
 
-    def cancel(self, request_id: str) -> bool:
+    def cancel(self, request_id: str, reason: str = "cancelled") -> bool:
         idx = self._route.pop(request_id, None)
         if idx is None:
             return False
-        return self.engines[idx].cancel(request_id)
+        return self.engines[idx].cancel(request_id, reason=reason)
 
     def step(self) -> List[TokenEvent]:
         events: List[TokenEvent] = []
-        for e in self.engines:
+        for i, e in enumerate(self.engines):
             if e.has_work:
-                events.extend(e.step())
+                try:
+                    events.extend(e.step())
+                except Exception:
+                    # remember the failing replica and the events already
+                    # collected from healthy ones; recover_from_failure
+                    # (called by EngineWorker) returns both
+                    self._failed_replica = i
+                    self._pre_failure_events = events
+                    raise
         for ev in events:
             if ev.finished:
                 self._route.pop(ev.request_id, None)
@@ -146,6 +157,34 @@ class DataParallelEngines:
         for e in self.engines:
             done.update(e.run_to_completion())
         return done
+
+    def recover_from_failure(self) -> List[TokenEvent]:
+        """Post-step-failure recovery (EngineWorker): only the replica
+        that raised is recovered — healthy replicas keep their in-flight
+        requests untouched.  Falls back to recovering every replica when
+        the failure origin is unknown (e.g. submit-path errors)."""
+        events: List[TokenEvent] = list(self._pre_failure_events)
+        self._pre_failure_events = []
+        idx = self._failed_replica
+        self._failed_replica = None
+        targets = self.engines if idx is None else [self.engines[idx]]
+        for e in targets:
+            events.extend(e.recover_from_failure())
+        for ev in events:
+            if ev.finished:
+                self._route.pop(ev.request_id, None)
+        return events
+
+    def self_check(self, repair: bool = False) -> List[str]:
+        problems: List[str] = []
+        for i, e in enumerate(self.engines):
+            problems.extend(
+                f"replica {i}: {p}" for p in e.self_check(repair=repair)
+            )
+        return problems
+
+    def retry_after_estimate(self) -> float:
+        return min(e.retry_after_estimate() for e in self.engines)
 
     @property
     def metrics(self):
@@ -194,6 +233,10 @@ class _AggregateMetrics:
         agg["requests"] = {
             k: sum(s["requests"][k] for s in snaps)
             for k in snaps[0]["requests"]
+        }
+        agg["queue"] = {
+            "depth": sum(s["queue"]["depth"] for s in snaps),
+            "peak": max(s["queue"]["peak"] for s in snaps),
         }
         agg["tokens"] = {
             "prompt": sum(s["tokens"]["prompt"] for s in snaps),

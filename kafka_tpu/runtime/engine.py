@@ -63,6 +63,7 @@ import numpy as np
 from ..models.config import ModelConfig
 from ..models.llama import KVCache, PagedView, forward
 from ..ops.sampling import SamplingParams, sample_tokens_per_slot
+from .failpoints import failpoint
 from .kv_cache import (
     OutOfPagesError,
     PagePool,
@@ -75,6 +76,22 @@ from .metrics import EngineMetrics
 from .prefix_cache import PrefixCache
 
 logger = logging.getLogger("kafka_tpu.engine")
+
+
+class AdmissionError(RuntimeError):
+    """submit() rejected a request because the waiting queue is at its
+    configured bound (EngineConfig.max_waiting).  Carries the engine's
+    Retry-After estimate so the serving layer can surface HTTP 429
+    without another cross-thread round trip."""
+
+    def __init__(self, depth: int, limit: int, retry_after_s: float):
+        self.depth = depth
+        self.limit = limit
+        self.retry_after_s = retry_after_s
+        super().__init__(
+            f"waiting queue full ({depth}/{limit}); retry in "
+            f"~{retry_after_s:.0f}s"
+        )
 
 WAITING, PREFILLING, PARKED, ACTIVE, DRAINING, FINISHED = (
     "waiting", "prefilling", "parked", "active", "draining", "finished"
@@ -148,6 +165,16 @@ class EngineConfig:
     # Pool pages kept free of parked pinning (headroom for active lanes'
     # decode growth).  None -> 2 * max_batch.
     park_reserve_pages: Optional[int] = None
+    # Request lifecycle bounds (None/0 = disabled).  max_ttft_s times out a
+    # request still waiting for its FIRST token; max_total_s bounds total
+    # wall time from submit.  Both finish with finish_reason="timeout" and
+    # free slot + pages exactly like a cancel.
+    max_ttft_s: Optional[float] = None
+    max_total_s: Optional[float] = None
+    # Admission backpressure: submit() raises AdmissionError once the
+    # waiting queue holds this many requests (0 = unbounded).  The serving
+    # layer surfaces it as HTTP 429 + Retry-After.
+    max_waiting: int = 0
 
     @property
     def max_window(self) -> int:
@@ -167,6 +194,10 @@ class GenRequest:
     top_p: float = 1.0
     seed: int = 0
     stop_token_ids: Tuple[int, ...] = ()
+    # Per-request deadline overrides (seconds from submit); None defers to
+    # EngineConfig.max_ttft_s / max_total_s.  Enforced by _check_deadlines.
+    deadline_ttft_s: Optional[float] = None
+    deadline_s: Optional[float] = None
     # engine bookkeeping
     state: str = WAITING
     slot: int = -1
@@ -391,6 +422,27 @@ class InferenceEngine:
                     f"mesh is {dict(mesh.shape)} over Hq={cfg.num_heads}/"
                     f"Hkv={cfg.num_kv_heads}: use 'auto' or 'xla'"
                 )
+            # Mosaic lane/sublane alignment, validated at construction on
+            # real TPUs — the 'auto' rule checks these before resolving to
+            # pallas, but a FORCED pallas backend used to skip them and
+            # fail much later with an opaque Mosaic compile error.  Off-TPU
+            # the kernel runs in interpret mode with no such contract, and
+            # CPU-mesh tests deliberately use tiny unaligned shapes.
+            if jax.default_backend() == "tpu":
+                tp = mesh.shape.get("tp", 1)
+                merged_kv = cfg.num_kv_heads * cfg.head_dim
+                if (merged_kv // tp) % 128 != 0:
+                    raise ValueError(
+                        "attention_backend='pallas' needs the per-shard "
+                        f"merged KV row (Hkv*D/tp = {merged_kv // tp}) to "
+                        "be a multiple of 128 lanes — use 'auto' or 'xla'"
+                    )
+                if self.ecfg.page_size % 16 != 0:
+                    raise ValueError(
+                        "attention_backend='pallas' needs page_size "
+                        f"({self.ecfg.page_size}) to be a multiple of the "
+                        "16-row bf16 sublane tile — use 'auto' or 'xla'"
+                    )
         self.cfg = cfg.replace(
             attention_backend=self._resolve_backend(cfg, self.ecfg, mesh),
             prefill_ring=sp > 1,
@@ -854,6 +906,15 @@ class InferenceEngine:
     def submit(self, req: GenRequest) -> None:
         if len(req.prompt_ids) == 0:
             raise ValueError("empty prompt")
+        if (
+            self.ecfg.max_waiting > 0
+            and len(self.waiting) >= self.ecfg.max_waiting
+        ):
+            self.metrics.record_rejected()
+            raise AdmissionError(
+                len(self.waiting), self.ecfg.max_waiting,
+                self.retry_after_estimate(),
+            )
         limit = self.ecfg.max_window
         if len(req.prompt_ids) + 1 > limit:
             raise ValueError(
@@ -877,14 +938,15 @@ class InferenceEngine:
         self.waiting.append(req)
         self._requests[req.request_id] = req
 
-    def cancel(self, request_id: str) -> bool:
+    def cancel(self, request_id: str, reason: str = "cancelled") -> bool:
         """Abort a request (client disconnect); frees its slot and pages.
 
         Must run on the thread that drives `step()` (the engine is
         single-writer; EngineWorker routes cancels through its inbox for
         this reason). Returns False for unknown/already-finished ids.
         In-flight fetches for the request are simply discarded as they
-        mature.
+        mature.  `reason` lets failure paths (worker._fail_all) record the
+        finish as an engine error rather than a client cancel.
         """
         req = self._requests.get(request_id)
         if req is None or req.state == FINISHED:
@@ -895,12 +957,75 @@ class InferenceEngine:
             except ValueError:
                 pass
         req.state = FINISHED
-        req.finish_reason = "cancelled"
-        self.metrics.record_finish("cancelled")
+        req.finish_reason = reason
+        self.metrics.record_finish(reason)
         if req.slot >= 0 or req.seq is not None:
             self._release_slot(req)
         self._requests.pop(request_id, None)
         return True
+
+    def retry_after_estimate(self) -> float:
+        """Seconds until queue relief is plausible, for 429 Retry-After.
+
+        Derived from current decode throughput: the batch retires roughly
+        max_batch requests per (default token budget x per-token latency);
+        a full waiting queue drains one admission per retirement.  Recent
+        TPOT is the honest per-token figure (wall-clock throughput goes to
+        zero while idle); with no samples yet fall back to a conservative
+        guess.  Clamped to [1, 120] — this is a hint, not a promise.
+        """
+        tpot_s = self.metrics.recent_tpot_s() or 0.05
+        per_request_s = self.ecfg.max_new_tokens_default * tpot_s
+        drain_rate = self.ecfg.max_batch / max(per_request_s, 1e-3)
+        excess = max(1, len(self.waiting) - self.ecfg.max_batch)
+        return float(min(120.0, max(1.0, excess / max(drain_rate, 1e-3))))
+
+    def _check_deadlines(self) -> None:
+        """Time out requests past their TTFT/total deadline (step() entry).
+
+        A timeout is a cancel with a client-visible reason: the request
+        finishes with finish_reason="timeout", its slot and pages free
+        immediately, and in-flight fetches for it are discarded as they
+        mature.  DRAINING requests are exempt — their dispatching already
+        stopped and a terminal event is imminent.
+        """
+        ecfg = self.ecfg
+        now = time.monotonic()
+        for req in list(self._requests.values()):
+            if req.state in (FINISHED, DRAINING):
+                continue
+            total = req.deadline_s if req.deadline_s is not None \
+                else ecfg.max_total_s
+            ttft = req.deadline_ttft_s if req.deadline_ttft_s is not None \
+                else ecfg.max_ttft_s
+            age = now - req.submit_time
+            if (total is not None and age > total) or (
+                ttft is not None
+                and req.first_token_time is None
+                and age > ttft
+            ):
+                self._timeout(req)
+
+    def _timeout(self, req: GenRequest) -> None:
+        logger.warning(
+            "request %s timed out after %.2fs (state %s)",
+            req.request_id, time.monotonic() - req.submit_time, req.state,
+        )
+        if req.state == WAITING:
+            try:
+                self.waiting.remove(req)
+            except ValueError:
+                pass
+        req.state = FINISHED
+        req.finish_reason = "timeout"
+        self.metrics.record_finish("timeout")
+        if req.slot >= 0 or req.seq is not None or req in self.parked:
+            self._release_slot(req)
+        self._requests.pop(req.request_id, None)
+        self._out_events.append(
+            TokenEvent(req.request_id, None, finished=True,
+                       finish_reason="timeout")
+        )
 
     @property
     def num_active(self) -> int:
@@ -925,8 +1050,11 @@ class InferenceEngine:
         for its whole prefill — their inter-token gap is bounded by ~one
         chunk's compute.
         """
+        failpoint("engine.step")
         if self._park_cooldown > 0:
             self._park_cooldown -= 1
+        self._check_deadlines()
+        self.metrics.record_queue_depth(len(self.waiting))
         self._drain(block=False)
         self._admit()
         self._advance_prefills()
@@ -960,6 +1088,128 @@ class InferenceEngine:
         while req.state != FINISHED:
             self.step()
         return req
+
+    # ------------------------------------------------------------------
+    # failure handling & self-check
+    # ------------------------------------------------------------------
+
+    def _expected_page_owners(self) -> Dict[int, int]:
+        """Per-page live reference counts from host bookkeeping: every
+        registered request's sequence plus the prefix cache's retains.
+        This is what the pool's refcounts must equal — any page above it
+        is leaked, any below is double-freed."""
+        owners: Dict[int, int] = {}
+        for req in self._requests.values():
+            if req.seq is not None:
+                for p in req.seq.pages:
+                    owners[p] = owners.get(p, 0) + 1
+        if self.prefix_cache is not None:
+            for p, n in self.prefix_cache.page_owners().items():
+                owners[p] = owners.get(p, 0) + n
+        return owners
+
+    def self_check(self, repair: bool = False) -> List[str]:
+        """Verify scheduler/pool invariants; returns problems (empty=ok).
+
+        Checks: slot occupancy (every seated request knows its slot and
+        vice versa, no finished request holds a slot), parked-list states,
+        allocator internal consistency, and page accounting against the
+        live owner set.  With `repair`, page discrepancies are fixed in
+        place (leaks released, double frees re-pinned) so the engine can
+        keep serving after a step failure instead of slowly wedging.
+        """
+        problems: List[str] = []
+        for i, s in enumerate(self.slots):
+            if s is None:
+                continue
+            if s.slot != i:
+                problems.append(
+                    f"slot {i} holds {s.request_id} whose slot field is "
+                    f"{s.slot}"
+                )
+            if s.state not in (ACTIVE, PREFILLING):
+                problems.append(
+                    f"slot {i} holds {s.request_id} in state {s.state}"
+                )
+            if self._requests.get(s.request_id) is not s:
+                problems.append(
+                    f"slot {i} holds unregistered request {s.request_id}"
+                )
+        for req in self._requests.values():
+            if req.slot >= 0 and self.slots[req.slot] is not req:
+                problems.append(
+                    f"{req.request_id} claims slot {req.slot} but the slot "
+                    "holds someone else"
+                )
+        for req in self.parked:
+            if req.state not in (PARKED, PREFILLING):
+                problems.append(
+                    f"parked lane {req.request_id} in state {req.state}"
+                )
+        problems += self.pool.check_consistency()
+        problems += self.pool.reconcile(
+            self._expected_page_owners(), repair=repair
+        )
+        return problems
+
+    def recover_from_failure(self) -> List[TokenEvent]:
+        """Rebuild a servable engine after a step() exception.
+
+        Contract (chaos-tested): every request that had started compute
+        gets exactly one terminal error event; WAITING requests are kept
+        queued (they own no device state and can still be served); page
+        accounting is verified and repaired; decode control state is
+        rebuilt from scratch.  The caller (EngineWorker) dispatches the
+        returned events.
+        """
+        events: List[TokenEvent] = list(self._out_events)
+        self._out_events = []
+        # In-flight fetches reference arrays whose producing computation
+        # may have died mid-flight: discard them all (their tokens become
+        # speculative waste, same as a cancel).
+        self._pending.clear()
+        self._pending_steps = 0
+        self._constrained_fetch = None
+        for req in list(self._requests.values()):
+            if req.state == WAITING:
+                # never started compute: keep it queued, but make sure a
+                # half-attached prefix share doesn't pin pages.  A request
+                # popped from the queue whose prefill start died before
+                # changing its state is still WAITING but off-queue —
+                # re-insert it or it would orphan (registered, never
+                # scheduled, no terminal event).
+                if req.seq is not None:
+                    self.pool.free_sequence(req.seq)
+                    req.seq = None
+                if req not in self.waiting:
+                    self.waiting.append(req)
+                continue
+            req.state = FINISHED
+            req.finish_reason = "error:engine"
+            self.metrics.record_finish("error:engine")
+            self._release_slot(req)
+            self._requests.pop(req.request_id, None)
+            events.append(
+                TokenEvent(req.request_id, None, finished=True,
+                           finish_reason="error:engine")
+            )
+        # submit-order FIFO must survive the re-inserts above
+        self.waiting.sort(key=lambda r: r.submit_time)
+        # device control state: all lanes are gone, rebuild from zero (the
+        # next _dispatch_decode re-uploads tables via _refresh_ctl; _d_last
+        # lanes are re-seeded at each admission)
+        B = self.ecfg.max_batch
+        self._d_last = self._dev(np.zeros(B, np.int32))
+        self._d_seq_lens = self._dev(np.zeros(B, np.int32))
+        self._ctl_dirty = True
+        self._park_cooldown = 0
+        problems = self.self_check(repair=True)
+        if problems:
+            logger.error(
+                "post-failure self-check repaired %d problem(s): %s",
+                len(problems), "; ".join(problems),
+            )
+        return events
 
     # ------------------------------------------------------------------
     # fetch pipeline
@@ -1421,6 +1671,7 @@ class InferenceEngine:
         self, bucket: int, reqs: List[GenRequest], W: int
     ) -> None:
         """One fused chunk dispatch for 2..W same-bucket lanes."""
+        failpoint("engine.prefill")
         ecfg = self.ecfg
         page_rows = np.full((W, ecfg.max_pages_per_seq), TRASH_PAGE, np.int32)
         chunks = np.zeros((W, bucket), np.int32)
@@ -1544,6 +1795,7 @@ class InferenceEngine:
 
     def _advance_prefill(self, req: GenRequest) -> None:
         """Dispatch ONE prefill chunk; the final chunk activates the lane."""
+        failpoint("engine.prefill")
         ecfg = self.ecfg
         start = req.seq.length  # >0 after a prefix-cache hit (_attach_prefix)
         prompt = req.prefill_ids

@@ -1,0 +1,37 @@
+"""Failpoint fault injection — canonical runtime-facing module.
+
+The implementation lives in :mod:`kafka_tpu.failpoints` (top-level, so
+import-light tiers like ``db/`` and ``sandbox/`` can wire call sites
+without dragging in the JAX runtime that ``kafka_tpu.runtime``'s package
+init imports).  This module re-exports the full public surface; runtime
+code and tests should import from here.  See kafka_tpu/failpoints.py for
+site names, rule semantics, and the KAFKA_TPU_FAILPOINTS syntax.
+"""
+
+from ..failpoints import (  # noqa: F401
+    ENV_VAR,
+    FailpointError,
+    Rule,
+    SITES,
+    active_rules,
+    armed,
+    clear,
+    configure,
+    failpoint,
+    load_env,
+    parse,
+)
+
+__all__ = [
+    "ENV_VAR",
+    "FailpointError",
+    "Rule",
+    "SITES",
+    "active_rules",
+    "armed",
+    "clear",
+    "configure",
+    "failpoint",
+    "load_env",
+    "parse",
+]

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.config import ModelConfig
+from .failpoints import failpoint
 
 TRASH_PAGE = 0
 
@@ -67,6 +68,7 @@ class PagePool:
         return len(self._free)
 
     def alloc(self, n: int = 1) -> List[int]:
+        failpoint("kv.alloc")
         if n > len(self._free):
             raise OutOfPagesError(f"need {n} pages, have {len(self._free)} free")
         out = [self._free.pop() for _ in range(n)]
@@ -105,6 +107,76 @@ class PagePool:
         self.release(seq.pages)
         seq.pages.clear()
         seq.length = 0
+
+    # -- leak detection (engine self-check) ------------------------------
+
+    def check_consistency(self) -> List[str]:
+        """Internal allocator invariants; returns human-readable problems.
+
+        Every non-trash page must be exactly one of {free-listed with
+        refcount 0, owned with refcount > 0}.  Anything else is a leak or
+        a double free in the making.
+        """
+        problems: List[str] = []
+        seen: set = set()
+        for p in self._free:
+            if p in seen:
+                problems.append(f"page {p} duplicated in free list")
+            seen.add(p)
+            if p == TRASH_PAGE:
+                problems.append("trash page in free list")
+            elif self.refcount[p] != 0:
+                problems.append(
+                    f"page {p} free-listed with refcount {self.refcount[p]}"
+                )
+        for p in range(self.num_pages):
+            if p == TRASH_PAGE:
+                continue
+            rc = int(self.refcount[p])
+            if rc < 0:
+                problems.append(f"page {p} has negative refcount {rc}")
+            elif rc == 0 and p not in seen:
+                problems.append(
+                    f"page {p} leaked: refcount 0 but not in free list"
+                )
+        return problems
+
+    def reconcile(
+        self, expected: Dict[int, int], repair: bool = False
+    ) -> List[str]:
+        """Compare refcounts against the owners the caller enumerated.
+
+        `expected` maps page -> number of live references (sequences +
+        prefix-cache retains).  Pages whose refcount exceeds that are
+        leaked (held by nobody); with `repair` the excess references are
+        force-released back to the free list.  Refcounts BELOW the owner
+        count mean a double free: repair re-pins them so a future release
+        cannot corrupt a stranger's page.
+        """
+        reports: List[str] = []
+        for p in range(self.num_pages):
+            if p == TRASH_PAGE:
+                continue
+            rc = int(self.refcount[p])
+            want = expected.get(p, 0)
+            if rc == want:
+                continue
+            kind = "leaked" if rc > want else "double-freed"
+            reports.append(
+                f"page {p} {kind}: refcount {rc}, {want} live owners"
+                + (" (repaired)" if repair else "")
+            )
+            if not repair:
+                continue
+            if rc > want:
+                self.refcount[p] = want
+                if want == 0 and p not in self._free:
+                    self._free.append(p)
+            else:
+                if rc == 0 and p in self._free:
+                    self._free.remove(p)
+                self.refcount[p] = want
+        return reports
 
 
 def make_kv_pool_arrays(

@@ -57,6 +57,14 @@ class EngineMetrics:
     requests_finished: int = 0
     requests_cancelled: int = 0
     requests_preempted: int = 0
+    # lifecycle hardening counters: deadline expiries, admission-gate
+    # rejections (HTTP 429), and engine-failure terminations
+    requests_timeout: int = 0
+    requests_rejected: int = 0
+    requests_failed: int = 0
+    # waiting-queue gauge, recorded once per scheduler iteration
+    queue_depth: int = 0
+    queue_depth_peak: int = 0
     prompt_tokens: int = 0
     generated_tokens: int = 0
     decode_steps: int = 0
@@ -151,11 +159,31 @@ class EngineMetrics:
     def record_finish(self, reason: Optional[str]) -> None:
         if reason == "cancelled":
             self.requests_cancelled += 1
+        elif reason == "timeout":
+            self.requests_timeout += 1
+        elif reason is not None and reason.startswith("error"):
+            self.requests_failed += 1
         else:
             self.requests_finished += 1
 
     def record_preempt(self) -> None:
         self.requests_preempted += 1
+
+    def record_rejected(self) -> None:
+        self.requests_rejected += 1
+
+    def record_queue_depth(self, depth: int) -> None:
+        self.queue_depth = depth
+        if depth > self.queue_depth_peak:
+            self.queue_depth_peak = depth
+
+    def recent_tpot_s(self) -> Optional[float]:
+        """Median of the recent TPOT window, in seconds (None = no data).
+        Safe to call from other threads (torn-tolerant snapshot)."""
+        samples = _copy_samples(self.tpot_ms)
+        if not samples:
+            return None
+        return sorted(samples)[len(samples) // 2] / 1e3
 
     # -- cross-thread export --------------------------------------------
 
@@ -168,6 +196,13 @@ class EngineMetrics:
                 "finished": self.requests_finished,
                 "cancelled": self.requests_cancelled,
                 "preempted": self.requests_preempted,
+                "timeout": self.requests_timeout,
+                "rejected": self.requests_rejected,
+                "failed": self.requests_failed,
+            },
+            "queue": {
+                "depth": self.queue_depth,
+                "peak": self.queue_depth_peak,
             },
             "tokens": {
                 "prompt": self.prompt_tokens,

@@ -113,6 +113,13 @@ class MemoryPlan:
                                       # replicated across tq groups
     kv_bytes_per_token: int           # per device, k+v, all layers
     window_tokens: int                # configured attention window
+    # Machine-readable grouped tp×tq factorization (the layout the bytes
+    # above are charged under): kv params + pool shard kv_shard-ways and
+    # replicate across tq groups.  mesh["tp"] stays the REQUESTED tensor
+    # degree (= kv_shard * tq when grouped); consumers should read these
+    # fields, not parse the free-text notes.
+    kv_shard: int = 1
+    tq: int = 1
     notes: str = ""
 
     @property
@@ -153,6 +160,8 @@ class MemoryPlan:
             "fits": self.fits,
             "headroom_gib": round(self.headroom_bytes / GiB, 3),
             "kv_replicated": self.kv_replicated,
+            "kv_shard": self.kv_shard,
+            "tq": self.tq,
             "window_tokens": self.window_tokens,
             "max_concurrent_windows": self.max_concurrent_windows,
             "notes": self.notes,
@@ -317,6 +326,10 @@ def plan_memory(
             cfg, tp=tp, pp=pp, kv_dtype=kv_dtype, kv_shard=kv_shard
         ),
         window_tokens=window,
+        # unconditional: tp = kv_shard * tq always holds, so kv_shard=1
+        # with tp=8 reports tq=8 (full 8-way replication), not tq=1
+        kv_shard=kv_shard,
+        tq=tp // kv_shard,
         notes=(
             (
                 f"grouped GQA layout: tensor degree {tp} factorizes "

@@ -54,6 +54,16 @@ class PrefixCache:
     def __len__(self) -> int:
         return len(self._entries)
 
+    def page_owners(self) -> Dict[int, int]:
+        """Per-page retain counts held by cache entries (engine
+        self_check: these are legitimate owners alongside live
+        sequences)."""
+        owners: Dict[int, int] = {}
+        for e in self._entries.values():
+            for p in e.pages:
+                owners[p] = owners.get(p, 0) + 1
+        return owners
+
     def lookup(
         self, key: str, prompt_ids: Sequence[int]
     ) -> Optional[Tuple[List[int], int]]:

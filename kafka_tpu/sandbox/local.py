@@ -15,6 +15,7 @@ from typing import Any, AsyncIterator, Dict, Optional
 
 import httpx
 
+from ..failpoints import failpoint
 from ..tools.types import ToolEvent
 from .base import Sandbox
 from .types import SandboxConfig
@@ -73,6 +74,9 @@ class LocalSandbox(Sandbox):
         timeout = timeout or DEFAULT_TOOL_TIMEOUT_S
         terminal_seen = False
         try:
+            # chaos seam: an injected fault takes the transport-error path
+            # below, so the agent still receives a terminal tool event
+            failpoint("sandbox.exec")
             async with self._client.stream(
                 "POST",
                 f"{self.url}/run",

@@ -38,6 +38,7 @@ from pydantic import ValidationError
 from ..core.types import (
     ContextLengthError,
     LLMProviderError,
+    ServerOverloadedError,
     Usage,
     new_completion_id,
 )
@@ -139,6 +140,9 @@ def build_tpu_provider(cfg: ServingConfig) -> LLMProvider:
         cp_strategy=cfg.cp_strategy,
         multi_step=cfg.multi_step,
         kv_quantize=cfg.kv_quantize,
+        max_ttft_s=cfg.max_ttft_s,
+        max_total_s=cfg.request_timeout_s,
+        max_waiting=cfg.max_queue_depth,
     )
     # Memory-fit validation (runtime/planner.py): per-device bytes under
     # the actual sharding rules, against the live device's HBM.  When the
@@ -234,6 +238,12 @@ def build_tpu_provider(cfg: ServingConfig) -> LLMProvider:
 
         t0 = _time.monotonic()
         engines = getattr(engine, "engines", [engine])
+        # warmup is operator traffic, not client traffic: it must not trip
+        # the admission bound (a small max_queue_depth would otherwise
+        # reject the multi-stream warmup batch).  All engines share this
+        # EngineConfig instance, so flip it once and restore after.
+        _admission_bound = engine_cfg.max_waiting
+        engine_cfg.max_waiting = 0
         # Every prefill bucket compiles now — a real conversation grows
         # through the bucket ladder, and each uncompiled bucket would cost
         # its first request a ~30s stall.  One prompt per bucket (sized to
@@ -279,6 +289,7 @@ def build_tpu_provider(cfg: ServingConfig) -> LLMProvider:
             ))
             e.run_to_completion()
         engine.run_to_completion()
+        engine_cfg.max_waiting = _admission_bound
         for e in engines:
             e.metrics = EngineMetrics()
         logger.info("warmup compile done in %.1fs", _time.monotonic() - t0)
@@ -319,6 +330,11 @@ async def create_app(
     """Build the application; DI parameters override config-driven wiring
     (the testing seams the reference got from its ABC layering)."""
     cfg = cfg or ServingConfig.from_env()
+    # late env injection (KAFKA_TPU_FAILPOINTS set after import): arm any
+    # configured failpoints before the engine builds
+    from ..runtime.failpoints import load_env as _load_failpoints
+
+    _load_failpoints()
     if llm_provider is None:
         llm_provider = build_tpu_provider(cfg)
     if db is None:
@@ -360,10 +376,36 @@ async def create_app(
         "tools": tools,
         "mcp_servers": list(mcp_servers or []),
         "kafka": kafka,
+        "draining": False,
     }
     _add_routes(app)
+    app.on_shutdown.append(_drain_on_shutdown)
     app.on_cleanup.append(_cleanup)
     return app
+
+
+async def _drain_on_shutdown(app: web.Application) -> None:
+    """Graceful drain: stop admitting, let in-flight streams finish.
+
+    Runs while connections are still open (aiohttp on_shutdown).  /health
+    flips to 503 "draining" so load balancers pull the instance, the
+    admission gate rejects new serving requests with 503, and the engine
+    gets ServingConfig.drain_timeout_s to finish what it holds before the
+    leftovers are cancelled (each still receives its terminal event).
+    """
+    state = app[STATE_KEY]
+    if state.get("draining"):
+        return
+    state["draining"] = True
+    drain = getattr(state["llm"], "drain", None)
+    if drain is None:
+        return
+    timeout = state["cfg"].drain_timeout_s
+    logger.info("draining: waiting up to %.1fs for in-flight requests",
+                timeout)
+    clean = await drain(timeout)
+    logger.info("drain %s", "complete" if clean else "timed out (cancelled "
+                "remaining requests)")
 
 
 async def _cleanup(app: web.Application) -> None:
@@ -489,6 +531,43 @@ async def _parse(request: web.Request, model_cls):
     except Exception:
         raise web.HTTPBadRequest(text='{"error": "invalid JSON body"}',
                                  content_type="application/json")
+
+
+def _admission_gate(request: web.Request) -> None:
+    """Reject serving requests when draining or when the engine's waiting
+    queue is full (HTTP 503 / 429 + Retry-After).  Thread CRUD and health
+    stay open — only endpoints that would submit engine work are gated."""
+    state = _state(request)
+    if state.get("draining"):
+        raise web.HTTPServiceUnavailable(
+            text=json.dumps({"error": {
+                "message": "server is draining for shutdown",
+                "type": "server_draining",
+            }}),
+            content_type="application/json",
+            headers={"Retry-After": str(int(
+                state["cfg"].drain_timeout_s
+                if hasattr(state["cfg"], "drain_timeout_s") else 30
+            ))},
+        )
+    check = getattr(state["llm"], "admission_check", None)
+    if check is None:
+        return
+    retry_after = check()
+    if retry_after is None:
+        return
+    record = getattr(state["llm"], "record_rejection", None)
+    if record is not None:
+        record()
+    raise web.HTTPTooManyRequests(
+        text=json.dumps({"error": {
+            "message": "request queue is full; retry later "
+                       "(server_overloaded)",
+            "type": "server_overloaded",
+        }}),
+        content_type="application/json",
+        headers={"Retry-After": str(max(1, int(retry_after)))},
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -628,6 +707,14 @@ async def _completion_response(events, model: str) -> web.Response:
     """Non-streaming completion with OpenAI-style structured errors."""
     try:
         return web.json_response(await _collect_completion(events, model))
+    except ServerOverloadedError as e:
+        # engine-thread admission backstop: same 429 contract as the gate
+        # (type server_overloaded + Retry-After), not a generic 4xx
+        return web.json_response(
+            {"error": {"message": str(e), "type": "server_overloaded"}},
+            status=429,
+            headers={"Retry-After": str(max(1, int(e.retry_after_s)))},
+        )
     except LLMProviderError as e:
         status = e.status_code or 500
         return web.json_response(
@@ -645,6 +732,7 @@ async def _completion_response(events, model: str) -> web.Response:
 
 
 async def chat_completions(request: web.Request) -> web.StreamResponse:
+    _admission_gate(request)
     body = await _parse(request, ChatCompletionRequest)
     events = _agent_events(request, body, thread_id=None)
     if body.stream:
@@ -653,6 +741,7 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
 
 
 async def thread_chat_completions(request: web.Request) -> web.StreamResponse:
+    _admission_gate(request)
     thread_id = request.match_info["thread_id"]
     await _check_thread_owner(request, thread_id, create=True)
     body = await _parse(request, ChatCompletionRequest)
@@ -663,6 +752,7 @@ async def thread_chat_completions(request: web.Request) -> web.StreamResponse:
 
 
 async def agent_run(request: web.Request) -> web.StreamResponse:
+    _admission_gate(request)
     body = await _parse(request, AgentRunRequest)
     return await sse_response(
         request, _agent_events(request, body, thread_id=None)
@@ -670,6 +760,7 @@ async def agent_run(request: web.Request) -> web.StreamResponse:
 
 
 async def thread_agent_run(request: web.Request) -> web.StreamResponse:
+    _admission_gate(request)
     thread_id = request.match_info["thread_id"]
     await _check_thread_owner(request, thread_id, create=True)
     body = await _parse(request, AgentRunRequest)
@@ -943,8 +1034,11 @@ async def list_models(request: web.Request) -> web.Response:
 async def health(request: web.Request) -> web.Response:
     state = _state(request)
     llm = state["llm"]
+    draining = bool(state.get("draining"))
     payload: Dict[str, Any] = {
-        "status": "ok",
+        # "draining" + 503 pulls the instance from load-balancer rotation
+        # while in-flight streams finish (graceful-drain contract)
+        "status": "draining" if draining else "ok",
         "kafka_initialized": state["kafka"]._initialized,
     }
     plan = getattr(llm, "memory_plan", None)  # set by build_tpu_provider
@@ -963,7 +1057,7 @@ async def health(request: web.Request) -> web.Response:
         }
         if len(replicas) > 1:
             payload["engine"]["dp"] = len(replicas)
-    return web.json_response(payload)
+    return web.json_response(payload, status=503 if draining else 200)
 
 
 async def metrics(request: web.Request) -> web.Response:

@@ -54,6 +54,20 @@ class ServingConfig:
     ep_size: int = 1
     # long-context CP strategy when sp>1: "ring" or "ulysses"
     cp_strategy: str = "ring"
+    # Request-lifecycle hardening (runtime/failpoints.py chaos-tests these
+    # paths; README "Failure semantics"):
+    #   max_ttft_s — a request still awaiting its FIRST token past this
+    #       many seconds finishes with finish_reason="timeout" (None = off)
+    #   request_timeout_s — total wall-time bound per request (None = off)
+    #   max_queue_depth — bounded engine waiting queue; a submit past it
+    #       answers HTTP 429 + Retry-After (0 = unbounded)
+    #   drain_timeout_s — graceful-shutdown budget: /health flips to
+    #       "draining", admission stops, in-flight streams get this long
+    #       to finish before they are cancelled
+    max_ttft_s: Optional[float] = None
+    request_timeout_s: Optional[float] = None
+    max_queue_depth: int = 256
+    drain_timeout_s: float = 30.0
     # server
     host: str = "0.0.0.0"
     port: int = 8000
@@ -142,6 +156,11 @@ class ServingConfig:
             dp_size=get_axis("DP", cls.dp_size),
             ep_size=get_axis("EP", cls.ep_size),
             cp_strategy=get("CP_STRATEGY", cls.cp_strategy),
+            max_ttft_s=get("MAX_TTFT_S", None, float),
+            request_timeout_s=get("REQUEST_TIMEOUT_S", None, float),
+            max_queue_depth=get("MAX_QUEUE_DEPTH", cls.max_queue_depth, int),
+            drain_timeout_s=get("DRAIN_TIMEOUT_S", cls.drain_timeout_s,
+                                float),
             host=get("HOST", cls.host),
             port=get("PORT", cls.port, int),
             api_token=get("API_TOKEN", None),

@@ -38,16 +38,34 @@ os.environ["KAFKA_TPU_COMPILE_CACHE"] = ""
 # and the count crosses vm.max_map_count (65530 default) near the end —
 # mmap starts failing and LLVM/XLA dies uncatchably.  Measured: ~42k maps
 # six minutes into the run, growing ~5k/min.  Two defenses: raise the
-# sysctl when permitted (containers often run as root), and drop compiled
-# executables between test modules (fixture below).
-try:
-    with open("/proc/sys/vm/max_map_count") as _f:
-        _cur = int(_f.read())
-    if _cur < 262144:
+# sysctl when permitted AND opted in (the sysctl is HOST-GLOBAL kernel
+# config, so mutating it is gated behind KAFKA_TPU_TEST_RAISE_MAP_COUNT=1
+# and undone at session finish — see pytest_sessionfinish below), and drop
+# compiled executables between test modules (fixture below), which is the
+# always-on defense.
+_PRIOR_MAP_COUNT = None
+if os.environ.get("KAFKA_TPU_TEST_RAISE_MAP_COUNT") == "1":
+    try:
+        with open("/proc/sys/vm/max_map_count") as _f:
+            _cur = int(_f.read())
+        if _cur < 262144:
+            with open("/proc/sys/vm/max_map_count", "w") as _f:
+                _f.write("262144")
+            _PRIOR_MAP_COUNT = _cur
+    except (OSError, ValueError):
+        pass  # not privileged / not Linux: the per-module purge still applies
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """Restore the host sysctl we raised (never leave kernel config
+    mutated as a test side effect)."""
+    if _PRIOR_MAP_COUNT is None:
+        return
+    try:
         with open("/proc/sys/vm/max_map_count", "w") as _f:
-            _f.write("262144")
-except (OSError, ValueError):
-    pass  # not privileged / not Linux: the per-module purge still applies
+            _f.write(str(_PRIOR_MAP_COUNT))
+    except OSError:
+        pass
 
 import gc  # noqa: E402
 

@@ -646,3 +646,99 @@ class TestConstrainedChaining:
         done = eng.run_to_completion()
         out = done["exhaust"].output_ids
         assert out[:3] == seq and len(out) == 8
+
+
+class TestLifecycleHardening:
+    """Deadlines + admission backpressure (ISSUE 1 request-lifecycle
+    hardening): timeouts finish with finish_reason="timeout" and free slot
+    + pages exactly like a cancel; a submit past the bounded queue raises
+    AdmissionError with a Retry-After estimate."""
+
+    def test_total_deadline_times_out_waiting_request(self, model):
+        import time as _time
+
+        cfg, params = model
+        eng = make_engine(cfg, params, max_total_s=0.0)
+        eng.submit(GenRequest(request_id="t1", prompt_ids=[1, 2, 3]))
+        _time.sleep(0.005)
+        events = eng.step()
+        terminal = [e for e in events if e.finished]
+        assert len(terminal) == 1
+        assert terminal[0].finish_reason == "timeout"
+        assert eng.pool.free_pages == eng.pool.num_pages - 1
+        assert not eng.waiting and not eng._requests
+        assert eng.metrics.requests_timeout == 1
+
+    def test_deadline_frees_slot_and_pages_mid_decode(self, model):
+        import time as _time
+
+        cfg, params = model
+        eng = make_engine(cfg, params)
+        req = GenRequest(request_id="mid", prompt_ids=[1, 2, 3],
+                         max_new_tokens=500, deadline_s=0.05)
+        eng.submit(req)
+        reason = None
+        t0 = _time.monotonic()
+        while reason is None and _time.monotonic() - t0 < 30:
+            for ev in eng.step():
+                if ev.finished:
+                    reason = ev.finish_reason
+        assert reason == "timeout"
+        assert all(s is None for s in eng.slots)
+        assert eng.pool.free_pages == eng.pool.num_pages - 1
+        assert not eng.self_check(), eng.self_check()
+        # the engine keeps serving afterwards
+        ok = eng.generate([4, 5, 6], max_new_tokens=2)
+        assert ok.finish_reason == "length"
+
+    def test_ttft_deadline_spares_request_that_got_first_token(self, model):
+        import time as _time
+
+        cfg, params = model
+        # generous TTFT bound: the first token arrives well inside it, so
+        # the request must run to its full budget even after the bound
+        eng = make_engine(cfg, params, max_ttft_s=30.0)
+        req = eng.generate([1, 2, 3], max_new_tokens=4)
+        assert req.finish_reason == "length"
+        assert len(req.output_ids) == 4
+
+    def test_per_request_deadline_overrides_config(self, model):
+        import time as _time
+
+        cfg, params = model
+        eng = make_engine(cfg, params, max_total_s=300.0)
+        eng.submit(GenRequest(request_id="o1", prompt_ids=[1, 2, 3],
+                              deadline_s=0.0))
+        _time.sleep(0.005)
+        events = eng.step()
+        assert any(e.finished and e.finish_reason == "timeout"
+                   for e in events)
+
+    def test_bounded_queue_rejects_with_retry_after(self, model):
+        from kafka_tpu.runtime import AdmissionError
+
+        cfg, params = model
+        eng = make_engine(cfg, params, max_waiting=2)
+        rejected = None
+        for i in range(16):
+            try:
+                eng.submit(GenRequest(request_id=f"q{i}",
+                                      prompt_ids=[1, 2], max_new_tokens=2))
+            except AdmissionError as e:
+                rejected = e
+                break
+        assert rejected is not None
+        assert rejected.retry_after_s >= 1.0
+        assert eng.metrics.requests_rejected == 1
+        # everything admitted before the bound still completes
+        done = eng.run_to_completion()
+        assert len(done) == i
+        assert eng.metrics.queue_depth_peak >= 1
+
+    def test_unbounded_queue_by_default(self, model):
+        cfg, params = model
+        eng = make_engine(cfg, params)
+        for i in range(20):
+            eng.submit(GenRequest(request_id=f"u{i}", prompt_ids=[1, 2],
+                                  max_new_tokens=1))
+        assert len(eng.run_to_completion()) == 20

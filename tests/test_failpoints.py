@@ -1,0 +1,500 @@
+"""Failpoint fault-injection subsystem + chaos matrix.
+
+The contract under test (ISSUE 1 acceptance criteria): with any failpoint
+armed, every affected request still receives exactly one terminal event,
+the KV-pool leak detector reports zero leaked pages, no slot is left
+stuck, and the engine keeps serving new requests after recovery.  Also
+covers the rule/trigger machinery itself (parse syntax, env activation,
+nth/count scoping, the delay action) and the per-tier sites: sandbox.exec
+degrades to a terminal error ToolEvent, db.write surfaces as an exception
+without corrupting the store.
+"""
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from kafka_tpu.llm.worker import EngineWorker
+from kafka_tpu.models import ModelConfig, init_params
+from kafka_tpu.runtime import (
+    EngineConfig,
+    FailpointError,
+    GenRequest,
+    InferenceEngine,
+)
+from kafka_tpu.runtime import failpoints as fp
+from kafka_tpu.runtime.kv_cache import PagePool
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    fp.clear()
+    yield
+    fp.clear()
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = ModelConfig(name="failpoint-test", vocab_size=128, hidden_size=64,
+                      intermediate_size=128, num_layers=2, num_heads=4,
+                      num_kv_heads=2, head_dim=16, dtype="float32")
+    params = init_params(cfg, jax.random.PRNGKey(7))
+    return cfg, params
+
+
+def make_engine(cfg, params, **kw):
+    defaults = dict(max_batch=2, page_size=8, num_pages=32,
+                    max_pages_per_seq=4, prefill_buckets=(8, 16, 32))
+    defaults.update(kw)
+    return InferenceEngine(cfg, params, EngineConfig(**defaults),
+                           kv_dtype=jnp.float32)
+
+
+class TestRuleMachinery:
+    def test_disabled_is_noop(self):
+        fp.failpoint("engine.step")  # nothing armed: must not raise
+
+    def test_error_fires_and_clears(self):
+        fp.configure("x.y", "error", "boom")
+        with pytest.raises(FailpointError, match="boom"):
+            fp.failpoint("x.y")
+        fp.clear("x.y")
+        fp.failpoint("x.y")
+
+    def test_nth_trigger_fires_exactly_once(self):
+        rule = fp.configure("x.y", "error", nth=3)
+        fp.failpoint("x.y")
+        fp.failpoint("x.y")
+        with pytest.raises(FailpointError):
+            fp.failpoint("x.y")
+        fp.failpoint("x.y")  # disarmed after the nth call
+        assert rule.calls == 4 and rule.fired == 1
+
+    def test_count_caps_firings(self):
+        fp.configure("x.y", "error", count=2)
+        for _ in range(2):
+            with pytest.raises(FailpointError):
+                fp.failpoint("x.y")
+        fp.failpoint("x.y")
+
+    def test_delay_action_sleeps(self):
+        fp.configure("x.y", "delay", "0.05")
+        t0 = time.monotonic()
+        fp.failpoint("x.y")
+        assert time.monotonic() - t0 >= 0.045
+
+    def test_parse_syntax(self):
+        rules = fp.parse(
+            "engine.step=error(boom):nth=3; kv.alloc=delay(0.05):count=2"
+        )
+        assert rules[0].site == "engine.step"
+        assert rules[0].action == "error"
+        assert rules[0].arg == "boom"
+        assert rules[0].nth == 3
+        assert rules[1].site == "kv.alloc"
+        assert rules[1].action == "delay"
+        assert rules[1].count == 2
+
+    @pytest.mark.parametrize("bad", [
+        "nonsense", "a.b=explode", "a.b=error(x):often=2", "a.b=error(x",
+    ])
+    def test_parse_rejects_bad_specs(self, bad):
+        with pytest.raises(ValueError):
+            fp.parse(bad)
+
+    def test_env_activation(self):
+        assert fp.load_env("x.y=error(env-armed)") == 1
+        with pytest.raises(FailpointError, match="env-armed"):
+            fp.failpoint("x.y")
+
+    def test_armed_context_manager_restores(self):
+        with fp.armed("x.y", "error"):
+            assert fp.active_rules()
+        assert not fp.active_rules()
+
+
+def run_chaos(eng, n_requests=3, max_new=3, step_cap=500):
+    """Drive the engine the way EngineWorker does (step, recover on
+    exception) until idle; returns {request_id: finish_reason}."""
+    for i in range(n_requests):
+        eng.submit(GenRequest(request_id=f"r{i}", prompt_ids=[1, 2, 3],
+                              max_new_tokens=max_new))
+    terminal = {}
+    steps = 0
+    while eng.has_work and steps < step_cap:
+        steps += 1
+        try:
+            events = eng.step()
+        except Exception:
+            events = eng.recover_from_failure()
+        for ev in events:
+            if ev.finished:
+                assert ev.request_id not in terminal, (
+                    f"{ev.request_id} got TWO terminal events"
+                )
+                terminal[ev.request_id] = ev.finish_reason
+    return terminal
+
+
+def assert_invariants(eng, terminal, n_requests=3):
+    # every request got exactly one terminal event (dup asserted inline)
+    assert len(terminal) == n_requests, terminal
+    # zero leaked pages: everything back in the free list
+    assert eng.pool.free_pages == eng.pool.num_pages - 1
+    # zero stuck slots, clean route/page accounting
+    assert all(s is None for s in eng.slots)
+    assert not eng.self_check(), eng.self_check()
+    assert not eng._requests
+
+
+CHAOS_MATRIX = [
+    ("engine.step", 1), ("engine.step", 4), ("engine.step", 9),
+    ("engine.prefill", 1), ("engine.prefill", 3),
+    ("kv.alloc", 1), ("kv.alloc", 2), ("kv.alloc", 3),
+]
+
+
+class TestChaosMatrix:
+    @pytest.mark.parametrize("site,nth", CHAOS_MATRIX)
+    def test_injected_fault_preserves_invariants(self, model, site, nth):
+        cfg, params = model
+        eng = make_engine(cfg, params)
+        with fp.armed(site, "error", nth=nth):
+            terminal = run_chaos(eng)
+        assert_invariants(eng, terminal)
+        # the engine must keep serving after recovery
+        req = eng.generate([5, 6, 7], max_new_tokens=2)
+        assert req.finish_reason == "length"
+
+    def test_step_delay_does_not_break_anything(self, model):
+        cfg, params = model
+        eng = make_engine(cfg, params)
+        with fp.armed("engine.step", "delay", "0.02", count=2):
+            terminal = run_chaos(eng)
+        assert_invariants(eng, terminal)
+        assert all(r in ("length", "stop") for r in terminal.values())
+
+    def test_waiting_requests_survive_recovery(self, model):
+        """A step failure fails STARTED requests but queued ones are kept
+        and served after recovery (improvement over fail-everything)."""
+        cfg, params = model
+        eng = make_engine(cfg, params, max_batch=1, max_parked=0)
+        with fp.armed("engine.step", "error", nth=2):
+            terminal = run_chaos(eng, n_requests=3)
+        assert_invariants(eng, terminal)
+        # the batch holds one request; the two queued behind it must have
+        # finished normally
+        normal = [r for r in terminal.values() if r == "length"]
+        assert len(normal) >= 2, terminal
+
+    def test_repeated_faults_still_converge(self, model):
+        cfg, params = model
+        eng = make_engine(cfg, params)
+        with fp.armed("engine.step", "error", count=3):
+            # count=3 without nth: the first three steps all die
+            terminal = run_chaos(eng)
+        assert_invariants(eng, terminal)
+
+
+class TestLeakDetector:
+    def test_clean_pool_passes(self):
+        pool = PagePool(8, 4)
+        assert not pool.check_consistency()
+
+    def test_detects_leaked_refcount(self):
+        pool = PagePool(8, 4)
+        pages = pool.alloc(2)
+        problems = pool.reconcile({}, repair=False)
+        assert len(problems) == 2 and "leaked" in problems[0]
+        # repair force-releases them back to the free list
+        pool.reconcile({}, repair=True)
+        assert pool.free_pages == 7
+        assert not pool.check_consistency()
+
+    def test_detects_double_free(self):
+        pool = PagePool(8, 4)
+        pages = pool.alloc(1)
+        expected = {pages[0]: 1}
+        pool.release(pages)  # owner did not give its reference up
+        problems = pool.reconcile(expected, repair=True)
+        assert problems and "double-freed" in problems[0]
+        assert int(pool.refcount[pages[0]]) == 1
+        assert pages[0] not in pool._free
+
+    def test_engine_self_check_spots_manufactured_leak(self, model):
+        cfg, params = model
+        eng = make_engine(cfg, params)
+        leaked = eng.pool.alloc(1)  # nobody owns this
+        problems = eng.self_check()
+        assert any("leaked" in p for p in problems)
+        eng.self_check(repair=True)
+        assert not eng.self_check()
+        assert eng.pool.free_pages == eng.pool.num_pages - 1
+
+    def test_self_check_respects_prefix_cache_retains(self, model):
+        cfg, params = model
+        eng = make_engine(cfg, params, prefix_cache_entries=4)
+        eng.submit(GenRequest(request_id="p1", prompt_ids=[1] * 9,
+                              max_new_tokens=2, prefix_key="thread-1"))
+        eng.run_to_completion()
+        # cache holds retained pages; they are owners, not leaks
+        assert len(eng.prefix_cache) == 1
+        assert not eng.self_check(), eng.self_check()
+
+
+class TestWorkerRecovery:
+    def _collect(self, worker, events_q):
+        async def go():
+            got = []
+            while True:
+                ev = await asyncio.wait_for(events_q.get(), timeout=30)
+                got.append(ev)
+                if ev.finished:
+                    return got
+        return go
+
+    def test_streams_get_terminal_events_through_worker(self, model):
+        cfg, params = model
+        eng = make_engine(cfg, params)
+        worker = EngineWorker(eng).start()
+        try:
+            with fp.armed("engine.step", "error", nth=3):
+                async def go():
+                    loop = asyncio.get_running_loop()
+                    queues = [
+                        worker.submit(
+                            GenRequest(request_id=f"w{i}",
+                                       prompt_ids=[1, 2, 3],
+                                       max_new_tokens=4),
+                            loop,
+                        )
+                        for i in range(3)
+                    ]
+
+                    async def drain(q):
+                        reasons = []
+                        while True:
+                            ev = await asyncio.wait_for(q.get(), timeout=30)
+                            if ev.finished:
+                                return ev.finish_reason
+                    return await asyncio.gather(*(drain(q) for q in queues))
+
+                reasons = asyncio.run(go())
+            # every stream terminated (error or clean), none hung
+            assert len(reasons) == 3
+            # engine is servable again and accounting is clean
+            deadline = time.monotonic() + 10
+            while eng.has_work and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert not eng.self_check(), eng.self_check()
+            assert not worker.check_routes()
+        finally:
+            worker.stop()
+
+    def test_dispatch_fault_does_not_hang_stream(self, model):
+        cfg, params = model
+        eng = make_engine(cfg, params)
+        worker = EngineWorker(eng).start()
+        try:
+            with fp.armed("worker.dispatch", "error", nth=2):
+                async def go():
+                    loop = asyncio.get_running_loop()
+                    q = worker.submit(
+                        GenRequest(request_id="d1", prompt_ids=[1, 2, 3],
+                                   max_new_tokens=4),
+                        loop,
+                    )
+                    while True:
+                        ev = await asyncio.wait_for(q.get(), timeout=30)
+                        if ev.finished:
+                            return ev.finish_reason
+
+                reason = asyncio.run(go())
+            assert reason in ("length", "stop")
+        finally:
+            worker.stop()
+
+    def test_terminal_event_survives_repeated_dispatch_faults(self, model):
+        """A fault that keeps firing across dispatch attempts must not
+        lose the terminal event: failed terminal dispatches requeue
+        through the inbox and deliver once the bounded rule expires."""
+        cfg, params = model
+        eng = make_engine(cfg, params)
+        worker = EngineWorker(eng).start()
+        try:
+            with fp.armed("worker.dispatch", "error", count=4):
+                async def go():
+                    loop = asyncio.get_running_loop()
+                    q = worker.submit(
+                        GenRequest(request_id="rd1", prompt_ids=[1, 2, 3],
+                                   max_new_tokens=2),
+                        loop,
+                    )
+                    while True:
+                        ev = await asyncio.wait_for(q.get(), timeout=30)
+                        if ev.finished:
+                            return ev.finish_reason
+
+                reason = asyncio.run(go())
+            assert reason in ("length", "stop")
+            assert not worker.check_routes()
+        finally:
+            worker.stop()
+
+    def test_unbounded_dispatch_fault_cannot_hang_stream(self, model):
+        """Even a rule that NEVER stops firing must not hang a consumer:
+        after the paced retry budget, the terminal event is delivered
+        with the failpoint bypassed (last-resort path)."""
+        cfg, params = model
+        eng = make_engine(cfg, params)
+        worker = EngineWorker(eng).start()
+        try:
+            with fp.armed("worker.dispatch", "error"):  # unbounded
+                async def go():
+                    loop = asyncio.get_running_loop()
+                    q = worker.submit(
+                        GenRequest(request_id="ub1", prompt_ids=[1, 2, 3],
+                                   max_new_tokens=2),
+                        loop,
+                    )
+                    while True:
+                        ev = await asyncio.wait_for(q.get(), timeout=60)
+                        if ev.finished:
+                            return ev.finish_reason
+
+                reason = asyncio.run(go())
+            assert reason in ("length", "stop")
+        finally:
+            worker.stop()
+
+
+class TestSandboxExecSite:
+    def test_injected_fault_yields_terminal_tool_error(self):
+        from kafka_tpu.sandbox.local import LocalSandbox
+
+        sbx = LocalSandbox("http://127.0.0.1:1")  # never dialed
+
+        async def go():
+            events = []
+            with fp.armed("sandbox.exec", "error", "chaos"):
+                async for ev in sbx.run_tool("shell_exec", {"cmd": "true"}):
+                    events.append(ev)
+            await sbx.aclose()
+            return events
+
+        events = asyncio.run(go())
+        assert len(events) == 1
+        assert events[0].kind == "error"
+        assert events[0].terminal
+        assert "chaos" in events[0].text()
+
+
+class TestDbWriteSite:
+    def test_write_fault_surfaces_and_store_survives(self, tmp_path):
+        from kafka_tpu.db import LocalDBClient
+
+        async def go():
+            db = LocalDBClient(str(tmp_path / "chaos.db"))
+            await db.initialize()
+            with fp.armed("db.write", "error", "disk gone"):
+                with pytest.raises(FailpointError):
+                    await db.create_thread(thread_id="t-fault")
+            # the store is intact after the fault clears
+            tid = await db.create_thread(thread_id="t-ok")
+            assert await db.thread_exists(tid)
+            assert not await db.thread_exists("t-fault")
+            await db.close()
+
+        asyncio.run(go())
+
+    def test_reads_not_gated_by_db_write_site(self, tmp_path):
+        from kafka_tpu.db import LocalDBClient
+
+        async def go():
+            db = LocalDBClient(str(tmp_path / "reads.db"))
+            await db.initialize()
+            tid = await db.create_thread(thread_id="t1")
+            with fp.armed("db.write", "error"):
+                assert await db.thread_exists(tid)  # SELECT: unaffected
+            await db.close()
+
+        asyncio.run(go())
+
+
+class TestGracefulDrainProvider:
+    def test_drain_lets_inflight_finish(self, model):
+        from kafka_tpu.llm import TPULLMProvider
+        from kafka_tpu.models.tokenizer import ByteTokenizer
+
+        tok = ByteTokenizer()
+        cfg, params = model
+        cfg = cfg.replace(vocab_size=tok.vocab_size)
+        params = init_params(cfg, jax.random.PRNGKey(3))
+        eng = make_engine(cfg, params, num_pages=64, max_pages_per_seq=8,
+                          page_size=16)
+        provider = TPULLMProvider(eng, tok, model_name="drain-test")
+
+        async def go():
+            chunks = []
+
+            async def consume():
+                async for c in provider.stream_completion(
+                    [{"role": "user", "content": "hi"}], max_tokens=6
+                ):
+                    chunks.append(c)
+
+            task = asyncio.create_task(consume())
+            await asyncio.sleep(0.05)  # let it enter the engine
+            clean = await provider.drain(timeout_s=30)
+            await task
+            return clean, chunks
+
+        clean, chunks = asyncio.run(go())
+        assert clean is True
+        assert chunks and chunks[-1].finish_reason in ("stop", "length")
+        asyncio.run(provider.aclose())
+
+    def test_drain_timeout_cancels_leftovers(self, model):
+        from kafka_tpu.llm import TPULLMProvider
+        from kafka_tpu.models.tokenizer import ByteTokenizer
+
+        tok = ByteTokenizer()
+        cfg, params = model
+        cfg = cfg.replace(vocab_size=tok.vocab_size)
+        params = init_params(cfg, jax.random.PRNGKey(3))
+        eng = make_engine(cfg, params, num_pages=64, max_pages_per_seq=8,
+                          page_size=16)
+        provider = TPULLMProvider(eng, tok, model_name="drain-test")
+
+        async def go():
+            loop = asyncio.get_running_loop()
+            # no stop tokens + a step-delay failpoint: the request cannot
+            # finish inside the drain budget, forcing the cancel sweep
+            q = provider.worker.submit(
+                GenRequest(request_id="slow", prompt_ids=[1, 2, 3],
+                           max_new_tokens=2000),
+                loop,
+            )
+            with fp.armed("engine.step", "delay", "0.02"):
+                # wait for the worker thread to move the submit from its
+                # inbox into the engine before draining
+                deadline = time.monotonic() + 10
+                while not eng.has_work and time.monotonic() < deadline:
+                    await asyncio.sleep(0.005)
+                clean = await provider.drain(timeout_s=0.2)
+            while True:
+                ev = await asyncio.wait_for(q.get(), timeout=30)
+                if ev.finished:
+                    return clean, ev.finish_reason
+
+        clean, reason = asyncio.run(go())
+        # the request could not finish inside the timeout: it was
+        # cancelled, and its stream still observed a terminal event
+        assert clean is False
+        assert reason == "cancelled"
+        asyncio.run(provider.aclose())

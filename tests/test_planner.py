@@ -163,3 +163,32 @@ class TestServingIntegration:
         s = plan_for_serving(ServingConfig()).summary()
         json.dumps(s)
         assert {"fits", "weight_gib", "max_concurrent_windows"} <= set(s)
+
+
+class TestMachineReadableFactorization:
+    """MemoryPlan.kv_shard/tq: the grouped tp×tq layout as fields, not
+    free-text notes (ADVICE r5).  Invariant: tp == kv_shard * tq."""
+
+    def test_grouped_layout_fields(self):
+        scfg = ServingConfig.profile_32k()  # degree 16 over 8 kv heads
+        plan = plan_for_serving(scfg, chip="v5p")
+        assert plan.kv_shard == 8 and plan.tq == 2
+        assert plan.mesh["tp"] * 1 == plan.kv_shard * plan.tq * 1
+        assert plan.summary()["kv_shard"] == 8
+        assert plan.summary()["tq"] == 2
+
+    def test_full_replication_reports_tq_equal_tp(self):
+        # a degree sharing no factor with Hkv: kv fully replicated, so
+        # tq must equal the whole degree (tp = kv_shard * tq holds)
+        plan = plan_memory(
+            get_config("llama-3-70b"), tp=3, num_pages=64, page_size=16,
+            max_pages_per_seq=16, max_batch=4,
+        )
+        assert plan.kv_shard == 1 and plan.tq == 3
+
+    def test_unsharded_plan_is_identity(self):
+        plan = plan_memory(
+            get_config("llama-3.2-1b"), num_pages=64, page_size=16,
+            max_pages_per_seq=16, max_batch=4,
+        )
+        assert plan.kv_shard == 1 and plan.tq == 1

@@ -444,3 +444,127 @@ class TestAuthAndProfiles:
                 await client.close()
 
         asyncio.run(go())
+
+
+class OverloadedLLM(FakeLLM):
+    """FakeLLM reporting a full engine queue (admission_check seam)."""
+
+    def __init__(self, turns, retry_after=7.0):
+        super().__init__(turns)
+        self.retry_after = retry_after
+
+    def admission_check(self):
+        return self.retry_after
+
+
+class DrainRecordingLLM(FakeLLM):
+    def __init__(self, turns):
+        super().__init__(turns)
+        self.drained_with = None
+
+    async def drain(self, timeout_s):
+        self.drained_with = timeout_s
+        return True
+
+
+class TestLifecycleHTTP:
+    """429/Retry-After admission contract + graceful-drain surface."""
+
+    def _build(self, tmp_path, llm):
+        db = LocalDBClient(str(tmp_path / "lh.db"))
+
+        async def build():
+            app = await create_app(
+                cfg=ServingConfig(db_path=str(tmp_path / "lh.db")),
+                llm_provider=llm,
+                db=db,
+                tools=[],
+            )
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            return client
+
+        return build
+
+    def test_queue_full_answers_429_with_retry_after(self, tmp_path):
+        llm = OverloadedLLM([], retry_after=7.0)
+        build = self._build(tmp_path, llm)
+
+        async def go():
+            client = await build()
+            try:
+                r = await client.post(
+                    "/v1/chat/completions",
+                    json={"messages": [{"role": "user", "content": "hi"}]},
+                )
+                assert r.status == 429
+                assert r.headers["Retry-After"] == "7"
+                body = await r.json()
+                assert body["error"]["type"] == "server_overloaded"
+                # CRUD endpoints stay open under overload
+                t = await client.post("/v1/threads", json={})
+                assert t.status == 201
+            finally:
+                await client.close()
+
+        asyncio.run(go())
+
+    def test_admitting_when_engine_has_room(self, tmp_path):
+        llm = OverloadedLLM([text_turn("ok")], retry_after=None)
+        build = self._build(tmp_path, llm)
+
+        async def go():
+            client = await build()
+            try:
+                r = await client.post(
+                    "/v1/chat/completions",
+                    json={"model": "fake-model",
+                          "messages": [{"role": "user", "content": "hi"}]},
+                )
+                assert r.status == 200
+            finally:
+                await client.close()
+
+        asyncio.run(go())
+
+    def test_draining_flips_health_and_rejects_serving(self, tmp_path):
+        llm = FakeLLM([])
+        build = self._build(tmp_path, llm)
+
+        async def go():
+            client = await build()
+            try:
+                from kafka_tpu.server.app import STATE_KEY
+
+                client.app[STATE_KEY]["draining"] = True
+                h = await client.get("/health")
+                assert h.status == 503
+                assert (await h.json())["status"] == "draining"
+                r = await client.post(
+                    "/v1/agent/run",
+                    json={"messages": [{"role": "user", "content": "hi"}]},
+                )
+                assert r.status == 503
+                assert "Retry-After" in r.headers
+                # reads stay open while draining (debugging/observability)
+                t = await client.get("/v1/threads")
+                assert t.status == 200
+            finally:
+                await client.close()
+
+        asyncio.run(go())
+
+    def test_shutdown_invokes_provider_drain(self, tmp_path):
+        llm = DrainRecordingLLM([])
+        build = self._build(tmp_path, llm)
+
+        async def go():
+            client = await build()
+            from kafka_tpu.server.app import STATE_KEY
+
+            app = client.app
+            await client.close()  # server shutdown runs on_shutdown hooks
+            assert llm.drained_with == app[STATE_KEY]["cfg"].drain_timeout_s
+            assert app[STATE_KEY]["draining"] is True
+
+        asyncio.run(go())
