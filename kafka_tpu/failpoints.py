@@ -10,15 +10,26 @@ demand.  This module is that seam for kafka_tpu:
 * **Sites** are plain strings compiled into the hot paths:
   ``engine.step`` (top of the scheduler iteration), ``engine.prefill``
   (chunk dispatch), ``kv.alloc`` (page allocation), ``worker.dispatch``
-  (token-event routing), ``sandbox.exec`` (tool execution),
-  ``db.write`` (thread-store mutation).  The registry is open — any
-  string works — but those are the wired ones (see SITES).
+  (token-event routing), ``sandbox.exec`` (tool execution, client side),
+  ``sandbox.boot`` (subprocess sandbox spawn), ``sandbox.server.exec``
+  (tool execution INSIDE the sandbox subprocess), ``dist.init``
+  (jax.distributed initialization), ``dist.step`` (a guarded multi-host
+  collective), ``db.write`` (thread-store mutation).  The registry is
+  open — any string works — but those are the wired ones (see SITES).
 * **Rules** attach an action to a site: ``error`` raises
-  :class:`FailpointError`, ``delay`` sleeps.  Triggers scope a rule to the
-  ``nth`` call (1-based, fires once) or cap total firings with ``count``.
+  :class:`FailpointError`, ``delay`` sleeps, ``exit`` hard-kills the
+  process (``os._exit``) — the cross-process chaos primitive: armed in a
+  sandbox subprocess or a jax.distributed worker it simulates a crashed
+  peer, so tests can assert the SURVIVING process degrades cleanly.
+  Triggers scope a rule to the ``nth`` call (1-based, fires once) or cap
+  total firings with ``count``.
 * **Off by default, zero hot-path cost**: every call site goes through
   :func:`failpoint`, whose first line is a module-global bool check — no
   dict lookup, no lock, nothing, until some rule is armed.
+* **Cross-process inheritance**: :func:`subprocess_env` serializes the
+  currently-armed rules back into the env syntax so child processes
+  (sandbox subprocesses, jax.distributed workers) arm the same spec at
+  import — chaos reaches across PID boundaries.
 
 Activation is programmatic (``configure`` / the ``armed`` context manager
 in tests) or environmental::
@@ -42,15 +53,24 @@ logger = logging.getLogger("kafka_tpu.failpoints")
 
 ENV_VAR = "KAFKA_TPU_FAILPOINTS"
 
-# The sites wired into call paths (documentation; the registry is open).
+# The sites wired into call paths.  This is the DOCUMENTED REGISTRY: a
+# static check (tests/test_failpoints.py) asserts every failpoint("<site>")
+# call in kafka_tpu/ appears here and vice versa, so new sites cannot ship
+# undocumented.  The runtime registry itself stays open (any string works).
 SITES = (
     "engine.step",
     "engine.prefill",
     "kv.alloc",
     "worker.dispatch",
     "sandbox.exec",
+    "sandbox.boot",
+    "sandbox.server.exec",
+    "dist.init",
+    "dist.step",
     "db.write",
 )
+
+ACTIONS = ("error", "delay", "exit")
 
 
 class FailpointError(RuntimeError):
@@ -70,8 +90,8 @@ class Rule:
     `fired` counts actual firings (the difference is trigger filtering)."""
 
     site: str
-    action: str  # "error" | "delay"
-    arg: str = ""  # error message / delay seconds (as given)
+    action: str  # "error" | "delay" | "exit"
+    arg: str = ""  # error message / delay seconds / exit code (as given)
     nth: Optional[int] = None  # fire ONLY on the nth call (1-based)
     count: Optional[int] = None  # max firings (None = unlimited)
     calls: int = 0
@@ -90,6 +110,12 @@ class Rule:
         if self.action == "delay":
             time.sleep(float(self.arg or 0.01))
             return
+        if self.action == "exit":
+            # simulate a process crash: no atexit, no finally blocks, no
+            # flushed streams — the way a SIGKILL'd peer actually looks to
+            # the processes that outlive it
+            logger.error("failpoint %s: hard process exit", self.site)
+            os._exit(int(self.arg or 1))
         raise FailpointError(self.site, self.arg)
 
 
@@ -120,10 +146,21 @@ def configure(
     count: Optional[int] = None,
 ) -> Rule:
     """Arm one rule (replacing any existing rule at `site`)."""
-    if action not in ("error", "delay"):
+    if action not in ACTIONS:
         raise ValueError(f"unknown failpoint action {action!r} for {site!r}")
+    if any(c in str(arg) for c in ";:)"):
+        # the spec metacharacters cannot serialize (format_rules), and an
+        # unserializable rule would break subprocess_env — failing every
+        # sandbox spawn while an UNRELATED rule is armed.  Fail at arm
+        # time instead (parse() can't produce such args syntactically).
+        raise ValueError(
+            f"failpoint arg {arg!r} for {site!r} may not contain the "
+            "spec metacharacters ';' ':' ')'"
+        )
     if action == "delay":
         float(arg or 0.01)  # validate now, not at fire time
+    elif action == "exit":
+        int(arg or 1)
     rule = Rule(site=site, action=action, arg=str(arg), nth=nth, count=count)
     global _active
     with _lock:
@@ -197,15 +234,68 @@ def parse(spec: str) -> List[Rule]:
                 count = int(v)
             else:
                 raise ValueError(f"unknown failpoint modifier {k!r}")
-        if action not in ("error", "delay"):
+        if action not in ACTIONS:
             raise ValueError(
                 f"unknown failpoint action {action!r} in {part!r}"
             )
+        # validate args at parse time, same as configure(): a bad spec
+        # must fail on load, not surface as the WRONG failure mode (a
+        # recoverable ValueError where the chaos run expected a kill)
+        if action == "delay":
+            float(arg or 0.01)
+        elif action == "exit":
+            int(arg or 1)
         rules.append(
             Rule(site=site.strip(), action=action, arg=arg, nth=nth,
                  count=count)
         )
     return rules
+
+
+def format_rules(rules: List[Rule]) -> str:
+    """Serialize rules back into the env syntax (inverse of :func:`parse`).
+
+    Round-trip property (chaos-tested): ``parse(format_rules(parse(s)))``
+    produces the same rules as ``parse(s)``.  Args containing the syntax
+    metacharacters ``;`` ``:`` ``)`` cannot round-trip and are rejected —
+    a spec that silently re-parses differently in the child process would
+    make cross-process chaos runs lie.
+    """
+    parts: List[str] = []
+    for r in rules:
+        if any(c in r.arg for c in ";:)"):
+            raise ValueError(
+                f"failpoint arg {r.arg!r} at {r.site!r} cannot be "
+                "serialized (contains spec metacharacters)"
+            )
+        head = f"{r.site}={r.action}"
+        if r.arg:
+            head += f"({r.arg})"
+        if r.nth is not None:
+            head += f":nth={r.nth}"
+        if r.count is not None:
+            head += f":count={r.count}"
+        parts.append(head)
+    return ";".join(parts)
+
+
+def subprocess_env(base: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+    """Environment for a child process that inherits the armed failpoints.
+
+    Cross-process chaos seam: sandbox subprocesses (sandbox/process.py)
+    and jax.distributed workers spawn with this env, so a spec armed in
+    the parent — programmatically or via KAFKA_TPU_FAILPOINTS — is live in
+    the child from import time (load_env at module bottom).  With nothing
+    armed, any stale spec inherited from the parent's own environment is
+    scrubbed: a disarmed parent must not spawn pre-armed children.
+    """
+    env = dict(os.environ if base is None else base)
+    spec = format_rules(active_rules())
+    if spec:
+        env[ENV_VAR] = spec
+    else:
+        env.pop(ENV_VAR, None)
+    return env
 
 
 def load_env(env: Optional[str] = None) -> int:

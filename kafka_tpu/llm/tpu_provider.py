@@ -49,6 +49,20 @@ from .worker import EngineWorker
 logger = logging.getLogger("kafka_tpu.llm.tpu")
 
 
+def _torn_items(d) -> list:
+    """Snapshot a dict the engine thread mutates concurrently.
+
+    list(dict.items()) can raise "dictionary changed size" mid-copy —
+    retry like metrics._copy_samples; torn reads are fine (a request
+    finishing during the copy no longer needs attention)."""
+    for _ in range(8):
+        try:
+            return list(d.items())
+        except RuntimeError:
+            continue
+    return []
+
+
 class IncrementalDetokenizer:
     """Streams token ids to text without re-decoding the whole output.
 
@@ -107,6 +121,10 @@ class TPULLMProvider(LLMProvider):
         self.worker = worker or EngineWorker(engine)
         self.worker.start()
         self._counter = itertools.count()
+        # topology-rebuild coordination: one resize at a time; while held
+        # (or waited on) the admission gate turns new traffic away, which
+        # is what makes the resize drain work a finite set and converge
+        self._resize_lock = asyncio.Lock()
         # Vision tower params (models/vision.py) — present iff the model
         # config has a VisionConfig; image requests 400 otherwise.
         self.vision_params = vision_params
@@ -187,10 +205,22 @@ class TPULLMProvider(LLMProvider):
         backstop for the race.  With DP replicas, admit while ANY replica
         has room (the router picks per-thread).
         """
+        if self._resize_lock.locked():
+            # topology rebuild in flight (or queued): turn new traffic
+            # away (429 + Retry-After) so the resize drain works a
+            # FINITE set
+            return 5.0
         limit = self.engine.ecfg.max_waiting
         if limit <= 0:
             return None
         replicas = self._replicas()
+        # a quarantined replica's empty queue is not capacity — the
+        # router will not place anything there; gate on ROUTABLE
+        # replicas or overload 429s are replaced by admission churn
+        health = getattr(self.engine, "health", None)
+        if health is not None:
+            routable = [e for e, h in zip(replicas, health) if h.routable]
+            replicas = routable or replicas
         if any(len(e.waiting) < limit for e in replicas):
             return None
         return min(e.retry_after_estimate() for e in replicas)
@@ -218,19 +248,8 @@ class TPULLMProvider(LLMProvider):
                 return True
             await asyncio.sleep(0.05)
 
-        def _ids(d):
-            # the engine thread mutates its _requests dict concurrently;
-            # list(dict) can raise "dictionary changed size" mid-copy —
-            # retry like metrics._copy_samples (torn reads are fine, a
-            # request finishing during the copy no longer needs a cancel)
-            for _ in range(8):
-                try:
-                    return list(d)
-                except RuntimeError:
-                    continue
-            return []
-
-        leftover = [rid for e in replicas for rid in _ids(e._requests)]
+        leftover = [rid for e in replicas
+                    for rid, _ in _torn_items(e._requests)]
         if leftover:
             logger.warning(
                 "drain timeout after %.1fs: cancelling %d in-flight "
@@ -246,6 +265,84 @@ class TPULLMProvider(LLMProvider):
             ):
                 await asyncio.sleep(0.02)
         return not leftover
+
+    async def resize_dp(self, dp: int, drain_timeout_s: float = 30.0) -> bool:
+        """Rebuild the DP replica set at a new dp count (replica loss /
+        scale-down) while WAITING requests survive the rebuild.
+
+        The drain/restart topology story (ISSUE 2): started lanes own
+        device state that cannot move across engines, so they get
+        `drain_timeout_s` to retire naturally; leftovers are cancelled
+        (each still receives its terminal event).  Queued requests are
+        never touched — they ride through the rebuild and serve from the
+        new replicas.  Returns True when no request had to be cancelled.
+
+        Engine restructuring happens with the worker thread PARKED
+        (EngineWorker.pause): the single-writer invariant means a parked
+        worker cannot race the rebuild, and queued submits/cancels simply
+        wait in the inbox for resume().  One resize runs at a time
+        (asyncio lock), and the admission gate 429s new serving traffic
+        for the duration — the drain then works a finite set and must
+        converge.
+        """
+        rebuild = getattr(self.engine, "rebuild", None)
+        if rebuild is None:
+            raise ValueError(
+                "resize_dp requires a DataParallelEngines engine "
+                "(single-engine deployments have no replica topology)"
+            )
+        # validate the device budget BEFORE draining: an impossible dp
+        # must fail up front, not after in-flight requests were cancelled
+        validate = getattr(self.engine, "validate_dp", None)
+        if validate is not None:
+            validate(dp)
+        async with self._resize_lock:
+            return await self._resize_locked(rebuild, dp, drain_timeout_s)
+
+    async def _resize_locked(self, rebuild, dp: int,
+                             drain_timeout_s: float) -> bool:
+        def _started(e) -> bool:
+            return bool(e.num_active or e.parked or e._pending)
+
+        clean = True
+        deadline = time.monotonic() + drain_timeout_s
+        while True:
+            # park first, then look: an unparked worker could seat a
+            # waiting request between our check and the rebuild
+            if not await asyncio.to_thread(self.worker.pause):
+                self.worker.resume()  # half-engaged pause must not linger
+                raise RuntimeError("engine worker did not pause")
+            busy = [e for e in self._replicas() if _started(e)]
+            if not busy:
+                break
+            self.worker.resume()
+            if time.monotonic() >= deadline:
+                if time.monotonic() >= deadline + drain_timeout_s + 5.0:
+                    # cancels were dispatched and still didn't land
+                    raise RuntimeError(
+                        "resize_dp: started work did not drain"
+                    )
+                # sweep EVERY iteration past the deadline: requests the
+                # worker seated after an earlier sweep (inbox stragglers)
+                # get cancelled too, so the finite set keeps shrinking.
+                # Worker is resumed, hence the torn-tolerant snapshot.
+                clean = False
+                ids = [rid for e in busy
+                       for rid, req in _torn_items(e._requests)
+                       if req.state != "waiting"]
+                if ids:
+                    logger.warning(
+                        "resize_dp: drain timeout; cancelling %d started "
+                        "request(s)", len(ids),
+                    )
+                    for rid in ids:
+                        self.worker.cancel(rid)
+            await asyncio.sleep(0.02)
+        try:
+            rebuild(dp=dp)
+        finally:
+            self.worker.resume()
+        return clean
 
     def get_model_info(self, model: Optional[str] = None) -> Dict[str, Any]:
         return {

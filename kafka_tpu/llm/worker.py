@@ -54,6 +54,12 @@ class EngineWorker:
         self._thread: Optional[threading.Thread] = None
         self._stopped = threading.Event()
         self._lock = threading.Lock()  # guards _routes (submit vs dispatch)
+        # pause seam (topology rebuilds): while paused the worker thread
+        # parks between steps — the engine's single-writer invariant then
+        # lets ANOTHER thread mutate engine structure safely
+        self._pause_req = threading.Event()
+        self._pause_ack = threading.Event()
+        self._resume_evt = threading.Event()
         # terminal events whose dispatch failed, awaiting a paced retry
         # (worker-thread only; see _dispatch_guarded/_retry_redispatches)
         self._redispatches: list = []
@@ -81,6 +87,25 @@ class EngineWorker:
     def alive(self) -> bool:
         return self._thread is not None and self._thread.is_alive()
 
+    def pause(self, timeout: float = 30.0) -> bool:
+        """Park the engine thread between steps; returns once it is parked
+        (True) or the wait timed out (False).  While paused, no step()
+        runs and no inbox command is processed — the caller owns the
+        engine and may restructure it (DataParallelEngines.rebuild).
+        Always pair with resume(), promptly: submits and cancels queue up
+        behind the pause."""
+        if not self.alive:
+            return True  # no thread -> nothing can race the caller
+        self._resume_evt.clear()
+        self._pause_ack.clear()
+        self._pause_req.set()
+        self._inbox.put(("__wake__", None))
+        return self._pause_ack.wait(timeout)
+
+    def resume(self) -> None:
+        self._pause_req.clear()
+        self._resume_evt.set()
+
     # -- request API (called from asyncio) -----------------------------
 
     def submit(
@@ -104,6 +129,10 @@ class EngineWorker:
     def _run(self) -> None:
         logger.info("engine worker started")
         while not self._stopped.is_set():
+            # pause seam: park between steps until resumed (or stopped)
+            while self._pause_req.is_set() and not self._stopped.is_set():
+                self._pause_ack.set()
+                self._resume_evt.wait(timeout=0.1)
             # Block when idle; drain without blocking when active.
             block = not self.engine.has_work
             try:

@@ -1,7 +1,12 @@
 """Parallelism tier: meshes, sharding rules, context/pipeline/expert
 parallelism, multi-host init."""
 
-from .distributed import init_distributed
+from .distributed import (
+    DistributedStepError,
+    barrier,
+    guarded_collective,
+    init_distributed,
+)
 from .expert import (
     init_moe_params,
     moe_mlp_reference,
@@ -34,6 +39,9 @@ from .sharding import (
 
 __all__ = [
     "AXIS_ORDER",
+    "DistributedStepError",
+    "barrier",
+    "guarded_collective",
     "init_distributed",
     "init_moe_params",
     "moe_mlp_reference",

@@ -21,17 +21,114 @@ Configuration, env-var driven for launcher friendliness:
 On Cloud TPU the three are auto-detected by JAX when omitted —
 `init_distributed()` with no env set on a multi-host TPU VM still does the
 right thing via `jax.distributed.initialize()`'s own discovery.
+
+**Fault tolerance across the process boundary** (ISSUE 2): a multi-host
+collective whose peer process died does not fail — it HANGS, because the
+transport keeps waiting for a contribution that will never arrive.  A
+serving process wedged inside a psum is the worst failure mode there is
+(no error, no progress, no drain).  `guarded_collective` is the crash-only
+wrapper: it runs the device computation on a watchdog thread and converts
+a missing-peer hang into a `DistributedStepError` within a deadline, so
+the surviving process can surface a clean terminal error (fail its
+in-flight requests, flip /health, exit) instead of hanging forever.
+Failpoint sites `dist.init` (before jax.distributed.initialize) and
+`dist.step` (top of every guarded collective) let chaos tests kill a
+coordinator or worker mid-psum — see tests/test_multihost.py.
 """
 
 from __future__ import annotations
 
 import logging
 import os
-from typing import Optional
+import threading
+from typing import Any, Callable, Optional
+
+from ..failpoints import failpoint
 
 logger = logging.getLogger("kafka_tpu.distributed")
 
 _INITIALIZED = False
+
+# Default watchdog budget for one guarded collective.  Generous: a real
+# collective is milliseconds-to-seconds; only a dead peer spends 60s.
+GUARD_TIMEOUT_ENV = "KAFKA_TPU_DIST_STEP_TIMEOUT_S"
+
+
+class DistributedStepError(RuntimeError):
+    """A guarded multi-host collective missed its deadline — a peer
+    process is dead or unreachable.  Deliberately terminal: the caller
+    must treat the distributed program as broken (fail in-flight work,
+    re-form the topology) — retrying the same collective against the
+    same dead peer would just hang again."""
+
+
+def barrier(name: str, timeout_s: float = 60.0) -> bool:
+    """Cross-process rendezvous on the jax.distributed coordination
+    service; returns False as a no-op when not in a multi-host topology.
+
+    Unlike XLA collectives this works on EVERY backend — including CPU,
+    whose jaxlib cannot run multiprocess computations at all — so chaos
+    tests (and topology-change choreography like coordinated drain)
+    rendezvous here.  A dead peer surfaces as a deadline error from the
+    coordination client rather than a silent hang; compose with
+    :func:`guarded_collective` for a hard watchdog on top.
+    """
+    if not _INITIALIZED:
+        return False
+    from jax._src import distributed as _dist  # no public barrier API yet
+
+    client = getattr(_dist.global_state, "client", None)
+    if client is None:
+        return False
+    client.wait_at_barrier(name, int(timeout_s * 1000))
+    return True
+
+
+def guarded_collective(
+    fn: Callable[..., Any],
+    *args: Any,
+    timeout_s: Optional[float] = None,
+    label: str = "collective",
+) -> Any:
+    """Run `fn(*args)` (a device computation containing cross-process
+    collectives) under a watchdog; raise DistributedStepError if it does
+    not complete within `timeout_s`.
+
+    `fn` must block until the result is materialized (e.g. call
+    `jax.block_until_ready` on its output) — an async dispatch that
+    returns a future would "complete" instantly and defeat the guard.
+
+    The watchdog thread is a daemon: when the deadline fires the stuck
+    collective is left behind (there is no portable way to cancel a
+    runtime collective) and the caller decides process fate — the
+    surviving workers of a killed peer typically log the terminal error
+    and exit rather than serve from a half-dead mesh.
+    """
+    failpoint("dist.step")
+    if timeout_s is None:
+        timeout_s = float(os.environ.get(GUARD_TIMEOUT_ENV, "60"))
+    result: dict = {}
+
+    def run() -> None:
+        try:
+            result["value"] = fn(*args)
+        except BaseException as e:  # surfaced to the caller below
+            result["error"] = e
+
+    t = threading.Thread(
+        target=run, name=f"kafka-tpu-dist-{label}", daemon=True
+    )
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        raise DistributedStepError(
+            f"distributed {label} did not complete within {timeout_s:.0f}s "
+            "— a peer process is dead or unreachable; this process must "
+            "not keep serving from a broken mesh"
+        )
+    if "error" in result:
+        raise result["error"]
+    return result.get("value")
 
 
 def init_distributed(
@@ -63,6 +160,10 @@ def init_distributed(
     )
     if coordinator_address is None and num_processes is None:
         return False  # single-process: nothing to do
+
+    # chaos seam: fires only once multi-host init is actually requested
+    # (single-process runs must never trip an armed dist.init rule)
+    failpoint("dist.init")
 
     import jax
 

@@ -1,4 +1,5 @@
-"""Data-parallel serving: request routing across engine replicas.
+"""Data-parallel serving: request routing across engine replicas, with
+per-replica fault supervision.
 
 SURVEY §2.2 defines serving DP as "continuous batching with the batch axis
 sharded or replicated per TP group" — in serving practice that is replica
@@ -17,6 +18,24 @@ mesh configuration (each slice carrying the tp axis) and routes:
   config 2 composes with DP);
 * unkeyed requests go to the least-loaded replica (active + waiting).
 
+**Replica supervision** (crash-only serving across the process/device
+boundary, Candea & Fox HotOS '03): each replica carries a health record.
+A step() failure counts against it; `quarantine_threshold` CONSECUTIVE
+failures trip a circuit breaker — the replica stops receiving traffic,
+its queued (WAITING) requests migrate to healthy replicas, and affinity
+pins re-steer lazily on next use.  Healthy replicas keep their in-flight
+requests untouched throughout.  After a backoff window (doubling per
+successive trip) the replica re-enters on PROBATION: it takes traffic
+again, but a single failure re-trips immediately, while
+`probation_steps` clean steps promote it back to healthy (warm
+re-admit).  If every replica is quarantined at once, the one closest to
+re-admission is force-probated — total quarantine must degrade to
+best-effort service, never to a refusal loop.
+
+`rebuild(dp=...)` re-creates the replica set at a different dp count
+(replica loss, scale-down) while WAITING requests survive the rebuild —
+the drain/restart topology story (server/app.py /admin/resize).
+
 The object intentionally mirrors the single-engine surface the serving
 worker uses (submit / cancel / step / has_work / metrics), so
 llm/worker.EngineWorker drives it unchanged.
@@ -24,7 +43,10 @@ llm/worker.EngineWorker drives it unchanged.
 
 from __future__ import annotations
 
+import dataclasses
 import logging
+import os
+import time
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional
 
@@ -33,8 +55,33 @@ import jax
 from ..models.config import ModelConfig
 from ..parallel import MeshConfig, make_mesh, resolve_tensor_axes
 from .engine import EngineConfig, GenRequest, InferenceEngine, TokenEvent
+from .metrics import ReplicaSupervisorMetrics
 
 logger = logging.getLogger("kafka_tpu.dp")
+
+QUARANTINE_THRESHOLD_ENV = "KAFKA_TPU_REPLICA_QUARANTINE_THRESHOLD"
+
+HEALTHY, PROBATION, QUARANTINED = "healthy", "probation", "quarantined"
+
+
+@dataclasses.dataclass
+class ReplicaHealth:
+    """One replica's supervision record (engine-thread single-writer)."""
+
+    state: str = HEALTHY
+    consecutive_failures: int = 0
+    total_failures: int = 0
+    quarantine_count: int = 0  # trips so far (drives backoff doubling)
+    quarantined_until: float = 0.0  # monotonic deadline of current window
+    probation_successes: int = 0
+
+    @property
+    def routable(self) -> bool:
+        return self.state != QUARANTINED
+
+    def gauge(self) -> float:
+        """Numeric health for /metrics: 1 healthy, 0.5 probation, 0 out."""
+        return {HEALTHY: 1.0, PROBATION: 0.5, QUARANTINED: 0.0}[self.state]
 
 
 class DataParallelEngines:
@@ -51,6 +98,9 @@ class DataParallelEngines:
         ep: int = 1,
         kv_dtype=None,
         devices: Optional[List[jax.Device]] = None,
+        quarantine_threshold: Optional[int] = None,
+        quarantine_window_s: float = 5.0,
+        probation_steps: int = 3,
     ):
         devices = list(devices if devices is not None else jax.devices())
         per = tp * sp * ep
@@ -60,9 +110,40 @@ class DataParallelEngines:
                 f"dp={dp} x sp={sp} x tp={tp} x ep={ep} needs {need} "
                 f"devices, have {len(devices)}"
             )
+        # construction inputs kept for rebuild() (topology resize)
+        self._cfg = cfg
+        self._params = params
+        self._engine_cfg = engine_cfg
+        self._tp, self._sp, self._ep = tp, sp, ep
+        self._kv_dtype = kv_dtype
+        self._devices = devices
+        if quarantine_threshold is None:
+            quarantine_threshold = int(
+                os.environ.get(QUARANTINE_THRESHOLD_ENV, "3")
+            )
+        self.quarantine_threshold = max(1, quarantine_threshold)
+        self.quarantine_window_s = quarantine_window_s
+        self.probation_steps = max(1, probation_steps)
+        self.supervisor = ReplicaSupervisorMetrics()
         self.engines: List[InferenceEngine] = []
+        self.health: List[ReplicaHealth] = []
+        self._build_engines(dp)
+        self._route: Dict[str, int] = {}  # request_id -> replica
+        # prefix_key -> replica, LRU-capped: a thread whose cache entry is
+        # long evicted shouldn't stay pinned (or leak memory) forever
+        self._affinity: "OrderedDict[str, int]" = OrderedDict()
+        self._affinity_cap = 4096
+        # which replica raised out of step(), so recovery targets it alone
+        self._failed_replica: Optional[int] = None
+        self._pre_failure_events: List[TokenEvent] = []
+
+    def _build_engines(self, dp: int) -> None:
+        cfg, engine_cfg = self._cfg, self._engine_cfg
+        tp, sp, ep = self._tp, self._sp, self._ep
+        per = tp * sp * ep
+        engines: List[InferenceEngine] = []
         for r in range(dp):
-            slice_devices = devices[r * per : (r + 1) * per]
+            slice_devices = self._devices[r * per : (r + 1) * per]
             # a mesh over exactly this replica's devices pins its params
             # and KV pool there (the engine places for any provided mesh);
             # sp>1 replicas run ring-sharded chunked prefill internally
@@ -72,19 +153,15 @@ class DataParallelEngines:
             )
             mesh = make_mesh(MeshConfig(sp=sp, tp=tpk, tq=tq, ep=ep),
                              devices=slice_devices)
-            self.engines.append(
+            engines.append(
                 InferenceEngine(
-                    cfg, params, engine_cfg, kv_dtype=kv_dtype, mesh=mesh
+                    cfg, self._params, engine_cfg,
+                    kv_dtype=self._kv_dtype, mesh=mesh,
                 )
             )
-        self._route: Dict[str, int] = {}  # request_id -> replica
-        # prefix_key -> replica, LRU-capped: a thread whose cache entry is
-        # long evicted shouldn't stay pinned (or leak memory) forever
-        self._affinity: "OrderedDict[str, int]" = OrderedDict()
-        self._affinity_cap = 4096
-        # which replica raised out of step(), so recovery targets it alone
-        self._failed_replica: Optional[int] = None
-        self._pre_failure_events: List[TokenEvent] = []
+        self.dp = dp
+        self.engines = engines
+        self.health = [ReplicaHealth() for _ in range(dp)]
 
     # -- engine-like surface (llm/worker.EngineWorker compatible) --------
 
@@ -108,25 +185,137 @@ class DataParallelEngines:
     def waiting(self) -> List[GenRequest]:
         return [r for e in self.engines for r in e.waiting]
 
+    # -- supervision -----------------------------------------------------
+
+    def _refresh_health(self, now: Optional[float] = None) -> None:
+        """Expire quarantine windows: quarantined -> probation."""
+        now = time.monotonic() if now is None else now
+        for i, h in enumerate(self.health):
+            if h.state == QUARANTINED and now >= h.quarantined_until:
+                h.state = PROBATION
+                h.probation_successes = 0
+                logger.warning(
+                    "replica %d quarantine window expired; on probation", i
+                )
+
+    def _routable_indices(self) -> List[int]:
+        self._refresh_health()
+        idxs = [i for i, h in enumerate(self.health) if h.routable]
+        if idxs:
+            return idxs
+        # every replica quarantined: force-probate the one closest to
+        # re-admission — degraded service beats refusing all traffic
+        i = min(range(len(self.health)),
+                key=lambda j: self.health[j].quarantined_until)
+        h = self.health[i]
+        h.state = PROBATION
+        h.probation_successes = 0
+        logger.error(
+            "all %d replicas quarantined; force-readmitting replica %d "
+            "on probation", len(self.health), i,
+        )
+        return [i]
+
+    def _note_failure(self, i: int) -> None:
+        h = self.health[i]
+        h.consecutive_failures += 1
+        h.total_failures += 1
+        threshold = 1 if h.state == PROBATION else self.quarantine_threshold
+        if h.state != QUARANTINED and h.consecutive_failures >= threshold:
+            h.quarantine_count += 1
+            # doubling backoff per successive trip, capped at one minute —
+            # a replica that flaps under load shouldn't thrash re-admission
+            window = min(
+                60.0,
+                self.quarantine_window_s * (2 ** (h.quarantine_count - 1)),
+            )
+            h.state = QUARANTINED
+            h.quarantined_until = time.monotonic() + window
+            h.consecutive_failures = 0
+            self.supervisor.quarantines += 1
+            logger.error(
+                "replica %d quarantined for %.1fs after %d failure(s) "
+                "(trip #%d)", i, window, threshold, h.quarantine_count,
+            )
+
+    def _note_success(self, i: int) -> None:
+        h = self.health[i]
+        h.consecutive_failures = 0
+        if h.state == PROBATION:
+            h.probation_successes += 1
+            if h.probation_successes >= self.probation_steps:
+                h.state = HEALTHY
+                self.supervisor.readmits += 1
+                logger.warning(
+                    "replica %d re-admitted after %d clean probation "
+                    "steps", i, h.probation_successes,
+                )
+
+    def _migrate_waiting(self, i: int) -> None:
+        """Move a quarantined replica's queue onto routable replicas.
+
+        WAITING requests own no device state on the sick replica; leaving
+        them there would hold them hostage for the whole quarantine window
+        when a healthy replica could serve them now."""
+        taken = self.engines[i].take_waiting()
+        if not taken:
+            return
+        targets = [j for j in self._routable_indices() if j != i]
+        if not targets:
+            # sole-survivor case: put them back rather than drop them
+            for req in taken:
+                self.engines[i].adopt(req)
+            return
+        for req in sorted(taken, key=lambda r: r.submit_time):
+            j = min(targets, key=lambda t: (
+                self.engines[t].num_active + len(self.engines[t].waiting)
+                + len(self.engines[t].parked)
+            ))
+            self.engines[j].adopt(req)
+            self._route[req.request_id] = j
+            if req.prefix_key is not None:
+                if self._affinity.get(req.prefix_key) == i:
+                    self.supervisor.affinity_resteered += 1
+                self._set_affinity(req.prefix_key, j)
+            self.supervisor.waiting_migrated += 1
+        logger.warning(
+            "migrated %d waiting request(s) off quarantined replica %d",
+            len(taken), i,
+        )
+
+    # -- routing ---------------------------------------------------------
+
+    def _set_affinity(self, prefix_key: str, idx: int) -> None:
+        self._affinity[prefix_key] = idx
+        self._affinity.move_to_end(prefix_key)
+        while len(self._affinity) > self._affinity_cap:
+            self._affinity.popitem(last=False)
+
     def _pick(self, req: GenRequest) -> int:
+        routable = self._routable_indices()
         if req.prefix_key is not None:
             hit = self._affinity.get(req.prefix_key)
-            if hit is not None:
-                self._affinity.move_to_end(req.prefix_key)
-                return hit
-        loads = [e.num_active + len(e.waiting) + len(e.parked)
-                 for e in self.engines]
-        return loads.index(min(loads))
+            if hit is not None and hit < len(self.engines):
+                if self.health[hit].routable:
+                    self._affinity.move_to_end(req.prefix_key)
+                    return hit
+                # pinned replica is quarantined/dead: re-steer the thread
+                # to a healthy replica (it pays one prefix-cache miss —
+                # the price of surviving the replica, not a wedged stream)
+                self.supervisor.affinity_resteered += 1
+        loads = [
+            (self.engines[i].num_active + len(self.engines[i].waiting)
+             + len(self.engines[i].parked), i)
+            for i in routable
+        ]
+        return min(loads)[1]
 
     def submit(self, req: GenRequest) -> None:
         idx = self._pick(req)
         self.engines[idx].submit(req)  # may raise: record routes only after
         self._route[req.request_id] = idx
         if req.prefix_key is not None:
-            self._affinity[req.prefix_key] = idx
-            self._affinity.move_to_end(req.prefix_key)
-            while len(self._affinity) > self._affinity_cap:
-                self._affinity.popitem(last=False)
+            self._set_affinity(req.prefix_key, idx)
 
     def cancel(self, request_id: str, reason: str = "cancelled") -> bool:
         idx = self._route.pop(request_id, None)
@@ -135,17 +324,22 @@ class DataParallelEngines:
         return self.engines[idx].cancel(request_id, reason=reason)
 
     def step(self) -> List[TokenEvent]:
+        self._refresh_health()
         events: List[TokenEvent] = []
         for i, e in enumerate(self.engines):
+            if not self.health[i].routable:
+                continue  # quarantined: no traffic, no stepping
             if e.has_work:
                 try:
                     events.extend(e.step())
+                    self._note_success(i)
                 except Exception:
                     # remember the failing replica and the events already
                     # collected from healthy ones; recover_from_failure
                     # (called by EngineWorker) returns both
                     self._failed_replica = i
                     self._pre_failure_events = events
+                    self._note_failure(i)
                     raise
         for ev in events:
             if ev.finished:
@@ -162,7 +356,9 @@ class DataParallelEngines:
         """Post-step-failure recovery (EngineWorker): only the replica
         that raised is recovered — healthy replicas keep their in-flight
         requests untouched.  Falls back to recovering every replica when
-        the failure origin is unknown (e.g. submit-path errors)."""
+        the failure origin is unknown (e.g. submit-path errors).  If the
+        failure tripped the circuit breaker, the quarantined replica's
+        queued requests migrate to healthy replicas before returning."""
         events: List[TokenEvent] = list(self._pre_failure_events)
         self._pre_failure_events = []
         idx = self._failed_replica
@@ -170,10 +366,64 @@ class DataParallelEngines:
         targets = self.engines if idx is None else [self.engines[idx]]
         for e in targets:
             events.extend(e.recover_from_failure())
+        for i, h in enumerate(self.health):
+            if h.state == QUARANTINED:
+                self._migrate_waiting(i)
         for ev in events:
             if ev.finished:
                 self._route.pop(ev.request_id, None)
         return events
+
+    # -- topology rebuild (drain/restart story) --------------------------
+
+    def validate_dp(self, dp: int) -> None:
+        """Raise ValueError when `dp` cannot fit the device budget.
+
+        Exposed separately from rebuild() so callers (resize_dp) can
+        reject an impossible topology UP FRONT, before draining cancels
+        any in-flight work."""
+        per = self._tp * self._sp * self._ep
+        if dp * per > len(self._devices):
+            raise ValueError(
+                f"dp={dp} x {per} devices/replica needs {dp * per}, "
+                f"have {len(self._devices)}"
+            )
+
+    def rebuild(self, dp: int) -> None:
+        """Re-create the replica set at a new dp count; WAITING requests
+        survive the rebuild (re-queued onto the new replicas in submit
+        order, with routes and affinity rewritten).
+
+        Precondition: no replica holds STARTED work (active lanes, parked
+        lanes, in-flight fetches) — the caller drains or cancels those
+        first (llm/tpu_provider.resize_dp does, with the worker paused).
+        Started lanes own device state that cannot move across engines."""
+        self.validate_dp(dp)
+        for i, e in enumerate(self.engines):
+            if e.num_active or e.parked or e._pending:
+                raise RuntimeError(
+                    f"cannot rebuild: replica {i} still holds started "
+                    "work (drain or cancel it first)"
+                )
+        pending: List[GenRequest] = []
+        for e in self.engines:
+            pending.extend(e.take_waiting())
+        old_dp = len(self.engines)
+        self._build_engines(dp)
+        # replica indices changed meaning: stale pins/routes must not leak
+        self._affinity.clear()
+        self._route.clear()
+        for req in sorted(pending, key=lambda r: r.submit_time):
+            j = min(range(dp), key=lambda t: len(self.engines[t].waiting))
+            self.engines[j].adopt(req)
+            self._route[req.request_id] = j
+            if req.prefix_key is not None:
+                self._set_affinity(req.prefix_key, j)
+        self.supervisor.rebuilds += 1
+        logger.warning(
+            "rebuilt topology dp=%d -> dp=%d (%d waiting request(s) "
+            "carried over)", old_dp, dp, len(pending),
+        )
 
     def self_check(self, repair: bool = False) -> List[str]:
         problems: List[str] = []
@@ -189,7 +439,7 @@ class DataParallelEngines:
     @property
     def metrics(self):
         # expose replica 0's metrics object shape with aggregate snapshot
-        return _AggregateMetrics(self.engines)
+        return _AggregateMetrics(self)
 
     @property
     def prefix_cache(self):
@@ -217,8 +467,9 @@ class DataParallelEngines:
 class _AggregateMetrics:
     """Aggregated snapshot over replicas (read-only)."""
 
-    def __init__(self, engines: List[InferenceEngine]):
-        self._engines = engines
+    def __init__(self, router: DataParallelEngines):
+        self._router = router
+        self._engines = router.engines
 
     def snapshot(self, engine=None) -> Dict[str, Any]:
         from .metrics import _copy_samples, _percentiles
@@ -285,4 +536,16 @@ class _AggregateMetrics:
                 k: sum(s["prefix_cache"][k] for s in snaps)
                 for k in snaps[0]["prefix_cache"]
             }
+        # replica-lifecycle observability: per-replica health gauges +
+        # the supervisor counter family (quarantine/re-admit/migration)
+        router = self._router
+        agg["replica_supervisor"] = {
+            "health": [h.gauge() for h in router.health],
+            "states": [h.state for h in router.health],
+            "consecutive_failures": [
+                h.consecutive_failures for h in router.health
+            ],
+            "total_failures": [h.total_failures for h in router.health],
+            **router.supervisor.snapshot(),
+        }
         return agg

@@ -938,6 +938,34 @@ class InferenceEngine:
         self.waiting.append(req)
         self._requests[req.request_id] = req
 
+    def take_waiting(self) -> List[GenRequest]:
+        """Remove and return every WAITING request (they own no device
+        state).  Replica supervision seam: the DP router migrates a
+        quarantined/dead replica's queue onto healthy replicas, and
+        topology rebuilds carry the queue across engine generations.
+        Must run on the thread that drives step() (single-writer)."""
+        taken = list(self.waiting)
+        self.waiting.clear()
+        for req in taken:
+            if req.seq is not None:  # defensive: a waiting req owns no pages
+                self.pool.free_sequence(req.seq)
+                req.seq = None
+            self._requests.pop(req.request_id, None)
+        return taken
+
+    def adopt(self, req: GenRequest) -> None:
+        """Requeue a WAITING request taken from another replica.
+
+        Unlike submit() this skips admission bounds and submission metrics
+        — the request was already admitted and counted once; migration
+        must neither double-count it nor bounce it off the target's queue
+        bound (a migrated request losing its slot in line would turn a
+        replica failure into client-visible rejections)."""
+        req.state = WAITING
+        self.waiting.append(req)
+        self.waiting.sort(key=lambda r: r.submit_time)
+        self._requests[req.request_id] = req
+
     def cancel(self, request_id: str, reason: str = "cancelled") -> bool:
         """Abort a request (client disconnect); frees its slot and pages.
 

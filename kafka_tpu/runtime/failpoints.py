@@ -9,6 +9,7 @@ site names, rule semantics, and the KAFKA_TPU_FAILPOINTS syntax.
 """
 
 from ..failpoints import (  # noqa: F401
+    ACTIONS,
     ENV_VAR,
     FailpointError,
     Rule,
@@ -18,11 +19,14 @@ from ..failpoints import (  # noqa: F401
     clear,
     configure,
     failpoint,
+    format_rules,
     load_env,
     parse,
+    subprocess_env,
 )
 
 __all__ = [
+    "ACTIONS",
     "ENV_VAR",
     "FailpointError",
     "Rule",
@@ -32,6 +36,8 @@ __all__ = [
     "clear",
     "configure",
     "failpoint",
+    "format_rules",
     "load_env",
     "parse",
+    "subprocess_env",
 ]

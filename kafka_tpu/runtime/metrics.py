@@ -48,6 +48,30 @@ def _percentiles(samples: List[float], pts=(50, 90, 99)) -> Dict[str, float]:
 
 
 @dataclasses.dataclass
+class ReplicaSupervisorMetrics:
+    """Counters owned by the DP replica supervisor (runtime/dp_router.py).
+
+    Single-writer like EngineMetrics: the engine/worker thread that drives
+    DataParallelEngines.step() is the only mutator; snapshot() is read
+    from serving threads and is torn-tolerant."""
+
+    quarantines: int = 0  # circuit-breaker trips (healthy/probation -> out)
+    readmits: int = 0  # probation -> healthy promotions (warm re-admit)
+    waiting_migrated: int = 0  # queued requests moved off a sick replica
+    affinity_resteered: int = 0  # prefix_key pins moved to a new replica
+    rebuilds: int = 0  # topology rebuilds (dp resize / replica loss)
+
+    def snapshot(self) -> Dict[str, int]:
+        return {
+            "quarantines": self.quarantines,
+            "readmits": self.readmits,
+            "waiting_migrated": self.waiting_migrated,
+            "affinity_resteered": self.affinity_resteered,
+            "rebuilds": self.rebuilds,
+        }
+
+
+@dataclasses.dataclass
 class EngineMetrics:
     """Counters owned by the engine; histograms keep the last N samples."""
 

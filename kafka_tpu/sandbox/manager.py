@@ -82,6 +82,34 @@ class SandboxManager:
         self._ready: Dict[str, Sandbox] = {}  # thread_id -> live sandbox
         self._pending: set = set()  # thread_ids with creation in flight
         self._tasks: Dict[str, asyncio.Task] = {}
+        # fire-and-forget cleanup tasks: the loop only weak-refs tasks, so
+        # hold them here until done or GC can collect one mid-await
+        self._bg_tasks: set = set()
+        # Crash supervision hookup (ProcessSandboxFactory exit watcher):
+        # when a subprocess dies, evict the ready-cache entry immediately
+        # rather than on the next health probe — in-flight tool execs get
+        # their one terminal error from the broken stream, and the next
+        # get_sandbox_if_ready goes straight to the reconnect/restart path
+        # instead of serving a dead handle out of cache.
+        register = getattr(factory, "set_crash_listener", None)
+        if register is not None:
+            register(self._on_sandbox_crash)
+
+    def _on_sandbox_crash(self, sandbox_id: str) -> None:
+        """Factory exit-watcher callback (runs on the event loop — all
+        cache mutation stays loop-confined, the module invariant)."""
+        for thread_id, sandbox in list(self._ready.items()):
+            if sandbox.sandbox_id == sandbox_id:
+                logger.warning(
+                    "sandbox %s for thread %s crashed; evicting from "
+                    "ready cache", sandbox_id, thread_id,
+                )
+                self._ready.pop(thread_id, None)
+                task = asyncio.get_running_loop().create_task(
+                    _aclose_quiet(sandbox)
+                )
+                self._bg_tasks.add(task)
+                task.add_done_callback(self._bg_tasks.discard)
 
     # -- claim config (reference manager.py:85-147) --------------------
 

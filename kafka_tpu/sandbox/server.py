@@ -33,6 +33,13 @@ from typing import Any, AsyncIterator, Dict, Optional
 
 from aiohttp import web
 
+# Importing the failpoint module arms any KAFKA_TPU_FAILPOINTS spec from
+# the environment (load_env at module bottom) — this is how a spec armed
+# in the parent reaches the sandbox subprocess (process.py spawns with
+# failpoints.subprocess_env()).  kafka_tpu.failpoints is import-light by
+# design: no JAX, nothing heavy enters the sandbox process.
+from ..failpoints import failpoint
+
 logger = logging.getLogger("kafka_tpu.sandbox.server")
 
 SBX_KEY = web.AppKey("sandbox_state", dict)
@@ -345,6 +352,11 @@ async def run_tool(request: web.Request) -> web.StreamResponse:
         )
 
     try:
+        # chaos seam INSIDE the sandbox process: `error` degrades to a
+        # terminal error event on the stream; `exit` simulates the whole
+        # subprocess crashing mid-tool (the client sees the stream die and
+        # must surface exactly one terminal error — sandbox/local.py)
+        failpoint("sandbox.server.exec")
         if name == "create_shell":
             shell_id = args.get("shell_id") or f"shell-{len(s['shells'])}"
             if shell_id not in s["shells"]:

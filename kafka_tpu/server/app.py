@@ -204,6 +204,7 @@ def build_tpu_provider(cfg: ServingConfig) -> LLMProvider:
             dp=cfg.dp_size, tp=cfg.tp_size, sp=cfg.sp_size,
             ep=cfg.ep_size,
             devices=local,
+            quarantine_threshold=cfg.replica_quarantine_threshold,
         )
     else:
         mesh = None
@@ -512,6 +513,7 @@ def _add_routes(app: web.Application) -> None:
     r.add_post("/v1/auth/login", auth_login)
     r.add_get("/health", health)
     r.add_get("/metrics", metrics)
+    r.add_post("/admin/resize", resize_topology)
     r.add_post("/debug/profile", capture_profile)
     r.add_get("/playground", playground)
     # OPTIONS preflight is answered by cors_middleware before routing
@@ -1057,6 +1059,12 @@ async def health(request: web.Request) -> web.Response:
         }
         if len(replicas) > 1:
             payload["engine"]["dp"] = len(replicas)
+        health_records = getattr(engine, "health", None)
+        if health_records:
+            # replica supervision at a glance: a load balancer (or a
+            # human) sees which replicas are quarantined without parsing
+            # the full /metrics snapshot
+            payload["engine"]["replicas"] = [h.state for h in health_records]
     return web.json_response(payload, status=503 if draining else 200)
 
 
@@ -1068,7 +1076,62 @@ async def metrics(request: web.Request) -> web.Response:
     engine = getattr(llm, "engine", None)
     if engine is None:
         return web.json_response({"error": "no local engine"}, status=404)
-    return web.json_response(engine.metrics.snapshot(engine))
+    snap = engine.metrics.snapshot(engine)
+    # sandbox subprocess supervision counters (crashes, supervised
+    # restarts, crash loops, reaped zombie handles) — module-aggregated
+    # across factories, same one-source-of-truth rule as the engine
+    # counters
+    from ..sandbox.process import supervisor_snapshot
+
+    snap["sandbox"] = supervisor_snapshot()
+    return web.json_response(snap)
+
+
+async def resize_topology(request: web.Request) -> web.Response:
+    """Rebuild the DP replica set at a new dp count (replica loss or
+    scale-down) while queued requests survive: body {"dp": N, optional
+    "drain_timeout_s": S}.  Started requests get the drain budget to
+    finish; leftovers are cancelled with terminal events (reported as
+    "clean": false).  Unlike serving endpoints, this one is
+    operator-destructive (it cancels whatever cannot drain), so the
+    open-if-no-token dev default does NOT apply: without a configured
+    KAFKA_TPU_API_TOKEN the endpoint refuses outright."""
+    if not _state(request)["cfg"].api_token:
+        return web.json_response(
+            {"error": "admin endpoints require KAFKA_TPU_API_TOKEN to "
+                      "be configured"},
+            status=403,
+        )
+    llm = _state(request)["llm"]
+    resize = getattr(llm, "resize_dp", None)
+    if resize is None or not hasattr(
+        getattr(llm, "engine", None), "rebuild"
+    ):
+        return web.json_response(
+            {"error": "this deployment has no resizable DP topology"},
+            status=501,
+        )
+    try:
+        body = await request.json()
+        dp = int(body["dp"])
+        drain_timeout_s = float(
+            body.get("drain_timeout_s",
+                     _state(request)["cfg"].drain_timeout_s)
+        )
+    except Exception:
+        return web.json_response(
+            {"error": 'body must be {"dp": N[, "drain_timeout_s": S]}'},
+            status=400,
+        )
+    if dp < 1:
+        return web.json_response({"error": "dp must be >= 1"}, status=400)
+    try:
+        clean = await resize(dp, drain_timeout_s=drain_timeout_s)
+    except ValueError as e:
+        return web.json_response({"error": str(e)}, status=400)
+    except RuntimeError as e:
+        return web.json_response({"error": str(e)}, status=409)
+    return web.json_response({"dp": dp, "clean": clean})
 
 
 async def playground(request: web.Request) -> web.Response:

@@ -68,6 +68,15 @@ class ServingConfig:
     request_timeout_s: Optional[float] = None
     max_queue_depth: int = 256
     drain_timeout_s: float = 30.0
+    # Cross-process fault tolerance (README "Process boundaries"):
+    #   replica_quarantine_threshold — consecutive step failures before a
+    #       DP replica is circuit-broken out of the router (probation +
+    #       warm re-admit after a doubling backoff window).  Sandbox
+    #       subprocess supervision is configured where the factory lives,
+    #       straight from KAFKA_TPU_SANDBOX_RESTART_BACKOFF_S /
+    #       KAFKA_TPU_SANDBOX_MAX_RESTARTS (sandbox/process.py) — no
+    #       config field here, the server never constructs that factory.
+    replica_quarantine_threshold: int = 3
     # server
     host: str = "0.0.0.0"
     port: int = 8000
@@ -161,6 +170,9 @@ class ServingConfig:
             max_queue_depth=get("MAX_QUEUE_DEPTH", cls.max_queue_depth, int),
             drain_timeout_s=get("DRAIN_TIMEOUT_S", cls.drain_timeout_s,
                                 float),
+            replica_quarantine_threshold=get(
+                "REPLICA_QUARANTINE_THRESHOLD",
+                cls.replica_quarantine_threshold, int),
             host=get("HOST", cls.host),
             port=get("PORT", cls.port, int),
             api_token=get("API_TOKEN", None),

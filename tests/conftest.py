@@ -56,6 +56,25 @@ if os.environ.get("KAFKA_TPU_TEST_RAISE_MAP_COUNT") == "1":
         pass  # not privileged / not Linux: the per-module purge still applies
 
 
+def pytest_configure(config):
+    """Marker registration (no pytest.ini in this repo).
+
+    * ``slow`` — excluded from the tier-1 run (`-m 'not slow'`); its
+      semantics are unchanged vs the seed, just registered now.
+    * ``chaos`` — multi-PROCESS kill tests (subprocess spawn + kill +
+      backoff waits).  Chaos tests that are also slow carry BOTH markers
+      so tier-1 keeps its fast single-process subset; run the full matrix
+      with ``pytest -m chaos``.
+    """
+    config.addinivalue_line(
+        "markers", "slow: long-running; excluded from tier-1 (-m 'not slow')"
+    )
+    config.addinivalue_line(
+        "markers",
+        "chaos: cross-process fault-injection (kill subprocesses/workers)",
+    )
+
+
 def pytest_sessionfinish(session, exitstatus):
     """Restore the host sysctl we raised (never leave kernel config
     mutated as a test side effect)."""

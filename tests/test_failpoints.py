@@ -118,6 +118,93 @@ class TestRuleMachinery:
         assert not fp.active_rules()
 
 
+class TestCrossProcessSpecs:
+    """Serialization + inheritance machinery for PID-crossing chaos."""
+
+    def test_parse_round_trips_all_documented_sites(self):
+        spec = ";".join(f"{site}=error(x)" for site in fp.SITES)
+        rules = fp.parse(spec)
+        assert [r.site for r in rules] == list(fp.SITES)
+        assert fp.parse(fp.format_rules(rules))[0].site == fp.SITES[0]
+
+    def test_format_rules_round_trip(self):
+        spec = ("sandbox.server.exec=error(boom):count=2;"
+                "dist.step=exit(3);sandbox.boot=delay(0.05):nth=4")
+        first = fp.parse(spec)
+        second = fp.parse(fp.format_rules(first))
+        assert [
+            (r.site, r.action, r.arg, r.nth, r.count) for r in first
+        ] == [
+            (r.site, r.action, r.arg, r.nth, r.count) for r in second
+        ]
+
+    def test_format_rejects_unserializable_args(self):
+        rule = fp.Rule(site="a.b", action="error", arg="has;semicolon")
+        with pytest.raises(ValueError, match="metacharacters"):
+            fp.format_rules([rule])
+
+    def test_exit_action_parses_and_validates(self):
+        (rule,) = fp.parse("dist.step=exit(7):nth=2")
+        assert (rule.action, rule.arg, rule.nth) == ("exit", "7", 2)
+        with pytest.raises(ValueError):
+            fp.configure("a.b", "exit", "not-a-code")
+
+    def test_subprocess_env_inherits_armed_rules(self):
+        with fp.armed("sandbox.server.exec", "error", "chaos", count=1):
+            env = fp.subprocess_env({"PATH": "/bin"})
+            (rule,) = fp.parse(env[fp.ENV_VAR])
+            assert rule.site == "sandbox.server.exec"
+            assert rule.action == "error" and rule.arg == "chaos"
+            assert rule.count == 1
+        # disarmed parent scrubs any stale spec: no pre-armed children
+        env = fp.subprocess_env({fp.ENV_VAR: "a.b=error(stale)"})
+        assert fp.ENV_VAR not in env
+
+
+class TestSiteRegistry:
+    """Tooling satellite: every failpoint("<site>") call site in
+    kafka_tpu/ must appear in the documented SITES registry (and the
+    registry must not advertise sites nothing calls) — new sites cannot
+    ship undocumented."""
+
+    def _wired_sites(self):
+        import pathlib
+        import re
+
+        import kafka_tpu
+
+        root = pathlib.Path(kafka_tpu.__file__).parent
+        wired = set()
+        for path in root.rglob("*.py"):
+            if path.name == "failpoints.py":
+                continue  # the definition module, not a call site
+            for site in re.findall(
+                r'failpoint\(\s*["\']([^"\']+)["\']', path.read_text()
+            ):
+                wired.add(site)
+        return wired
+
+    def test_every_wired_site_is_documented(self):
+        wired = self._wired_sites()
+        undocumented = wired - set(fp.SITES)
+        assert not undocumented, (
+            f"failpoint sites wired but missing from SITES: {undocumented}"
+        )
+
+    def test_every_documented_site_is_wired(self):
+        wired = self._wired_sites()
+        dead = set(fp.SITES) - wired
+        assert not dead, f"SITES documents unwired sites: {dead}"
+
+    def test_readme_documents_every_site(self):
+        import pathlib
+
+        readme = (pathlib.Path(__file__).parent.parent / "README.md"
+                  ).read_text()
+        missing = [s for s in fp.SITES if f"`{s}`" not in readme]
+        assert not missing, f"README missing failpoint sites: {missing}"
+
+
 def run_chaos(eng, n_requests=3, max_new=3, step_cap=500):
     """Drive the engine the way EngineWorker does (step, recover on
     exception) until idle; returns {request_id: finish_reason}."""

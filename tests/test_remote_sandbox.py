@@ -9,6 +9,7 @@ over genuine HTTP, with only the VM *hardware* faked.
 
 import asyncio
 import itertools
+import time
 
 import pytest
 from aiohttp import web
@@ -184,3 +185,299 @@ class TestManagerLifecycle:
             await mgr.aclose()
 
         run_with_plane(fn)
+
+
+# ---------------------------------------------------------------------------
+# Subprocess sandbox crash recovery (ProcessSandboxFactory supervision)
+# ---------------------------------------------------------------------------
+
+
+class TestProcessSandboxLiveness:
+    """Satellite: connect/restart verify subprocess liveness (port probe +
+    exit-code check) before returning a Sandbox; zombie handles are
+    reaped — a crashed subprocess is never handed back as connected."""
+
+    def test_connect_rejects_crashed_subprocess_and_reaps(self):
+        from kafka_tpu.sandbox.process import ProcessSandboxFactory
+
+        async def go():
+            factory = ProcessSandboxFactory(
+                boot_timeout_s=30, supervise=False
+            )
+            try:
+                sbx = await factory.create("t1")
+                sid = sbx.sandbox_id
+                await sbx.aclose()
+                # crash the subprocess behind the factory's back
+                proc = factory._procs[sid]
+                proc.kill()
+                await proc.wait()
+                # connect must NOT hand back the dead sandbox...
+                assert await factory.connect(sid) is None
+                # ...and the zombie handle must be reaped from _procs
+                assert sid not in factory._procs
+            finally:
+                await factory.aclose()
+
+        asyncio.run(go())
+
+    def test_create_fails_fast_when_subprocess_exits_at_boot(self):
+        from kafka_tpu.runtime import failpoints as fp
+        from kafka_tpu.sandbox.process import ProcessSandboxFactory
+        from kafka_tpu.sandbox.types import SandboxError
+
+        async def go():
+            factory = ProcessSandboxFactory(boot_timeout_s=30,
+                                            supervise=False)
+            try:
+                # the inherited exit(3) rule kills the subprocess at its
+                # first in-child exec site... but boot never execs, so
+                # instead crash at boot via a bad spec: sandbox.boot
+                # fires in THIS process during _spawn
+                with fp.armed("sandbox.boot", "error", "no-boot"):
+                    with pytest.raises(fp.FailpointError, match="no-boot"):
+                        await factory.create("t-boot")
+                assert not factory._procs  # nothing leaked
+            finally:
+                await factory.aclose()
+
+        asyncio.run(go())
+
+    def test_crash_loop_detector_unit(self):
+        """Detector logic without real processes: more than max_restarts
+        crashes inside the window blacklists the id."""
+        from kafka_tpu.sandbox.process import ProcessSandboxFactory
+
+        async def go():
+            factory = ProcessSandboxFactory(
+                supervise=False, max_restarts=2, crash_window_s=60.0
+            )
+            sid = "proc-1-deadbeef"
+            assert factory._note_crash(sid) == 1
+            assert factory._note_crash(sid) == 2
+            assert sid not in factory._crash_looping
+            factory._note_crash(sid)  # third crash: > max_restarts
+            assert sid in factory._crash_looping
+            # a blacklisted id is never handed back
+            assert await factory.connect(sid) is None
+            assert await factory.restart(sid) is None
+            # terminate clears the blacklist (operator reset path)
+            await factory.terminate(sid)
+            assert sid not in factory._crash_looping
+
+        asyncio.run(go())
+
+
+class TestProcessSandboxCrashRecovery:
+    def test_inflight_exec_gets_exactly_one_terminal_error(self):
+        """Kill the sandbox subprocess mid-tool: the in-flight exec's
+        stream must end with exactly one terminal error event (never
+        hang, never double-terminate), and the exit watcher must
+        auto-restart the sandbox."""
+        from kafka_tpu.sandbox.process import (
+            ProcessSandboxFactory,
+            supervisor_snapshot,
+        )
+
+        async def go():
+            factory = ProcessSandboxFactory(
+                boot_timeout_s=30, restart_backoff_s=0.05, max_restarts=5
+            )
+            before = supervisor_snapshot()
+            try:
+                sbx = await factory.create("t-crash")
+                sid = sbx.sandbox_id
+
+                async def run_long():
+                    evs = []
+                    async for ev in sbx.run_tool(
+                        "shell_exec",
+                        {"command": "sleep 30", "timeout": 60},
+                    ):
+                        evs.append(ev)
+                    return evs
+
+                task = asyncio.create_task(run_long())
+                await asyncio.sleep(0.5)  # let the exec reach the shell
+                factory._procs[sid].kill()
+                evs = await asyncio.wait_for(task, timeout=15)
+                terminals = [e for e in evs if e.terminal]
+                assert len(terminals) == 1, evs
+                assert terminals[0].kind == "error"
+                # exit watcher: reaped + auto-restarted with backoff
+                deadline = time.monotonic() + 15
+                while time.monotonic() < deadline:
+                    after = supervisor_snapshot()
+                    if (after["restarts"] > before["restarts"]
+                            and (await sbx.check_health()).get("healthy")):
+                        break
+                    await asyncio.sleep(0.1)
+                after = supervisor_snapshot()
+                assert after["crashes"] > before["crashes"]
+                assert after["restarts"] > before["restarts"]
+                assert after["reaped"] > before["reaped"]
+                assert (await sbx.check_health()).get("healthy")
+                await sbx.aclose()
+            finally:
+                await factory.aclose()
+
+        asyncio.run(go())
+
+    def test_failpoint_env_inheritance_fires_in_subprocess(self):
+        """Satellite: an armed KAFKA_TPU_FAILPOINTS spec propagates into
+        the sandbox subprocess and fires at sandbox.server.exec — the
+        in-child chaos site — degrading to a terminal error ToolEvent."""
+        from kafka_tpu.runtime import failpoints as fp
+        from kafka_tpu.sandbox.process import ProcessSandboxFactory
+
+        async def go():
+            factory = ProcessSandboxFactory(boot_timeout_s=30,
+                                            supervise=False)
+            try:
+                with fp.armed("sandbox.server.exec", "error",
+                              "inherited-chaos"):
+                    sbx = await factory.create("t-inherit")
+                    evs = [
+                        ev async for ev in sbx.run_tool(
+                            "shell_exec", {"command": "echo hi"}
+                        )
+                    ]
+                    assert len(evs) == 1, evs
+                    assert evs[0].kind == "error" and evs[0].terminal
+                    assert "inherited-chaos" in str(evs[0].data)
+                    await sbx.aclose()
+                    await factory.terminate(sbx.sandbox_id)
+                # with nothing armed, children spawn clean and exec works
+                sbx = await factory.create("t-clean")
+                evs = [
+                    ev async for ev in sbx.run_tool(
+                        "shell_exec", {"command": "echo hi"}
+                    )
+                ]
+                assert any(e.kind == "result" for e in evs), evs
+                await sbx.aclose()
+            finally:
+                await factory.aclose()
+
+        asyncio.run(go())
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+class TestProcessSandboxChaosMatrix:
+    def test_exit_failpoint_crashes_subprocess_mid_exec(self):
+        """The `exit` action inherited into the subprocess kills it
+        mid-tool: one terminal error on the stream, watcher restarts,
+        and the restarted sandbox serves again."""
+        from kafka_tpu.runtime import failpoints as fp
+        from kafka_tpu.sandbox.process import ProcessSandboxFactory
+
+        async def go():
+            factory = ProcessSandboxFactory(
+                boot_timeout_s=30, restart_backoff_s=0.05, max_restarts=5
+            )
+            try:
+                with fp.armed("sandbox.server.exec", "exit", "7"):
+                    sbx = await factory.create("t-exit")
+                # rule disarmed in the parent now; the CHILD armed its
+                # inherited copy at boot and dies on first exec
+                evs = [
+                    ev async for ev in sbx.run_tool(
+                        "shell_exec", {"command": "echo hi"}
+                    )
+                ]
+                terminals = [e for e in evs if e.terminal]
+                assert len(terminals) == 1 and terminals[0].kind == "error"
+                # watcher respawns it WITHOUT the failpoint env (parent
+                # disarmed): the restarted sandbox must serve normally
+                deadline = time.monotonic() + 15
+                ok = False
+                while time.monotonic() < deadline and not ok:
+                    if (await sbx.check_health()).get("healthy"):
+                        ok = True
+                        break
+                    await asyncio.sleep(0.1)
+                assert ok, "watcher did not restart the crashed sandbox"
+                evs = [
+                    ev async for ev in sbx.run_tool(
+                        "shell_exec", {"command": "echo back"}
+                    )
+                ]
+                assert any(e.kind == "result" for e in evs), evs
+                await sbx.aclose()
+            finally:
+                await factory.aclose()
+
+        asyncio.run(go())
+
+    def test_crash_loop_trips_with_real_kills(self):
+        from kafka_tpu.sandbox.process import (
+            ProcessSandboxFactory,
+            supervisor_snapshot,
+        )
+
+        async def go():
+            factory = ProcessSandboxFactory(
+                boot_timeout_s=30, restart_backoff_s=0.05, max_restarts=2,
+                crash_window_s=60.0,
+            )
+            before = supervisor_snapshot()
+            try:
+                sbx = await factory.create("t-loop")
+                sid = sbx.sandbox_id
+                # kill every generation the watcher brings back
+                deadline = time.monotonic() + 30
+                while (sid not in factory._crash_looping
+                       and time.monotonic() < deadline):
+                    proc = factory._procs.get(sid)
+                    if proc is not None and proc.returncode is None:
+                        proc.kill()
+                    await asyncio.sleep(0.1)
+                assert sid in factory._crash_looping
+                after = supervisor_snapshot()
+                assert after["crash_loops"] > before["crash_loops"]
+                assert after["crashes"] - before["crashes"] >= 3
+                # a crash-looping sandbox is gone from the factory's view
+                assert await factory.connect(sid) is None
+                await sbx.aclose()
+            finally:
+                await factory.aclose()
+
+        asyncio.run(go())
+
+
+class TestManagerCrashEviction:
+    def test_ready_cache_evicts_on_subprocess_crash(self, tmp_path):
+        """SandboxManager registers as crash listener: a dead subprocess
+        is evicted from the ready cache immediately, and ensure_sandbox
+        recovers through the factory's restart path."""
+        from kafka_tpu.sandbox.process import ProcessSandboxFactory
+
+        async def go():
+            db = LocalDBClient(str(tmp_path / "crash.db"))
+            await db.initialize()
+            await db.create_thread("th-c")
+            factory = ProcessSandboxFactory(
+                boot_timeout_s=30, restart_backoff_s=0.05, max_restarts=5
+            )
+            mgr = SandboxManager(db, factory)
+            try:
+                sbx = await mgr.ensure_sandbox("th-c")
+                sid = sbx.sandbox_id
+                assert mgr._ready.get("th-c") is sbx
+                factory._procs[sid].kill()
+                # the exit watcher must evict the ready-cache entry
+                deadline = time.monotonic() + 10
+                while (mgr._ready.get("th-c") is not None
+                       and time.monotonic() < deadline):
+                    await asyncio.sleep(0.05)
+                assert mgr._ready.get("th-c") is None
+                # recovery: same sandbox id comes back healthy
+                sbx2 = await mgr.ensure_sandbox("th-c")
+                assert sbx2.sandbox_id == sid
+                assert (await sbx2.check_health()).get("healthy")
+            finally:
+                await mgr.aclose()
+                await db.close()
+
+        asyncio.run(go())

@@ -568,3 +568,94 @@ class TestLifecycleHTTP:
             assert app[STATE_KEY]["draining"] is True
 
         asyncio.run(go())
+
+
+class TestResizeEndpoint:
+    ADMIN = {"Authorization": "Bearer admin-secret"}
+
+    def _resizable_llm(self, calls):
+        llm = FakeLLM([])
+
+        # fake resizable provider: has resize_dp + engine.rebuild
+        class FakeEngine:
+            def rebuild(self, dp):
+                pass
+
+        async def resize_dp(dp, drain_timeout_s=30.0):
+            calls.append((dp, drain_timeout_s))
+            return True
+
+        llm.engine = FakeEngine()
+        llm.resize_dp = resize_dp
+        return llm
+
+    def test_resize_refused_without_api_token(self, tmp_path):
+        """The open-if-no-token dev default does not extend to the
+        operator-destructive admin surface."""
+        built, _, _ = make_client(tmp_path, [])
+
+        async def go():
+            client = await built
+            try:
+                r = await client.post("/admin/resize", json={"dp": 2})
+                assert r.status == 403
+                assert "API_TOKEN" in (await r.json())["error"]
+            finally:
+                await client.close()
+
+        asyncio.run(go())
+
+    def test_resize_without_dp_topology_is_501(self, tmp_path):
+        db = LocalDBClient(str(tmp_path / "r0.db"))
+
+        async def go():
+            app = await create_app(
+                cfg=ServingConfig(db_path=str(tmp_path / "r0.db"),
+                                  api_token="admin-secret"),
+                llm_provider=FakeLLM([]), db=db, tools=[],
+            )
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                r = await client.post("/admin/resize", json={"dp": 2},
+                                      headers=self.ADMIN)
+                assert r.status == 501
+                assert "topology" in (await r.json())["error"]
+            finally:
+                await client.close()
+
+        asyncio.run(go())
+
+    def test_resize_validates_body_and_runs(self, tmp_path):
+        calls = []
+        llm = self._resizable_llm(calls)
+        db = LocalDBClient(str(tmp_path / "r.db"))
+
+        async def go():
+            app = await create_app(
+                cfg=ServingConfig(db_path=str(tmp_path / "r.db"),
+                                  api_token="admin-secret"),
+                llm_provider=llm, db=db, tools=[],
+            )
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                # the static-token middleware still gates the route
+                r = await client.post("/admin/resize", json={"dp": 2})
+                assert r.status == 401
+                r = await client.post("/admin/resize", json={"dp": 0},
+                                      headers=self.ADMIN)
+                assert r.status == 400
+                r = await client.post("/admin/resize", json={},
+                                      headers=self.ADMIN)
+                assert r.status == 400
+                r = await client.post("/admin/resize",
+                                      json={"dp": 2, "drain_timeout_s": 1},
+                                      headers=self.ADMIN)
+                assert r.status == 200
+                assert (await r.json()) == {"dp": 2, "clean": True}
+                assert calls == [(2, 1.0)]
+            finally:
+                await client.close()
+
+        asyncio.run(go())
