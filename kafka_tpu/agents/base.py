@@ -35,8 +35,9 @@ import asyncio
 import logging
 from typing import Any, AsyncIterator, Dict, List, Optional, Sequence
 
+from .. import tracing
 from ..core.toolcalls import ToolCallAccumulator, parse_tool_arguments
-from ..core.types import Message, new_completion_id
+from ..core.types import Message, Usage, new_completion_id
 from ..llm.base import LLMProvider, to_message_dicts
 from ..llm.compaction import ContextCompactionProvider, is_context_length_error
 from ..tools.base import ToolProvider
@@ -156,6 +157,10 @@ class Agent:
         compaction_attempted = False
         run_id = new_completion_id()
         final_content: List[str] = []
+        # Real usage accounting across the WHOLE agent run (the reference
+        # returned zeroed usage on the agent path, SURVEY §5.1): per-turn
+        # usage frames sum here and ride out on agent_done.
+        run_usage = Usage()
 
         iteration = 0
         while iteration < self.max_iterations:
@@ -174,22 +179,31 @@ class Agent:
                 if mask_fn is not None:
                     iter_kwargs["logits_mask_fn"] = mask_fn
             try:
-                stream = self.llm.stream_completion(
-                    working,
-                    model=model,
-                    temperature=temperature,
-                    max_tokens=max_tokens,
-                    tools=iter_tools if iter_tools else None,
-                    **iter_kwargs,
-                )
-                async for chunk in stream:
-                    streamed_any = streamed_any or bool(
-                        chunk.content or chunk.tool_calls
+                with tracing.span("agent.turn",
+                                  attrs={"iteration": iteration}):
+                    stream = self.llm.stream_completion(
+                        working,
+                        model=model,
+                        temperature=temperature,
+                        max_tokens=max_tokens,
+                        tools=iter_tools if iter_tools else None,
+                        **iter_kwargs,
                     )
-                    if chunk.content:
-                        content_parts.append(chunk.content)
-                    acc.add_deltas(chunk.tool_calls)
-                    yield chunk.to_openai_dict()
+                    async for chunk in stream:
+                        streamed_any = streamed_any or bool(
+                            chunk.content or chunk.tool_calls
+                        )
+                        if chunk.content:
+                            content_parts.append(chunk.content)
+                        if chunk.usage:
+                            run_usage.prompt_tokens += chunk.usage.get(
+                                "prompt_tokens", 0)
+                            run_usage.completion_tokens += chunk.usage.get(
+                                "completion_tokens", 0)
+                            run_usage.total_tokens += chunk.usage.get(
+                                "total_tokens", 0)
+                        acc.add_deltas(chunk.tool_calls)
+                        yield chunk.to_openai_dict()
             except Exception as e:
                 if (
                     is_context_length_error(e)
@@ -200,9 +214,12 @@ class Agent:
                     compaction_attempted = True
                     logger.info("context overflow on iteration %d; compacting",
                                 iteration)
-                    working = await self.compaction.compact(
-                        working, model, fit=self._compaction_fit(tool_defs)
-                    )
+                    with tracing.span("compaction",
+                                      attrs={"iteration": iteration}):
+                        working = await self.compaction.compact(
+                            working, model,
+                            fit=self._compaction_fit(tool_defs),
+                        )
                     iteration -= 1  # retry doesn't consume an iteration
                     continue
                 raise
@@ -228,6 +245,7 @@ class Agent:
                     "type": "agent_done",
                     "reason": "text_response",
                     "final_content": content,
+                    "usage": run_usage.to_dict(),
                 }
                 return
 
@@ -277,6 +295,7 @@ class Agent:
                     "reason": "idle",
                     "final_content": summary or content
                     or " ".join(final_content),
+                    "usage": run_usage.to_dict(),
                 }
                 return
 
@@ -284,6 +303,7 @@ class Agent:
             "type": "agent_done",
             "reason": "max_iterations",
             "final_content": " ".join(final_content),
+            "usage": run_usage.to_dict(),
         }
 
     # ------------------------------------------------------------------

@@ -42,6 +42,7 @@ from ..core.types import (
 from ..models.config import ModelConfig
 from ..models.tokenizer import BaseTokenizer, parse_tool_call_text
 from ..runtime.engine import GenRequest, InferenceEngine, TokenEvent
+from ..runtime.tracing import current as current_trace
 from .base import LLMProvider, MessageLike, to_message_dicts
 from .utils import count_images
 from .worker import EngineWorker
@@ -297,7 +298,15 @@ class TPULLMProvider(LLMProvider):
         if validate is not None:
             validate(dp)
         async with self._resize_lock:
-            return await self._resize_locked(rebuild, dp, drain_timeout_s)
+            try:
+                return await self._resize_locked(
+                    rebuild, dp, drain_timeout_s
+                )
+            finally:
+                # a cancelled resize (client timeout mid-drain) must never
+                # leave the worker parked — resume() is idempotent, and a
+                # permanently paused worker is a total serving outage
+                self.worker.resume()
 
     async def _resize_locked(self, rebuild, dp: int,
                              drain_timeout_s: float) -> bool:
@@ -456,6 +465,10 @@ class TPULLMProvider(LLMProvider):
             prefix_key=prefix_key,
             override_pos=override_pos,
             override_rows=override_rows,
+            # carry the ambient trace context across the thread boundary:
+            # the engine thread records queue/prefill/decode/emit spans
+            # against it (None = untraced, one branch per span site)
+            trace=current_trace(),
         )
         loop = asyncio.get_running_loop()
         events = self.worker.submit(req, loop)

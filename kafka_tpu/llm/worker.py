@@ -32,6 +32,7 @@ from typing import Dict, Optional, Tuple
 
 from ..runtime.engine import AdmissionError, GenRequest, InferenceEngine, TokenEvent
 from ..runtime.failpoints import failpoint
+from ..runtime.tracing import add_event
 
 logger = logging.getLogger("kafka_tpu.llm.worker")
 
@@ -262,6 +263,13 @@ class EngineWorker:
         """Device-step failure: every in-flight request gets a terminal event."""
         events = []
         for rid in list(self.engine._requests):
+            req = self.engine._requests.get(rid)
+            if req is not None:
+                # recovery itself died: the trace still records why the
+                # request ended (engine.recover_from_failure never ran
+                # for these, so this is not a duplicate)
+                add_event(req.trace, "engine.recover",
+                          {"reason": "error:engine", "fail_all": True})
             # reason matches the event below so metrics count these as
             # engine failures (requests.failed), not client cancels
             self.engine.cancel(rid, reason="error:engine")

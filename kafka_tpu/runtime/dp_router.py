@@ -56,6 +56,7 @@ from ..models.config import ModelConfig
 from ..parallel import MeshConfig, make_mesh, resolve_tensor_axes
 from .engine import EngineConfig, GenRequest, InferenceEngine, TokenEvent
 from .metrics import ReplicaSupervisorMetrics
+from .tracing import add_event
 
 logger = logging.getLogger("kafka_tpu.dp")
 
@@ -153,12 +154,13 @@ class DataParallelEngines:
             )
             mesh = make_mesh(MeshConfig(sp=sp, tp=tpk, tq=tq, ep=ep),
                              devices=slice_devices)
-            engines.append(
-                InferenceEngine(
-                    cfg, self._params, engine_cfg,
-                    kv_dtype=self._kv_dtype, mesh=mesh,
-                )
+            engine = InferenceEngine(
+                cfg, self._params, engine_cfg,
+                kv_dtype=self._kv_dtype, mesh=mesh,
             )
+            # traced requests' engine spans carry the replica they ran on
+            engine.replica = r
+            engines.append(engine)
         self.dp = dp
         self.engines = engines
         self.health = [ReplicaHealth() for _ in range(dp)]
@@ -233,6 +235,11 @@ class DataParallelEngines:
             h.quarantined_until = time.monotonic() + window
             h.consecutive_failures = 0
             self.supervisor.quarantines += 1
+            # a quarantine mid-request punctuates every affected trace's
+            # timeline (traced requests only; add_event no-ops otherwise)
+            for req in list(self.engines[i]._requests.values()):
+                add_event(req.trace, "quarantine",
+                          {"replica": i, "window_s": round(window, 2)})
             logger.error(
                 "replica %d quarantined for %.1fs after %d failure(s) "
                 "(trip #%d)", i, window, threshold, h.quarantine_count,
@@ -273,6 +280,8 @@ class DataParallelEngines:
             ))
             self.engines[j].adopt(req)
             self._route[req.request_id] = j
+            add_event(req.trace, "migrate",
+                      {"from_replica": i, "to_replica": j})
             if req.prefix_key is not None:
                 if self._affinity.get(req.prefix_key) == i:
                     self.supervisor.affinity_resteered += 1

@@ -50,6 +50,7 @@ runs it on a dispatch thread and streams tokens out per-request.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import itertools
 import logging
@@ -74,6 +75,7 @@ from .kv_cache import (
 )
 from .metrics import EngineMetrics
 from .prefix_cache import PrefixCache
+from .tracing import add_event, profiler_annotations_enabled, record_span
 
 logger = logging.getLogger("kafka_tpu.engine")
 
@@ -253,6 +255,12 @@ class GenRequest:
     # None for resumed parked lanes — their pending token is host-known
     # (output_ids[-1]).
     pending_tok: Optional[Any] = None
+    # Request tracing (runtime/tracing.py): the trace context this request
+    # carries — None = untraced, and every engine span site is then ONE
+    # branch.  trace_last_t stamps the previous decode dispatch so
+    # engine.decode spans tile the request's timeline at burst granularity.
+    trace: Optional[Any] = None
+    trace_last_t: Optional[float] = None
     # Vision soft-prompt (models/vision.py): projected image-patch rows
     # replacing the prompt's image_token_id placeholders at prefill.
     # override_pos are ABSOLUTE prompt positions, so chunked prefill,
@@ -550,6 +558,9 @@ class InferenceEngine:
             else None
         )
         self.metrics = EngineMetrics()
+        # DP replica index (set by runtime/dp_router.py): traced requests'
+        # engine spans carry it so a timeline names the replica it ran on
+        self.replica: Optional[int] = None
         self._rtt_est = self._measure_rtt()
 
     def _measure_rtt(self) -> float:
@@ -653,6 +664,28 @@ class InferenceEngine:
             and merged_q * merged_kv * 2 <= 7 * 1024 * 1024
         )
         return "pallas" if ok else "xla"
+
+    def _tattrs(self, **kw) -> Dict[str, Any]:
+        """Span attrs for this engine's traced requests (replica-stamped
+        on DP replicas).  Called only for traced requests — cold path."""
+        if self.replica is not None:
+            kw["replica"] = self.replica
+        return kw
+
+    def _dispatch_scope(self, members: Sequence[Optional["GenRequest"]]):
+        """jax.profiler named scope keyed by the dispatched trace ids, so
+        a /debug/profile xplane capture correlates device slices with
+        server-side spans.  One module-global bool read when disabled
+        (KAFKA_TPU_PROFILING unset)."""
+        if not profiler_annotations_enabled():
+            return contextlib.nullcontext()
+        ids = sorted({
+            m.trace.trace_id[:8] for m in members
+            if m is not None and m.trace is not None
+        })
+        return jax.profiler.TraceAnnotation(
+            "kafka.decode[" + ",".join(ids) + "]"
+        )
 
     def _dev(self, x) -> jnp.ndarray:
         """Host -> device, replicated across the mesh when one is active.
@@ -1215,6 +1248,8 @@ class InferenceEngine:
             req.state = FINISHED
             req.finish_reason = "error:engine"
             self.metrics.record_finish("error:engine")
+            add_event(req.trace, "engine.recover",
+                      {"reason": "error:engine", **self._tattrs()})
             self._release_slot(req)
             self._requests.pop(req.request_id, None)
             events.append(
@@ -1410,6 +1445,19 @@ class InferenceEngine:
                 req.submit_time, req.t_prefill_start,
                 req.t_first_dispatch, req.first_token_time,
             )
+            if req.trace is not None and req.t_first_dispatch is not None:
+                # fetch+emit runway: first device dispatch -> first token
+                # on the host (the tunnel-conditioned slice of TTFT)
+                record_span(
+                    req.trace, "emit",
+                    req.first_token_time - req.t_first_dispatch,
+                    attrs=self._tattrs(
+                        ttft_ms=round(
+                            (req.first_token_time - req.submit_time) * 1e3,
+                            2,
+                        )
+                    ),
+                )
         self.metrics.record_token()
         if token in req.stop_token_ids:
             reason = "stop"
@@ -1619,6 +1667,14 @@ class InferenceEngine:
         """
         if req.t_prefill_start is None:  # keep the FIRST start on resume
             req.t_prefill_start = time.monotonic()
+            # queue wait ends here (untraced requests: record_span is one
+            # branch; _tattrs only built for traced ones)
+            if req.trace is not None:
+                record_span(
+                    req.trace, "engine.queue",
+                    req.t_prefill_start - req.submit_time,
+                    attrs=self._tattrs(depth=len(self.waiting)),
+                )
         req.seq = req.seq or SequencePages(seq_id=req.request_id)
         self.pool.ensure_capacity(req.seq, len(req.prefill_ids) + 1)
         # constrained decoding: the mask depends only on output_ids, which
@@ -1753,6 +1809,19 @@ class InferenceEngine:
             if req.seq.length < len(req.prefill_ids):
                 continue  # more chunks to go
             req.prefill_allowed = None
+            if req.t_first_dispatch is None:
+                # stamp the fused path too: the TTFT breakdown and the
+                # engine.prefill span must not depend on which prefill
+                # program (single vs batched) served the request
+                req.t_first_dispatch = time.monotonic()
+                if req.trace is not None:
+                    record_span(
+                        req.trace, "engine.prefill",
+                        req.t_first_dispatch - (req.t_prefill_start
+                                                or req.t_first_dispatch),
+                        attrs=self._tattrs(tokens=len(req.prefill_ids),
+                                           fused=True),
+                    )
             if req.slot < 0:
                 # off-slot lane: park until a decode slot frees (_admit);
                 # its first token still ships through the fetch below
@@ -1866,6 +1935,13 @@ class InferenceEngine:
         req.prefill_allowed = None
         if req.t_first_dispatch is None:
             req.t_first_dispatch = time.monotonic()
+            if req.trace is not None:
+                record_span(
+                    req.trace, "engine.prefill",
+                    req.t_first_dispatch - (req.t_prefill_start
+                                            or req.t_first_dispatch),
+                    attrs=self._tattrs(tokens=len(req.prefill_ids)),
+                )
         if slot < 0:
             req.state = PARKED
             if req.resumed:
@@ -2181,12 +2257,13 @@ class InferenceEngine:
         if self._ctl_dirty:
             self._refresh_ctl()
         fn = self._get_multi_decode_fn(k)
-        (self.k_pool, self.v_pool, toks_seq, last, lens) = fn(
-            self.params, self.k_pool, self.v_pool,
-            self._d_table, self._d_last, self._d_seq_lens,
-            self._d_active, self._d_temps, self._d_top_ks,
-            self._d_top_ps, self._d_seeds,
-        )
+        with self._dispatch_scope(self.slots):
+            (self.k_pool, self.v_pool, toks_seq, last, lens) = fn(
+                self.params, self.k_pool, self.v_pool,
+                self._d_table, self._d_last, self._d_seq_lens,
+                self._d_active, self._d_temps, self._d_top_ks,
+                self._d_top_ps, self._d_seeds,
+            )
         self._d_last = last
         self._d_seq_lens = lens
         entry = self._book_dispatch(toks_seq, list(self.slots), steps=k)
@@ -2220,23 +2297,26 @@ class InferenceEngine:
         tokens, [B] bool on-mask): grammar-forced lanes whose sampled token
         is overridden device-side (no [B, V] mask upload).
         """
-        if forced is None:
-            self.k_pool, self.v_pool, toks, self._d_seq_lens = self._decode_fn(
-                self.params, self.k_pool, self.v_pool,
-                self._d_table, self._d_last, self._d_seq_lens,
-                d_active, self._d_temps, self._d_top_ks,
-                self._d_top_ps, self._d_seeds,
-                None if allowed is None else self._arg(allowed),
-            )
-        else:
-            self.k_pool, self.v_pool, toks, self._d_seq_lens = self._decode_fn(
-                self.params, self.k_pool, self.v_pool,
-                self._d_table, self._d_last, self._d_seq_lens,
-                d_active, self._d_temps, self._d_top_ks,
-                self._d_top_ps, self._d_seeds,
-                None if allowed is None else self._arg(allowed),
-                self._arg(forced[0]), self._arg(forced[1]),
-            )
+        with self._dispatch_scope(members):
+            if forced is None:
+                self.k_pool, self.v_pool, toks, self._d_seq_lens = \
+                    self._decode_fn(
+                        self.params, self.k_pool, self.v_pool,
+                        self._d_table, self._d_last, self._d_seq_lens,
+                        d_active, self._d_temps, self._d_top_ks,
+                        self._d_top_ps, self._d_seeds,
+                        None if allowed is None else self._arg(allowed),
+                    )
+            else:
+                self.k_pool, self.v_pool, toks, self._d_seq_lens = \
+                    self._decode_fn(
+                        self.params, self.k_pool, self.v_pool,
+                        self._d_table, self._d_last, self._d_seq_lens,
+                        d_active, self._d_temps, self._d_top_ks,
+                        self._d_top_ps, self._d_seeds,
+                        None if allowed is None else self._arg(allowed),
+                        self._arg(forced[0]), self._arg(forced[1]),
+                    )
         self._d_last = toks if full else jnp.where(d_active, toks, self._d_last)
         return self._book_dispatch(toks, members, steps=1)
 
@@ -2255,6 +2335,10 @@ class InferenceEngine:
         """
         toks.copy_to_host_async()
         self._step_count += steps
+        # decode-span inputs, computed lazily on the FIRST traced member:
+        # an all-untraced dispatch pays one branch per lane, nothing else
+        now_mono: Optional[float] = None
+        busy = 0
         items: List[Optional[GenRequest]] = []
         last_final: List[Optional[str]] = []
         for req in members:
@@ -2264,6 +2348,20 @@ class InferenceEngine:
                 continue
             req.seq.length += steps  # the dispatched tokens' kv slots
             req.dispatched += steps
+            if req.trace is not None:
+                # burst-granularity decode span: the window since this
+                # lane's previous dispatch, annotated with the fused-step
+                # count and batch occupancy
+                if now_mono is None:
+                    now_mono = time.monotonic()
+                    busy = sum(1 for m in members if m is not None)
+                prev = (req.trace_last_t or req.t_first_dispatch
+                        or now_mono)
+                record_span(
+                    req.trace, "engine.decode", now_mono - prev,
+                    attrs=self._tattrs(steps=steps, busy=busy),
+                )
+                req.trace_last_t = now_mono
             items.append(req)
             last_final.append(self._limit_reason_after_dispatch(req))
         finals = [[None] * len(items) for _ in range(steps - 1)] + [last_final]
@@ -2433,6 +2531,9 @@ class InferenceEngine:
     def _preempt(self, victim: GenRequest) -> None:
         logger.warning("preempting %s (out of KV pages)", victim.request_id)
         self.metrics.record_preempt()
+        add_event(victim.trace, "preempt",
+                  {"generated": len(victim.output_ids),
+                   **self._tattrs()})
         # Preemption needs complete outputs (prefill_ids below); the caller
         # (_ensure_pages) has already drained the pipeline.
         assert not self._pending, "preempt with in-flight fetches"

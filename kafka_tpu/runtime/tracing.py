@@ -1,0 +1,37 @@
+"""Runtime-facing re-export of the tracing subsystem.
+
+Mirrors runtime/failpoints.py: the engine/scheduler tier imports tracing
+through runtime/, while the canonical import-light module lives at
+kafka_tpu.tracing so the sandbox subprocess (which must not import JAX)
+can use the same code.
+"""
+
+from ..tracing import (  # noqa: F401
+    EVENTS,
+    SPANS,
+    ChildSpans,
+    Span,
+    Trace,
+    TraceContext,
+    add_event,
+    child_collector,
+    chrome_trace,
+    configure,
+    counters,
+    current,
+    finish_trace,
+    get_trace,
+    load_env,
+    profiler_annotations_enabled,
+    recent_traces,
+    record_span,
+    reset,
+    sample_rate,
+    slow_count,
+    span,
+    span_breakdown,
+    start_trace,
+    stitch,
+    subprocess_env,
+    wire_context,
+)

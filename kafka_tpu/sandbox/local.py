@@ -15,6 +15,7 @@ from typing import Any, AsyncIterator, Dict, Optional
 
 import httpx
 
+from .. import tracing
 from ..failpoints import failpoint
 from ..tools.types import ToolEvent
 from .base import Sandbox
@@ -70,6 +71,10 @@ class LocalSandbox(Sandbox):
             "tool": name,
             "arguments": arguments,
             "tool_call_id": tool_call_id,
+            # cross-process trace propagation: the sandbox records its own
+            # child spans under this context and ships them back as a
+            # {"kind": "spans"} frame, stitched below by trace id
+            "trace": tracing.wire_context(),
         }
         timeout = timeout or DEFAULT_TOOL_TIMEOUT_S
         terminal_seen = False
@@ -100,18 +105,38 @@ class LocalSandbox(Sandbox):
                     while b"\n\n" in buf:
                         frame, buf = buf.split(b"\n\n", 1)
                         ev = self._parse_frame(frame, name, tool_call_id)
+                        if ev is not None and ev.kind == "spans":
+                            # spans recorded inside the sandbox subprocess
+                            # (they trail the terminal result): stitch into
+                            # the parent trace, never surface to the agent
+                            if isinstance(ev.data, dict):
+                                tracing.stitch(ev.data)
+                            continue
+                        if terminal_seen:
+                            # post-terminal tail: only the spans frame above
+                            # and [DONE] are expected — [DONE] ends the
+                            # stream, anything else is dropped (a sandbox
+                            # must not stream past its result)
+                            if ev is None and b"[DONE]" in frame:
+                                return
+                            continue
                         if ev is None:
                             continue
                         if ev.terminal:
                             terminal_seen = True
                         yield ev
-                        if terminal_seen:
-                            return
         except Exception as e:
             # httpx transport errors, malformed URLs (e.g. a sandbox whose
             # port is gone — httpx.InvalidURL subclasses Exception, not
             # HTTPError), and raw socket errors all mean the same thing to
-            # the agent: this sandbox is unreachable.
+            # the agent: this sandbox is unreachable.  UNLESS the terminal
+            # event already went out — then the failure happened during the
+            # post-terminal tail (spans frame / [DONE]) and surfacing it
+            # would emit a SECOND terminal event for the same call.
+            if terminal_seen:
+                logger.debug("sandbox stream died after the terminal "
+                             "event: %s", e)
+                return
             yield ToolEvent(
                 "error", f"sandbox connection failed: {e}",
                 tool_name=name, tool_call_id=tool_call_id,

@@ -43,6 +43,7 @@ import uuid
 from typing import Callable, Dict, List, Optional, Set
 
 from .. import failpoints as fp
+from .. import tracing
 from ..failpoints import failpoint
 from .base import Sandbox
 from .local import LocalSandbox
@@ -129,8 +130,9 @@ class ProcessSandboxFactory(SandboxFactory):
             "--port", str(port), "--sandbox-id", sandbox_id,
             stdout=asyncio.subprocess.DEVNULL,
             stderr=asyncio.subprocess.DEVNULL,
-            # armed failpoint specs propagate: chaos crosses the PID line
-            env=fp.subprocess_env(),
+            # armed failpoint specs AND the tracing/log config propagate:
+            # chaos and observability both cross the PID line
+            env=tracing.subprocess_env(fp.subprocess_env()),
         )
         self._procs[sandbox_id] = proc
         if self.supervise:

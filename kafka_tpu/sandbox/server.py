@@ -28,6 +28,7 @@ import contextlib
 import io
 import json
 import logging
+import os
 import uuid
 from typing import Any, AsyncIterator, Dict, Optional
 
@@ -37,7 +38,11 @@ from aiohttp import web
 # the environment (load_env at module bottom) — this is how a spec armed
 # in the parent reaches the sandbox subprocess (process.py spawns with
 # failpoints.subprocess_env()).  kafka_tpu.failpoints is import-light by
-# design: no JAX, nothing heavy enters the sandbox process.
+# design: no JAX, nothing heavy enters the sandbox process.  The tracing
+# module is import-light for the same reason: /run payloads carry the
+# parent's trace context, the spans recorded HERE (the child side of the
+# PID boundary) ship back as a trailing {"kind": "spans"} SSE frame.
+from .. import tracing
 from ..failpoints import failpoint
 
 logger = logging.getLogger("kafka_tpu.sandbox.server")
@@ -351,59 +356,82 @@ async def run_tool(request: web.Request) -> web.StreamResponse:
             + b"\n\n"
         )
 
+    # child-side span collection: present iff the parent traced this
+    # request (the /run payload carries its context).  Spans recorded here
+    # live in THIS process; they ship back after the terminal event.
+    collector = tracing.child_collector(body.get("trace"))
+    span_cm = (
+        collector.span(
+            "sandbox.exec",
+            attrs={"tool": name, "pid": os.getpid(),
+                   "sandbox_id": s["sandbox_id"]},
+        )
+        if collector is not None else contextlib.nullcontext()
+    )
     try:
         # chaos seam INSIDE the sandbox process: `error` degrades to a
         # terminal error event on the stream; `exit` simulates the whole
         # subprocess crashing mid-tool (the client sees the stream die and
         # must surface exactly one terminal error — sandbox/local.py)
-        failpoint("sandbox.server.exec")
-        if name == "create_shell":
-            shell_id = args.get("shell_id") or f"shell-{len(s['shells'])}"
-            if shell_id not in s["shells"]:
-                session = ShellSession(shell_id)
-                await session.start()
-                s["shells"][shell_id] = session
-            await send({"kind": "result",
-                        "data": json.dumps({"shell_id": shell_id})})
-        elif name == "shell_exec":
-            shell_id = args.get("shell_id") or "default"
-            if shell_id not in s["shells"]:
-                session = ShellSession(shell_id)
-                await session.start()
-                s["shells"][shell_id] = session
-            timeout = float(args.get("timeout", 30.0))
-            async for ev in s["shells"][shell_id].exec(
-                args.get("command", ""), timeout=timeout
-            ):
-                await send(ev)
-        elif name == "notebook_run_cell":
-            kernel_id = args.get("kernel_id") or "default"
-            kernel = s["kernels"].setdefault(
-                kernel_id, NotebookKernel(kernel_id)
-            )
-            timeout = float(args.get("timeout", 300.0))
-            try:
-                out = await asyncio.wait_for(
-                    asyncio.to_thread(kernel.run_cell, args.get("code", "")),
-                    timeout=timeout,
-                )
-                await send({"kind": "result", "data": out})
-            except asyncio.TimeoutError:
-                await send({"kind": "error",
-                            "data": f"cell timed out after {timeout:.0f}s"})
-            except Exception as e:
-                await send({"kind": "error",
-                            "data": f"{type(e).__name__}: {e}"})
-        else:
-            await send({"kind": "error", "data": f"unknown sandbox tool: {name}"})
+        with span_cm:
+            await _run_named_tool(s, name, args, send)
     except Exception as e:
         logger.exception("sandbox tool failed")
         with contextlib.suppress(Exception):
             await send({"kind": "error", "data": f"{type(e).__name__}: {e}"})
+    if collector is not None and collector.spans:
+        # trailing frame, before [DONE]: the parent's LocalSandbox stitches
+        # these into its trace by trace id and drops them from tool output
+        with contextlib.suppress(Exception):
+            await send({"kind": "spans", "data": collector.export()})
     with contextlib.suppress(Exception):
         await resp.write(b"data: [DONE]\n\n")
         await resp.write_eof()
     return resp
+
+
+async def _run_named_tool(s, name, args, send) -> None:
+    """Dispatch one named sandbox tool, streaming events through `send`."""
+    failpoint("sandbox.server.exec")
+    if name == "create_shell":
+        shell_id = args.get("shell_id") or f"shell-{len(s['shells'])}"
+        if shell_id not in s["shells"]:
+            session = ShellSession(shell_id)
+            await session.start()
+            s["shells"][shell_id] = session
+        await send({"kind": "result",
+                    "data": json.dumps({"shell_id": shell_id})})
+    elif name == "shell_exec":
+        shell_id = args.get("shell_id") or "default"
+        if shell_id not in s["shells"]:
+            session = ShellSession(shell_id)
+            await session.start()
+            s["shells"][shell_id] = session
+        timeout = float(args.get("timeout", 30.0))
+        async for ev in s["shells"][shell_id].exec(
+            args.get("command", ""), timeout=timeout
+        ):
+            await send(ev)
+    elif name == "notebook_run_cell":
+        kernel_id = args.get("kernel_id") or "default"
+        kernel = s["kernels"].setdefault(
+            kernel_id, NotebookKernel(kernel_id)
+        )
+        timeout = float(args.get("timeout", 300.0))
+        try:
+            out = await asyncio.wait_for(
+                asyncio.to_thread(kernel.run_cell, args.get("code", "")),
+                timeout=timeout,
+            )
+            await send({"kind": "result", "data": out})
+        except asyncio.TimeoutError:
+            await send({"kind": "error",
+                        "data": f"cell timed out after {timeout:.0f}s"})
+        except Exception as e:
+            await send({"kind": "error",
+                        "data": f"{type(e).__name__}: {e}"})
+    else:
+        await send({"kind": "error", "data": f"unknown sandbox tool: {name}"})
 
 
 def main() -> None:
@@ -412,7 +440,12 @@ def main() -> None:
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--sandbox-id", default=None)
     args = p.parse_args()
-    logging.basicConfig(level=logging.INFO)
+    # KAFKA_TPU_LOG_FORMAT=json inherited from the parent process
+    # (tracing.subprocess_env): sandbox log lines carry the same
+    # trace_id/thread_id correlation keys as the server's
+    from ..logs import setup_logging
+
+    setup_logging()
     web.run_app(
         create_sandbox_app(args.sandbox_id), host=args.host, port=args.port
     )

@@ -42,6 +42,7 @@ from ..core.types import (
     Usage,
     new_completion_id,
 )
+from .. import tracing
 from ..core.wire import AgentRunRequest, ChatCompletionRequest
 from ..db import DBClient, LocalDBClient, make_db_client
 from ..kafka import KafkaV1Provider, MessageAccumulator
@@ -336,6 +337,14 @@ async def create_app(
     from ..runtime.failpoints import load_env as _load_failpoints
 
     _load_failpoints()
+    # tracing/slow-log config is per-deployment (ServingConfig), applied
+    # before the engine builds so every request is eligible from boot
+    tracing.configure(
+        sample=cfg.trace_sample,
+        ring=cfg.trace_ring,
+        slow_ttft_ms=cfg.slow_ttft_ms or 0,
+        slow_total_ms=cfg.slow_total_ms or 0,
+    )
     if llm_provider is None:
         llm_provider = build_tpu_provider(cfg)
     if db is None:
@@ -368,6 +377,7 @@ async def create_app(
 
     app = web.Application(middlewares=[
         cors_middleware(cfg.cors_origins),
+        tracing_middleware(),
         auth_middleware(cfg.api_token),
     ])
     app[STATE_KEY] = {
@@ -434,6 +444,66 @@ def cors_middleware(origins: str):
         if isinstance(resp, web.HTTPException):
             raise resp
         return resp
+
+    return mw
+
+
+# paths that never start a trace: health probes and the observability
+# surface itself would otherwise churn the ring with noise
+_TRACE_SKIP = ("/health", "/metrics", "/playground", "/debug")
+
+
+def _incoming_trace(request: web.Request):
+    """Adopt an incoming trace identity: X-Request-Id (the id verbatim) or
+    a W3C traceparent (00-<32hex trace>-<16hex span>-<flags> — the trace id
+    is adopted and the caller's span becomes the root's parent)."""
+    rid = request.headers.get("X-Request-Id", "").strip()
+    if rid:
+        return rid[:128], None
+    tp = request.headers.get("traceparent", "").strip()
+    parts = tp.split("-")
+    if len(parts) == 4 and len(parts[1]) == 32 and len(parts[2]) == 16:
+        return parts[1], parts[2]
+    return None, None
+
+
+def tracing_middleware():
+    """Root-span middleware: every serving request gets (or adopts) a
+    trace id; the whole handler — auth, agent loop, SSE stream — runs
+    inside the http.request span.  Sampled-out requests pass through
+    untouched (tracing.start_trace returns None)."""
+
+    @web.middleware
+    async def mw(request: web.Request, handler):
+        if request.method == "OPTIONS" or request.path.startswith(
+            _TRACE_SKIP
+        ):
+            return await handler(request)
+        trace_id, parent_id = _incoming_trace(request)
+        root = tracing.start_trace(
+            request_id=trace_id,
+            trace_id=trace_id,
+            parent_id=parent_id,
+            name="http.request",
+            attrs={"method": request.method, "path": request.path},
+        )
+        if root is None:
+            return await handler(request)
+        ctx = tracing.current()
+        status = None
+        try:
+            resp = await handler(request)
+            status = resp.status
+            if not resp.prepared and ctx is not None:
+                # streamed responses are already on the wire; buffered
+                # ones tell the client which id to ask /debug/trace for
+                resp.headers["X-Request-Id"] = ctx.trace_id
+            return resp
+        except web.HTTPException as e:
+            status = e.status
+            raise
+        finally:
+            tracing.finish_trace(root, status=status)
 
     return mw
 
@@ -515,6 +585,8 @@ def _add_routes(app: web.Application) -> None:
     r.add_get("/metrics", metrics)
     r.add_post("/admin/resize", resize_topology)
     r.add_post("/debug/profile", capture_profile)
+    r.add_get("/debug/traces", debug_traces)
+    r.add_get("/debug/trace/{request_id}", debug_trace)
     r.add_get("/playground", playground)
     # OPTIONS preflight is answered by cors_middleware before routing
 
@@ -1084,6 +1156,19 @@ async def metrics(request: web.Request) -> web.Response:
     from ..sandbox.process import supervisor_snapshot
 
     snap["sandbox"] = supervisor_snapshot()
+    # tracing counters + the slow-request counter (requests over the
+    # configured TTFT/total thresholds) join the same snapshot
+    snap["tracing"] = tracing.counters()
+    if isinstance(snap.get("requests"), dict):
+        snap["requests"]["slow"] = tracing.slow_count()
+    if request.query.get("format") == "prometheus":
+        from .prometheus import render_prometheus
+
+        return web.Response(
+            text=render_prometheus(snap),
+            headers={"Content-Type":
+                     "text/plain; version=0.0.4; charset=utf-8"},
+        )
     return web.json_response(snap)
 
 
@@ -1132,6 +1217,30 @@ async def resize_topology(request: web.Request) -> web.Response:
     except RuntimeError as e:
         return web.json_response({"error": str(e)}, status=409)
     return web.json_response({"dp": dp, "clean": clean})
+
+
+async def debug_traces(request: web.Request) -> web.Response:
+    """Recent-traces index (newest first): ids, durations, span names —
+    enough to find the trace id to pull from /debug/trace/{request_id}."""
+    return web.json_response({
+        "traces": tracing.recent_traces(),
+        "counters": tracing.counters(),
+        "sample": tracing.sample_rate(),
+    })
+
+
+async def debug_trace(request: web.Request) -> web.Response:
+    """One request's span tree as Chrome trace-event JSON — load the body
+    in Perfetto (ui.perfetto.dev) or chrome://tracing.  Keyed by the trace
+    id (== the X-Request-Id the request carried or was assigned)."""
+    data = tracing.chrome_trace(request.match_info["request_id"])
+    if data is None:
+        raise web.HTTPNotFound(
+            text=json.dumps({"error": "unknown trace (evicted from the "
+                             "ring, or the request was sampled out)"}),
+            content_type="application/json",
+        )
+    return web.json_response(data)
 
 
 async def playground(request: web.Request) -> web.Response:
@@ -1203,5 +1312,9 @@ async def capture_profile(request: web.Request) -> web.Response:
 
 def run_server(cfg: Optional[ServingConfig] = None) -> None:
     cfg = cfg or ServingConfig.from_env()
-    logging.basicConfig(level=logging.INFO)
+    from ..logs import setup_logging
+
+    # KAFKA_TPU_LOG_FORMAT=json (or cfg.log_format): every record carries
+    # trace_id/span_id/thread_id for cross-process correlation
+    setup_logging(cfg.log_format)
     web.run_app(create_app(cfg), host=cfg.host, port=cfg.port)

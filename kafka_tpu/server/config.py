@@ -77,6 +77,21 @@ class ServingConfig:
     #       KAFKA_TPU_SANDBOX_MAX_RESTARTS (sandbox/process.py) — no
     #       config field here, the server never constructs that factory.
     replica_quarantine_threshold: int = 3
+    # Observability (README "Observability"):
+    #   trace_sample — fraction of requests traced end to end (span tree in
+    #       the /debug/trace ring).  1.0 traces everything (the sampling-
+    #       down knob is what's disabled by default); 0 disables tracing.
+    #   trace_ring — how many finished traces the in-memory ring retains.
+    #   slow_ttft_ms / slow_total_ms — requests exceeding either threshold
+    #       emit ONE structured log line with their full span breakdown and
+    #       count in requests.slow (None = off).
+    #   log_format — "json" stamps every log record with trace_id/span_id/
+    #       thread_id (kafka_tpu/logs.py); "text" keeps stdlib formatting.
+    trace_sample: float = 1.0
+    trace_ring: int = 256
+    slow_ttft_ms: Optional[float] = None
+    slow_total_ms: Optional[float] = None
+    log_format: str = "text"
     # server
     host: str = "0.0.0.0"
     port: int = 8000
@@ -173,6 +188,11 @@ class ServingConfig:
             replica_quarantine_threshold=get(
                 "REPLICA_QUARANTINE_THRESHOLD",
                 cls.replica_quarantine_threshold, int),
+            trace_sample=get("TRACE_SAMPLE", cls.trace_sample, float),
+            trace_ring=get("TRACE_RING", cls.trace_ring, int),
+            slow_ttft_ms=get("SLOW_TTFT_MS", None, float),
+            slow_total_ms=get("SLOW_TOTAL_MS", None, float),
+            log_format=get("LOG_FORMAT", cls.log_format),
             host=get("HOST", cls.host),
             port=get("PORT", cls.port, int),
             api_token=get("API_TOKEN", None),

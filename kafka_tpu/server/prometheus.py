@@ -1,0 +1,224 @@
+"""Prometheus text exposition for the /metrics snapshot.
+
+``GET /metrics?format=prometheus`` renders the same snapshot the JSON
+endpoint serves (one source of truth — the engine's EngineMetrics, plus
+sandbox-supervision and tracing counters merged by server/app.py) in the
+classic text format (version 0.0.4): ``# TYPE`` lines, stable metric
+names, label escaping per the spec.  Percentile families render as
+summaries with ``quantile`` labels (p50 → 0.5 etc.).
+
+The renderer tolerates both snapshot shapes — a single engine's and the
+DP aggregate's (which lacks the TTFT breakdown and adds the
+replica_supervisor section) — by keying every family off ``.get``.
+A tier-1 test parses the output with a minimal format checker (no
+duplicate series, every family typed, values float-parseable) so the
+endpoint stays scrapeable.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+_QUANTILE = {"p50": "0.5", "p90": "0.9", "p99": "0.99"}
+
+
+def _escape(value: str) -> str:
+    """Label-value escaping per the exposition format spec."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def _fmt(value: Any) -> str:
+    try:
+        f = float(value)
+    except (TypeError, ValueError):
+        return "0"
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+class _Writer:
+    def __init__(self) -> None:
+        self.lines: List[str] = []
+        self._typed: set = set()
+
+    def family(self, name: str, kind: str, help_text: str) -> None:
+        if name in self._typed:
+            return
+        self._typed.add(name)
+        self.lines.append(f"# HELP {name} {help_text}")
+        self.lines.append(f"# TYPE {name} {kind}")
+
+    def sample(
+        self, name: str, value: Any,
+        labels: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        if labels:
+            rendered = ",".join(
+                f'{k}="{_escape(v)}"' for k, v in labels.items()
+            )
+            self.lines.append(f"{name}{{{rendered}}} {_fmt(value)}")
+        else:
+            self.lines.append(f"{name} {_fmt(value)}")
+
+    def summary(
+        self, name: str, quantiles: Dict[str, Any], help_text: str,
+        labels: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        self.family(name, "summary", help_text)
+        for p, q in _QUANTILE.items():
+            if p in quantiles:
+                self.sample(name, quantiles[p],
+                            {**(labels or {}), "quantile": q})
+
+    def render(self) -> str:
+        return "\n".join(self.lines) + "\n"
+
+
+def render_prometheus(snap: Dict[str, Any]) -> str:
+    w = _Writer()
+
+    w.family("kafka_tpu_uptime_seconds", "gauge", "Engine uptime.")
+    w.sample("kafka_tpu_uptime_seconds", snap.get("uptime_s", 0))
+
+    requests = snap.get("requests") or {}
+    if requests:
+        w.family("kafka_tpu_requests_total", "counter",
+                 "Requests by terminal state (submitted counts ingress).")
+        for state, v in requests.items():
+            w.sample("kafka_tpu_requests_total", v, {"state": state})
+
+    queue = snap.get("queue") or {}
+    if queue:
+        w.family("kafka_tpu_queue_depth", "gauge",
+                 "Engine waiting-queue depth (last scheduler iteration).")
+        w.sample("kafka_tpu_queue_depth", queue.get("depth", 0))
+        w.family("kafka_tpu_queue_depth_peak", "gauge",
+                 "Peak waiting-queue depth since boot.")
+        w.sample("kafka_tpu_queue_depth_peak", queue.get("peak", 0))
+
+    tokens = snap.get("tokens") or {}
+    if tokens:
+        w.family("kafka_tpu_tokens_total", "counter",
+                 "Token counters by kind.")
+        for kind in ("prompt", "generated", "speculative_wasted"):
+            if kind in tokens:
+                w.sample("kafka_tpu_tokens_total", tokens[kind],
+                         {"kind": kind})
+        w.family("kafka_tpu_tokens_generated_per_second", "gauge",
+                 "Decode throughput over uptime.")
+        w.sample("kafka_tpu_tokens_generated_per_second",
+                 tokens.get("generated_per_s", 0))
+
+    if "ttft_ms" in snap:
+        w.summary("kafka_tpu_ttft_milliseconds", snap["ttft_ms"],
+                  "Time to first token (recent window percentiles).")
+    for phase, q in (snap.get("ttft_breakdown_ms") or {}).items():
+        w.summary("kafka_tpu_ttft_phase_milliseconds", q,
+                  "TTFT decomposition by phase.", labels={"phase": phase})
+    if "tpot_ms" in snap:
+        w.summary("kafka_tpu_tpot_milliseconds", snap["tpot_ms"],
+                  "Time per output token (recent window percentiles).")
+
+    decode = snap.get("decode") or {}
+    if decode:
+        w.family("kafka_tpu_decode_steps_total", "counter",
+                 "Decode steps dispatched (fused steps count k).")
+        w.sample("kafka_tpu_decode_steps_total", decode.get("steps", 0))
+        w.family("kafka_tpu_batch_occupancy", "gauge",
+                 "Mean busy decode slots per step.")
+        w.sample("kafka_tpu_batch_occupancy",
+                 decode.get("batch_occupancy", 0))
+
+    emission = snap.get("emission") or {}
+    if "burst_tokens" in emission:
+        w.summary("kafka_tpu_emission_burst_tokens",
+                  emission["burst_tokens"],
+                  "Tokens arriving together per emission burst.")
+    if "burst_gap_ms" in emission:
+        w.summary("kafka_tpu_emission_burst_gap_milliseconds",
+                  emission["burst_gap_ms"],
+                  "Gap between emission bursts.")
+
+    if "constrained_roundtrips" in snap:
+        w.family("kafka_tpu_constrained_roundtrips_total", "counter",
+                 "Constrained choice points that awaited a device fetch.")
+        w.sample("kafka_tpu_constrained_roundtrips_total",
+                 snap["constrained_roundtrips"])
+
+    engine = snap.get("engine") or {}
+    if engine:
+        w.family("kafka_tpu_engine_active", "gauge",
+                 "Requests holding a decode slot.")
+        w.sample("kafka_tpu_engine_active", engine.get("active", 0))
+        w.family("kafka_tpu_engine_waiting", "gauge",
+                 "Requests in the waiting queue.")
+        w.sample("kafka_tpu_engine_waiting", engine.get("waiting", 0))
+        w.family("kafka_tpu_kv_pages", "gauge",
+                 "KV pool pages by state.")
+        for key, label in (("pages_total", "total"),
+                           ("pages_free", "free"),
+                           ("pages_in_use", "in_use")):
+            if key in engine:
+                w.sample("kafka_tpu_kv_pages", engine[key],
+                         {"state": label})
+        if "rtt_est_ms" in engine:
+            w.family("kafka_tpu_device_rtt_milliseconds", "gauge",
+                     "Estimated device-to-host fetch round trip.")
+            w.sample("kafka_tpu_device_rtt_milliseconds",
+                     engine["rtt_est_ms"])
+
+    if "dp" in snap:
+        w.family("kafka_tpu_dp_replicas", "gauge",
+                 "Configured DP replica count.")
+        w.sample("kafka_tpu_dp_replicas", snap["dp"])
+
+    pc = snap.get("prefix_cache") or {}
+    if pc:
+        w.family("kafka_tpu_prefix_cache_entries", "gauge",
+                 "Live prefix-cache entries.")
+        w.sample("kafka_tpu_prefix_cache_entries", pc.get("entries", 0))
+        w.family("kafka_tpu_prefix_cache_total", "counter",
+                 "Prefix-cache events by kind.")
+        for kind in ("hits", "misses", "tokens_reused"):
+            if kind in pc:
+                w.sample("kafka_tpu_prefix_cache_total", pc[kind],
+                         {"kind": kind})
+
+    sandbox = snap.get("sandbox") or {}
+    if sandbox:
+        w.family("kafka_tpu_sandbox_total", "counter",
+                 "Sandbox subprocess supervision events.")
+        for kind, v in sandbox.items():
+            w.sample("kafka_tpu_sandbox_total", v, {"event": kind})
+
+    sup = snap.get("replica_supervisor") or {}
+    if sup:
+        w.family("kafka_tpu_replica_health", "gauge",
+                 "Per-replica health (1 healthy, 0.5 probation, 0 out).")
+        for i, g in enumerate(sup.get("health", [])):
+            w.sample("kafka_tpu_replica_health", g, {"replica": i})
+        w.family("kafka_tpu_replica_supervisor_total", "counter",
+                 "Replica supervision events.")
+        for kind in ("quarantines", "readmits", "waiting_migrated",
+                     "affinity_resteered", "rebuilds"):
+            if kind in sup:
+                w.sample("kafka_tpu_replica_supervisor_total", sup[kind],
+                         {"event": kind})
+
+    tr = snap.get("tracing") or {}
+    if tr:
+        w.family("kafka_tpu_traces_total", "counter",
+                 "Traces started since boot.")
+        w.sample("kafka_tpu_traces_total", tr.get("traces", 0))
+        w.family("kafka_tpu_stitched_spans_total", "counter",
+                 "Cross-process spans stitched into parent traces.")
+        w.sample("kafka_tpu_stitched_spans_total",
+                 tr.get("stitched_spans", 0))
+
+    return w.render()

@@ -17,6 +17,7 @@ from __future__ import annotations
 import logging
 from typing import Any, AsyncIterator, Dict, List, Optional, Sequence
 
+from .. import tracing
 from .base import ToolProvider
 from .types import MCPServerConfig, Tool, ToolEvent, parse_tool_arguments
 
@@ -105,7 +106,15 @@ class AgentToolProvider(ToolProvider):
             )
             return
         args = parse_tool_arguments(arguments)
-        async for ev in tool.run_stream(args):
-            ev.tool_call_id = tool_call_id
-            ev.tool_name = ev.tool_name or name
-            yield ev
+        # one span per tool call; sandbox tools propagate the resulting
+        # context over the wire so child spans recorded INSIDE the sandbox
+        # subprocess stitch back under this one (sandbox/local.py)
+        with tracing.span(
+            "tool.exec", attrs={"tool": name, "source": tool.source}
+        ) as s:
+            async for ev in tool.run_stream(args):
+                ev.tool_call_id = tool_call_id
+                ev.tool_name = ev.tool_name or name
+                if s is not None and ev.kind == "error":
+                    s.attrs["error"] = True
+                yield ev

@@ -34,7 +34,11 @@ def tool_turn(name, args, call_id="call_1", cid="chatcmpl-s2"):
             "index": 0, "id": call_id, "type": "function",
             "function": {"name": name, "arguments": json.dumps(args)},
         }], id=cid),
-        StreamChunk(finish_reason="tool_calls", id=cid),
+        StreamChunk(
+            finish_reason="tool_calls", id=cid,
+            usage={"prompt_tokens": 11, "completion_tokens": 5,
+                   "total_tokens": 16},
+        ),
     ]
 
 
@@ -280,6 +284,71 @@ class TestChatCompletions:
                 await client.close()
             assert [m.get("content") for m in msgs] == [
                 "q1", "first", "q2", "second"]
+
+        asyncio.run(go())
+
+
+class TestUsageAccounting:
+    """ISSUE 3 satellite: the agent path reports REAL token usage (the
+    reference returned zeros, SURVEY §5.1) — summed across every turn of
+    a multi-turn tool loop, on both the non-streaming response and the
+    terminal SSE frame (agent_done)."""
+
+    # tool turn usage (11, 5, 16) + final text turn usage (7, 1, 8)
+    EXPECTED = {"prompt_tokens": 18, "completion_tokens": 6,
+                "total_tokens": 24}
+
+    def test_thread_completion_sums_usage_across_tool_loop(self, tmp_path):
+        built, _, _ = make_client(
+            tmp_path,
+            [tool_turn("add", {"a": 1, "b": 2}),
+             text_turn("3", cid="chatcmpl-u2")],
+        )
+
+        async def go():
+            client = await built
+            try:
+                r = await client.post(
+                    "/v1/threads/t-usage/chat/completions",
+                    json={"model": "fake-model",
+                          "messages": [{"role": "user", "content": "1+2?"}]},
+                )
+                assert r.status == 200
+                body = await r.json()
+            finally:
+                await client.close()
+            # non-zero AND additive: both turns' engine usage is present
+            assert body["usage"] == self.EXPECTED
+
+        asyncio.run(go())
+
+    def test_agent_done_carries_summed_usage_on_sse(self, tmp_path):
+        built, _, _ = make_client(
+            tmp_path,
+            [tool_turn("add", {"a": 1, "b": 2}),
+             text_turn("3", cid="chatcmpl-u3")],
+        )
+
+        async def go():
+            client = await built
+            try:
+                r = await client.post(
+                    "/v1/threads/t-usage-sse/chat/completions",
+                    json={"model": "fake-model", "stream": True,
+                          "messages": [{"role": "user", "content": "1+2?"}]},
+                )
+                assert r.status == 200
+                events = parse_sse(await r.text())
+            finally:
+                await client.close()
+            done = next(e for e in events if isinstance(e, dict)
+                        and e.get("type") == "agent_done")
+            assert done["usage"] == self.EXPECTED
+            # per-turn usage frames still stream (OpenAI chunk contract)
+            per_turn = [e["usage"] for e in events
+                        if isinstance(e, dict) and e.get("usage")
+                        and e.get("object") == "chat.completion.chunk"]
+            assert len(per_turn) == 2
 
         asyncio.run(go())
 
