@@ -202,6 +202,10 @@ class Agent:
                                 "completion_tokens", 0)
                             run_usage.total_tokens += chunk.usage.get(
                                 "total_tokens", 0)
+                            run_usage.cached_prompt_tokens += (
+                                chunk.usage.get("prompt_tokens_details")
+                                or {}
+                            ).get("cached_tokens", 0)
                         acc.add_deltas(chunk.tool_calls)
                         yield chunk.to_openai_dict()
             except Exception as e:

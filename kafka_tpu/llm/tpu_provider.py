@@ -126,6 +126,11 @@ class TPULLMProvider(LLMProvider):
         # (or waited on) the admission gate turns new traffic away, which
         # is what makes the resize drain work a finite set and converge
         self._resize_lock = asyncio.Lock()
+        # True while a CANCELLED rebuild thread still runs: its completion
+        # callback owns the worker resume, and the orphaned future below
+        # gates the next resize (see _resize_locked)
+        self._rebuild_owns_resume = False
+        self._orphan_rebuild: Optional[Any] = None
         # Vision tower params (models/vision.py) — present iff the model
         # config has a VisionConfig; image requests 400 otherwise.
         self.vision_params = vision_params
@@ -298,6 +303,21 @@ class TPULLMProvider(LLMProvider):
         if validate is not None:
             validate(dp)
         async with self._resize_lock:
+            if self._orphan_rebuild is not None:
+                # a previous resize was cancelled mid-rebuild: its thread
+                # may STILL be mutating engines.  Starting a second
+                # rebuild now would run two concurrent mutators (and the
+                # orphan's completion would resume the worker mid-rebuild)
+                # — wait the orphan out first.  Its done-callback was
+                # added before this await's, so by the time we continue
+                # the worker resume/flag-clear has already run.
+                try:
+                    await asyncio.shield(self._orphan_rebuild)
+                except Exception:
+                    # already logged by the orphan's done-callback; the
+                    # NEW resize proceeds and rebuilds from current state
+                    pass
+                self._orphan_rebuild = None
             try:
                 return await self._resize_locked(
                     rebuild, dp, drain_timeout_s
@@ -305,8 +325,12 @@ class TPULLMProvider(LLMProvider):
             finally:
                 # a cancelled resize (client timeout mid-drain) must never
                 # leave the worker parked — resume() is idempotent, and a
-                # permanently paused worker is a total serving outage
-                self.worker.resume()
+                # permanently paused worker is a total serving outage.
+                # EXCEPT while a cancelled rebuild thread is still
+                # mutating engines: then the rebuild's done-callback owns
+                # the resume (resuming earlier would race the rebuild).
+                if not self._rebuild_owns_resume:
+                    self.worker.resume()
 
     async def _resize_locked(self, rebuild, dp: int,
                              drain_timeout_s: float) -> bool:
@@ -347,10 +371,46 @@ class TPULLMProvider(LLMProvider):
                     for rid in ids:
                         self.worker.cancel(rid)
             await asyncio.sleep(0.02)
+        # Engine reconstruction compiles/places device arrays for seconds;
+        # with the worker parked the rebuild is single-writer safe from
+        # ANY thread, so run it off the event loop — /health (and every
+        # other handler) stays responsive during the rebuild instead of
+        # blocking behind it.
+        fut = asyncio.get_running_loop().run_in_executor(
+            None, lambda: rebuild(dp=dp)
+        )
         try:
-            rebuild(dp=dp)
+            await asyncio.shield(fut)
+        except asyncio.CancelledError:
+            if not fut.done():
+                # the rebuild thread is STILL mutating engines: resuming
+                # the worker now (the callers' finally blocks) would race
+                # it — hand the resume to the rebuild's completion, and
+                # leave the future behind so the NEXT resize waits it out
+                # before touching the topology
+                self._rebuild_owns_resume = True
+                self._orphan_rebuild = fut
+
+                def _resume(f) -> None:
+                    self._rebuild_owns_resume = False
+                    self.worker.resume()
+                    # the cancelled caller never sees the rebuild's fate:
+                    # a silent rebuild failure (old/half topology still
+                    # serving) must at least reach the logs
+                    exc = None if f.cancelled() else f.exception()
+                    if exc is not None:
+                        logger.error(
+                            "orphaned topology rebuild (resize was "
+                            "cancelled mid-flight) FAILED: %s — the "
+                            "previous topology may still be serving; "
+                            "retry /admin/resize", exc,
+                        )
+
+                fut.add_done_callback(_resume)
+            raise
         finally:
-            self.worker.resume()
+            if not self._rebuild_owns_resume:
+                self.worker.resume()
         return clean
 
     def get_model_info(self, model: Optional[str] = None) -> Dict[str, Any]:
@@ -536,6 +596,9 @@ class TPULLMProvider(LLMProvider):
                     final = self._finalize(
                         mode, buffered, ev, completion_id, model_id,
                         len(prompt_ids), n_tokens,
+                        # radix prefix-cache share (engine thread wrote it
+                        # at admission, strictly before any token event)
+                        cached_tokens=req.cached_tokens,
                     )
                     for chunk in final:
                         yield chunk
@@ -553,6 +616,7 @@ class TPULLMProvider(LLMProvider):
         model_id: str,
         prompt_tokens: int,
         completion_tokens: int,
+        cached_tokens: int = 0,
     ) -> List[StreamChunk]:
         """Terminal chunks: flush buffers, resolve tool calls, report usage."""
         chunks: List[StreamChunk] = []
@@ -582,6 +646,10 @@ class TPULLMProvider(LLMProvider):
             prompt_tokens=prompt_tokens,
             completion_tokens=completion_tokens,
             total_tokens=prompt_tokens + completion_tokens,
+            # OpenAI-compatible prompt_tokens_details.cached_tokens: the
+            # prompt span served from radix-cached KV pages (own- or
+            # cross-thread) instead of prefill compute
+            cached_prompt_tokens=cached_tokens,
         )
         chunks.append(
             StreamChunk(

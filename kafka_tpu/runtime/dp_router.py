@@ -300,24 +300,74 @@ class DataParallelEngines:
         while len(self._affinity) > self._affinity_cap:
             self._affinity.popitem(last=False)
 
+    def _load(self, i: int) -> int:
+        e = self.engines[i]
+        return e.num_active + len(e.waiting) + len(e.parked)
+
     def _pick(self, req: GenRequest) -> int:
+        """Prefix-aware routing: keyed requests go where the longest
+        cached prefix lives (a cheap read-only radix probe per routable
+        replica — the router runs on the engine thread, the tree's single
+        writer).  The thread-affinity LRU is the tiebreak among
+        equal-match replicas, so a warm thread stays put, while a COLD
+        thread with a shared system prompt lands on the replica that has
+        already prefilled it (cross-thread reuse) instead of the merely
+        least-loaded one.  A balance guard caps how much queue skew
+        prefix gravity may build: when the best-match replica is more
+        than a full batch deeper than the least-loaded routable one, load
+        wins — the colder replica prefills the prefix once and becomes a
+        second warm home."""
         routable = self._routable_indices()
+        pin: Optional[int] = None
         if req.prefix_key is not None:
             hit = self._affinity.get(req.prefix_key)
             if hit is not None and hit < len(self.engines):
                 if self.health[hit].routable:
-                    self._affinity.move_to_end(req.prefix_key)
-                    return hit
-                # pinned replica is quarantined/dead: re-steer the thread
-                # to a healthy replica (it pays one prefix-cache miss —
-                # the price of surviving the replica, not a wedged stream)
-                self.supervisor.affinity_resteered += 1
-        loads = [
-            (self.engines[i].num_active + len(self.engines[i].waiting)
-             + len(self.engines[i].parked), i)
-            for i in routable
-        ]
-        return min(loads)[1]
+                    pin = hit
+                else:
+                    # pinned replica is quarantined/dead: re-steer the
+                    # thread to a healthy replica (it pays one prefix-cache
+                    # miss — the price of surviving the replica, not a
+                    # wedged stream)
+                    self.supervisor.affinity_resteered += 1
+        if req.prefix_key is not None and len(routable) > 1:
+            # Warm steady state short-circuit: when the pinned replica
+            # already holds the maximum matchable prefix (every whole page
+            # but the last token), no other replica can beat it — skip the
+            # dp-wide probe entirely (every probe is an O(prompt) walk on
+            # the engine thread).
+            if pin is not None:
+                pc = self.engines[pin].prefix_cache
+                if pc is not None:
+                    ps = pc.pool.page_size
+                    max_match = ((len(req.prompt_ids) - 1) // ps) * ps
+                    if (
+                        max_match > 0
+                        and pc.match_tokens(req.prompt_ids) >= max_match
+                    ):
+                        return pin
+            match = {}
+            for i in routable:
+                pc = self.engines[i].prefix_cache
+                match[i] = (
+                    pc.match_tokens(req.prompt_ids) if pc is not None else 0
+                )
+            best = max(match.values())
+            if best > 0:
+                cands = [i for i in routable if match[i] == best]
+                if pin in cands:
+                    return pin
+                choice = min(cands, key=self._load)
+                floor_load = min(self._load(i) for i in routable)
+                if self._load(choice) - floor_load <= self.ecfg.max_batch:
+                    return choice
+                # prefix gravity would overload one replica: spill to the
+                # least-loaded routable (it warms its own copy on this
+                # prefill) — NOT the pin, which may be deeper still
+                return min(routable, key=self._load)
+        if pin is not None:
+            return pin
+        return min(routable, key=self._load)
 
     def submit(self, req: GenRequest) -> None:
         idx = self._pick(req)

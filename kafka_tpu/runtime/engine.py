@@ -135,8 +135,13 @@ class EngineConfig:
     # and doubling pool capacity.  Resolves attention to the XLA gather
     # path (the Pallas kernel's DMA contract is dense rows).
     kv_quantize: str = ""
-    # Thread-keyed prefix cache capacity (entries); 0 disables.
+    # Radix prefix cache (runtime/prefix_cache.py): cross-thread KV reuse
+    # over the refcounted pool.  prefix_cache_entries is the legacy on/off
+    # knob (0 disables; any positive value enables — the tree is no longer
+    # entry-counted).  prefix_cache_pages bounds the pages the cache may
+    # retain (None = bounded only by pool pressure via reclaim).
     prefix_cache_entries: int = 64
+    prefix_cache_pages: Optional[int] = None
     # Context-parallel strategy for sp>1 chunked prefill: "ring" (KV shards
     # rotate over ICI — bandwidth-optimal, any head count) or "ulysses"
     # (all_to_all to head-sharded layout — needs heads/tp % sp == 0).
@@ -250,6 +255,12 @@ class GenRequest:
     # KV prefix reuse: requests sharing a key (thread id) share cached
     # prompt-prefix pages and re-prefill only the suffix (BASELINE config 2)
     prefix_key: Optional[str] = None
+    # Radix-cache hit accounting (set by _attach_prefix): tokens served
+    # from cached pages and whether the match came from this thread's own
+    # prior turn or another thread's shared prefix.  Rides out on the
+    # engine.prefill span and usage.prompt_tokens_details.cached_tokens.
+    cached_tokens: int = 0
+    cache_source: Optional[str] = None  # "own" | "cross"
     # Off-slot (parked) admission: the prefill's sampled token as a device
     # scalar, held until a decode slot frees and seeds _d_last at seating.
     # None for resumed parked lanes — their pending token is host-known
@@ -552,9 +563,18 @@ class InferenceEngine:
         # complete output_ids while unconstrained lanes stay pipelined.
         self._constrained_fetch: Optional[_Fetch] = None
         self._out_events: List[TokenEvent] = []
+        if (
+            self.ecfg.prefix_cache_pages is not None
+            and self.ecfg.prefix_cache_pages < 0
+        ):
+            raise ValueError(
+                "prefix_cache_pages must be >= 0 (0 disables; None = "
+                "bounded only by pool pressure)"
+            )
         self.prefix_cache: Optional[PrefixCache] = (
-            PrefixCache(self.pool, self.ecfg.prefix_cache_entries)
+            PrefixCache(self.pool, max_pages=self.ecfg.prefix_cache_pages)
             if self.ecfg.prefix_cache_entries > 0
+            and self.ecfg.prefix_cache_pages != 0
             else None
         )
         self.metrics = EngineMetrics()
@@ -671,6 +691,17 @@ class InferenceEngine:
         if self.replica is not None:
             kw["replica"] = self.replica
         return kw
+
+    def _prefill_attrs(self, req: "GenRequest", **kw) -> Dict[str, Any]:
+        """engine.prefill span attrs: prompt size plus the radix-cache
+        share (cached_tokens / cache_source: own-thread vs cross-thread)
+        when the prefill resumed past cached pages.  Traced requests
+        only — cold path."""
+        kw["tokens"] = len(req.prefill_ids)
+        if req.cached_tokens:
+            kw["cached_tokens"] = req.cached_tokens
+            kw["cache_source"] = req.cache_source
+        return self._tattrs(**kw)
 
     def _dispatch_scope(self, members: Sequence[Optional["GenRequest"]]):
         """jax.profiler named scope keyed by the dispatched trace ids, so
@@ -1525,10 +1556,26 @@ class InferenceEngine:
             or req.seq is not None
         ):
             return
+        req.cached_tokens = 0
+        req.cache_source = None
         hit = self.prefix_cache.lookup(req.prefix_key, req.prefill_ids)
         if hit is not None:
             req.seq = SequencePages(seq_id=req.request_id)
-            req.seq.pages, req.seq.length = hit
+            req.seq.pages, req.seq.length = hit.pages, hit.tokens
+            req.cached_tokens = hit.tokens
+            req.cache_source = hit.source
+
+    def _detach_prefix(self, req: GenRequest) -> None:
+        """Roll back a page-blocked _attach_prefix: free the retains and
+        clear the hit record.  Nothing was counted yet — hit counters
+        commit only when the prefill starts (prefix_cache.commit_hit), so
+        a head blocked for many scheduler iterations leaves no trace in
+        the exported hit/reuse figures."""
+        if req.seq is not None:
+            self.pool.free_sequence(req.seq)
+            req.seq = None
+        req.cached_tokens = 0
+        req.cache_source = None
 
     def _admit(self) -> None:
         # Strict submit-order FIFO across BOTH queues: each free slot goes
@@ -1580,19 +1627,15 @@ class InferenceEngine:
             self.prefix_cache is not None
             and self.prefix_cache.reclaim(needed)
         ):
-            if req.seq is not None:
-                self.pool.free_sequence(req.seq)
-                req.seq = None
+            self._detach_prefix(req)
             return False
         self.waiting.pop(0)
         try:
             self._start_prefill(req, slot)
         except OutOfPagesError:
             # couldn't reserve the prompt's pages; roll back, retry later
-            if req.seq:
-                self.pool.free_sequence(req.seq)
+            self._detach_prefix(req)
             req.state = WAITING
-            req.seq = None
             self.waiting.insert(0, req)
             return False
         return True
@@ -1643,18 +1686,14 @@ class InferenceEngine:
             needed = self._pages_needed(req)
             if needed > self.pool.free_pages - reserve:
                 # parking must never eat the decode-growth headroom
-                if req.seq is not None:
-                    self.pool.free_sequence(req.seq)
-                    req.seq = None
+                self._detach_prefix(req)
                 break
             self.waiting.pop(0)
             try:
                 self._start_prefill(req, -1)
             except OutOfPagesError:
-                if req.seq:
-                    self.pool.free_sequence(req.seq)
+                self._detach_prefix(req)
                 req.state = WAITING
-                req.seq = None
                 self.waiting.insert(0, req)
                 break
             self.parked.append(req)
@@ -1677,6 +1716,10 @@ class InferenceEngine:
                 )
         req.seq = req.seq or SequencePages(seq_id=req.request_id)
         self.pool.ensure_capacity(req.seq, len(req.prefill_ids) + 1)
+        if req.cached_tokens and self.prefix_cache is not None:
+            # the attach survived the page gate: NOW the hit counts (a
+            # blocked head's repeated lookups never did — see commit_hit)
+            self.prefix_cache.commit_hit(req.cached_tokens, req.cache_source)
         # constrained decoding: the mask depends only on output_ids, which
         # is constant across prefill chunks — build it once
         req.prefill_allowed = None
@@ -1819,8 +1862,7 @@ class InferenceEngine:
                         req.trace, "engine.prefill",
                         req.t_first_dispatch - (req.t_prefill_start
                                                 or req.t_first_dispatch),
-                        attrs=self._tattrs(tokens=len(req.prefill_ids),
-                                           fused=True),
+                        attrs=self._prefill_attrs(req, fused=True),
                     )
             if req.slot < 0:
                 # off-slot lane: park until a decode slot frees (_admit);
@@ -1940,7 +1982,7 @@ class InferenceEngine:
                     req.trace, "engine.prefill",
                     req.t_first_dispatch - (req.t_prefill_start
                                             or req.t_first_dispatch),
-                    attrs=self._tattrs(tokens=len(req.prefill_ids)),
+                    attrs=self._prefill_attrs(req),
                 )
         if slot < 0:
             req.state = PARKED

@@ -286,10 +286,19 @@ class EngineMetrics:
                 "rtt_est_ms": round(engine._rtt_est * 1e3, 3),
             }
             if engine.prefix_cache is not None:
+                pc = engine.prefix_cache
                 snap["prefix_cache"] = {
-                    "entries": len(engine.prefix_cache),
-                    "hits": engine.prefix_cache.hits,
-                    "misses": engine.prefix_cache.misses,
-                    "tokens_reused": engine.prefix_cache.tokens_reused,
+                    # radix tree shape: nodes (page-aligned token runs) and
+                    # the pages they retain ("entries" keeps the legacy
+                    # name for the node count)
+                    "entries": len(pc),
+                    "nodes": len(pc),
+                    "cached_pages": pc.total_pages,
+                    "hits": pc.hits,
+                    "misses": pc.misses,
+                    "tokens_reused": pc.tokens_reused,
+                    "cross_thread_hits": pc.cross_thread_hits,
+                    "evictions": pc.evictions,
+                    "pages_evicted": pc.pages_evicted,
                 }
         return snap

@@ -1,23 +1,42 @@
-"""Thread-keyed KV prefix cache over the refcounted page pool.
+"""Content-addressed radix-tree KV prefix cache over the refcounted page pool.
 
-BASELINE config 2: multi-turn threads re-serve the same conversation prefix
-every turn; without this, every request re-prefills from token zero.  The
-reference has the persistence half of the story (the thread store is the
-recovery log, src/db/supabase.py:100-175) — this is the cache optimization
-the TPU engine layers on top:
+BASELINE configs 2 and 3: multi-turn threads re-serve the same conversation
+prefix every turn, and in a fan-out-heavy agent deployment *every* thread
+begins with the same system prompt + tool schemas — often thousands of
+tokens.  The original cache here was an exact `prefix_key` (thread id) LRU:
+it reused a thread's *own* prior turn but re-prefilled the shared
+system/tool prefix once per thread, per replica.  This version is a radix
+tree over page-granular token runs (SGLang's RadixAttention; page sharing
+a la vLLM's PagedAttention): `lookup()` walks the tree for the longest
+cached prefix regardless of which thread wrote it, so the shared prefix
+prefills once per *replica*.
 
-* When a request carrying a ``prefix_key`` (the thread id) finishes, its
-  sequence's pages are **retained** into the cache together with the exact
-  token ids materialized in them.
-* The next request with the same key shares the longest common token-prefix
-  at page granularity: full pages are refcount-shared (never re-written —
-  new tokens only ever write pages at or past the first partial page), and
-  prefill resumes at the shared boundary (`SequencePages.length > 0`, which
-  the engine's chunked prefill already supports).
-* Entries are LRU; the engine evicts them under page pressure before it
-  preempts live requests — a cache entry is always strictly cheaper to
-  rebuild (one prefill) than a preempted request (prefill + lost batch
-  slot).
+Mechanics:
+
+* Nodes hold page-aligned token runs plus the physical pages backing them
+  (the cache holds exactly one retain per stored page).  Children are keyed
+  by their first *page* of tokens — sequences diverging mid-page therefore
+  have different keys and never share the divergent page, which keeps every
+  shared page byte-exact.
+* `store()` inserts a finished sequence's materialized tokens along its
+  token path: matched runs are descended (the cache keeps its existing
+  pages — the incoming duplicates are simply not retained), divergence
+  splits a node at the page boundary, and the unmatched suffix becomes a
+  new node whose pages are retained.
+* `lookup()` shares only whole pages and always leaves at least one prompt
+  token to prefill (the prefill must produce last-token logits).  The
+  copy-on-write invariant is preserved by the engine's existing rule: new
+  tokens only ever write pages at or past the first partial page, so a
+  shared full page is never re-written by the reusing sequence.
+* Eviction is leaf-LRU: under page pressure (`reclaim`) or the page budget
+  (`max_pages`, env `KAFKA_TPU_PREFIX_CACHE_PAGES` through the serving
+  config) the least-recently-used *leaf* releases its pages — shared
+  prefixes near the root survive their coldest consumer.  Evicting a cache
+  node is still strictly cheaper than preempting a live request (one
+  prefill vs prefill + a lost batch slot), so the engine reclaims here
+  before it ever preempts.
+* `invalidate(thread_id)` drops only the nodes no *other* thread's store
+  path claims, so deleting one thread never cold-starts its siblings.
 
 Sharing is safe with the engine's async pipeline: a retiring request's
 in-flight decode steps only write KV at positions >= the stored token
@@ -28,107 +47,385 @@ from __future__ import annotations
 
 import dataclasses
 from collections import OrderedDict
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 from .kv_cache import PagePool
 
 
 @dataclasses.dataclass
-class _Entry:
-    tokens: List[int]  # token ids whose KV the pages hold, in order
-    pages: List[int]   # physical pages (cache holds one retain on each)
+class PrefixHit:
+    """One successful lookup: the caller owns one retain on each page."""
+
+    pages: List[int]
+    tokens: int  # cached token count (= len(pages) * page_size)
+    source: str  # "own" (this thread stored through here) | "cross"
+
+
+# Per-node claim cap: a fan-out shared-prefix node is stored through by
+# EVERY thread, and claims must not grow host memory unboundedly on a
+# long-lived replica (the router's affinity LRU is capped for the same
+# reason).  Dropping the oldest claim is conservative: the node merely
+# reads as "cross" for (and survives invalidate by) a thread that hasn't
+# stored through it recently — exactly how a genuinely shared node behaves.
+_KEYS_CAP = 512
+
+
+class _Node:
+    """One page-aligned token run.  len(tokens) == len(pages) * page_size."""
+
+    __slots__ = ("tokens", "pages", "children", "parent", "keys")
+
+    def __init__(
+        self,
+        tokens: List[int],
+        pages: List[int],
+        parent: Optional["_Node"],
+    ):
+        self.tokens = tokens
+        self.pages = pages
+        # first-page token tuple -> child (mid-page divergence => distinct
+        # first pages => distinct keys; splits stay page-aligned)
+        self.children: Dict[Tuple[int, ...], "_Node"] = {}
+        self.parent = parent
+        # prefix_keys whose store() path includes this node, recency-
+        # ordered and capped (invalidate removes only nodes nobody else
+        # claims; `in` answers own/cross classification)
+        self.keys: "OrderedDict[str, None]" = OrderedDict()
 
 
 class PrefixCache:
-    """LRU map: prefix_key -> (tokens, retained pages)."""
+    """Radix tree: token path -> retained pages, shared across threads."""
 
-    def __init__(self, pool: PagePool, max_entries: int = 64):
+    def __init__(self, pool: PagePool, max_pages: Optional[int] = None):
         self.pool = pool
-        self.max_entries = max_entries
-        self._entries: "OrderedDict[str, _Entry]" = OrderedDict()
+        # Page budget for retained pages (None = bounded only by pool
+        # pressure via reclaim()).  Replaces the old entry-count cap: pages
+        # are what the pool actually runs out of.
+        self.max_pages = max_pages
+        self._root = _Node([], [], None)
+        # running shape counters (store() at budget must not re-walk the
+        # tree per evicted leaf — that is O(nodes^2) on the engine thread)
+        self._n_nodes = 0
+        self._n_pages = 0
+        # leaves in (approximate) recency order: eviction pops the front in
+        # O(1) instead of a full-tree scan per reclaimed leaf — reclaim()
+        # runs on the engine thread's allocation path.  Approximate: a
+        # node that BECOMES a leaf (split / child removal) re-enters at
+        # the back; true recency is restored on its next touch.
+        self._leaves: "OrderedDict[_Node, None]" = OrderedDict()
+        # Set once any node's claim list hits _KEYS_CAP and drops a key:
+        # the dropped key's deeper nodes may still claim it, breaking the
+        # root-anchored invariant invalidate()'s fast path walks — it then
+        # degrades to a full-tree sweep (tree size is page-bounded).
+        self._claims_capped = False
         # counters (observability + tests)
         self.hits = 0
         self.misses = 0
         self.tokens_reused = 0
+        self.cross_thread_hits = 0  # hits whose deepest node another thread wrote
+        self.evictions = 0  # nodes evicted under pressure (leaf-LRU + budget)
+        self.pages_evicted = 0
+
+    # -- introspection ---------------------------------------------------
+
+    def _iter_nodes(self) -> Iterator[_Node]:
+        stack = list(self._root.children.values())
+        while stack:
+            node = stack.pop()
+            stack.extend(node.children.values())
+            yield node
 
     def __len__(self) -> int:
-        return len(self._entries)
+        """Node count (the old per-thread entry count's closest analogue)."""
+        return self._n_nodes
+
+    @property
+    def total_pages(self) -> int:
+        """Pages the cache currently retains (gauge for /metrics)."""
+        return self._n_pages
 
     def page_owners(self) -> Dict[int, int]:
-        """Per-page retain counts held by cache entries (engine
-        self_check: these are legitimate owners alongside live
-        sequences)."""
+        """Per-page retain counts held by the tree (engine self_check:
+        these are legitimate owners alongside live sequences)."""
         owners: Dict[int, int] = {}
-        for e in self._entries.values():
-            for p in e.pages:
+        for node in self._iter_nodes():
+            for p in node.pages:
                 owners[p] = owners.get(p, 0) + 1
         return owners
 
+    def _claim(self, node: _Node, key: str) -> None:
+        node.keys[key] = None
+        node.keys.move_to_end(key)
+        while len(node.keys) > _KEYS_CAP:
+            node.keys.popitem(last=False)
+            self._claims_capped = True
+
+    def _touch(self, node: _Node) -> None:
+        """Refresh recency.  The _leaves OrderedDict IS the LRU state —
+        only leaves are eviction candidates, so touching a non-leaf is a
+        no-op by design."""
+        if node in self._leaves:
+            self._leaves.move_to_end(node)
+
+    # -- lookup ----------------------------------------------------------
+
+    def _walk(
+        self, prompt_ids: Sequence[int]
+    ) -> Tuple[List[int], int, _Node]:
+        """Longest whole-page cached match for `prompt_ids` (read-only).
+
+        Returns (pages, matched_pages, deepest_node).  At least one prompt
+        token is always left to prefill, so at most (len-1)//page_size
+        pages are matchable.
+        """
+        ps = self.pool.page_size
+        limit = (len(prompt_ids) - 1) // ps
+        node = self._root
+        pages: List[int] = []
+        matched = 0
+        while matched < limit:
+            key = tuple(prompt_ids[matched * ps:(matched + 1) * ps])
+            child = node.children.get(key)
+            if child is None:
+                break
+            n = len(child.pages)
+            take = 1  # the child key IS its first page: already matched
+            while (
+                take < n
+                and matched + take < limit
+                and child.tokens[take * ps:(take + 1) * ps]
+                == list(prompt_ids[(matched + take) * ps:(matched + take + 1) * ps])
+            ):
+                take += 1
+            pages.extend(child.pages[:take])
+            matched += take
+            node = child
+            if take < n:
+                break
+        return pages, matched, node
+
+    def match_tokens(self, prompt_ids: Sequence[int]) -> int:
+        """Longest cached prefix in TOKENS — a read-only probe (no retains,
+        no LRU touch, no counters).  The DP router scores replicas with
+        this so cold threads land where their system prompt is already
+        hot (runtime/dp_router.py _pick)."""
+        _, matched, _ = self._walk(prompt_ids)
+        return matched * self.pool.page_size
+
     def lookup(
         self, key: str, prompt_ids: Sequence[int]
-    ) -> Optional[Tuple[List[int], int]]:
-        """Return (retained shared pages, cached token count) or None.
+    ) -> Optional[PrefixHit]:
+        """Longest cached prefix for `prompt_ids`, whoever wrote it.
 
         The caller owns one retain on each returned page (released through
-        the sequence's normal free path).  Only whole pages are shared, and
-        at least one prompt token is always left to prefill — the prefill
-        must produce last-token logits.
+        the sequence's normal free path).  `key` only classifies the hit:
+        "own" when this thread's own store path covers the match,
+        "cross" when another thread's prefix is being reused.
         """
-        entry = self._entries.get(key)
-        if entry is None:
+        pages, matched, deepest = self._walk(prompt_ids)
+        if matched == 0:
             self.misses += 1
             return None
-        self._entries.move_to_end(key)
-        lcp = 0
-        limit = min(len(entry.tokens), len(prompt_ids) - 1)
-        while lcp < limit and entry.tokens[lcp] == prompt_ids[lcp]:
-            lcp += 1
-        shared_pages = lcp // self.pool.page_size
-        if shared_pages == 0:
-            self.misses += 1
-            return None
-        pages = list(entry.pages[:shared_pages])
+        # refresh recency: only the deepest matched node can be a leaf
+        # (its ancestors have children by construction), so one touch
+        # keeps hot prefixes off the eviction front
+        self._touch(deepest)
         self.pool.retain(pages)
+        cached = matched * self.pool.page_size
+        source = "own" if key is not None and key in deepest.keys else "cross"
+        return PrefixHit(pages=pages, tokens=cached, source=source)
+
+    def commit_hit(self, tokens: int, source: Optional[str]) -> None:
+        """Count one hit.  Deliberately NOT done inside lookup(): these
+        counters export as a Prometheus counter family (monotone by
+        contract), and a page-blocked admission re-runs lookup every
+        scheduler iteration — counting there would either inflate the
+        hit/reuse figures at scheduler cadence exactly while the cache is
+        thrashing, or require a retraction that breaks monotonicity (a
+        decreasing counter reads as a reset to PromQL rate()).  The
+        engine commits exactly once, when the prefill actually starts."""
         self.hits += 1
-        cached = shared_pages * self.pool.page_size
-        self.tokens_reused += cached
-        return pages, cached
+        self.tokens_reused += tokens
+        if source == "cross":
+            self.cross_thread_hits += 1
+
+    # -- store -----------------------------------------------------------
 
     def store(self, key: str, tokens: Sequence[int], pages: Sequence[int]) -> None:
-        """Retain `pages` under `key`; replaces any previous entry."""
-        old = self._entries.pop(key, None)
-        if old is not None:
-            self.pool.release(old.pages)
-        n_pages = min(len(pages), -(-len(tokens) // self.pool.page_size))
-        kept = list(pages[:n_pages])
-        self.pool.retain(kept)
-        self._entries[key] = _Entry(tokens=list(tokens), pages=kept)
-        while len(self._entries) > self.max_entries:
-            self._evict_one()
+        """Insert a finished sequence's materialized tokens along its path.
 
-    def _evict_one(self) -> bool:
-        if not self._entries:
+        Only whole pages are stored (`tokens` must count exactly the
+        materialized KV slots — the engine drops the final sampled token,
+        whose KV is never written).  Matched runs keep the cache's
+        existing pages; only the unmatched suffix's pages are retained.
+        """
+        ps = self.pool.page_size
+        n_full = min(len(pages), len(tokens) // ps)
+        node = self._root
+        idx = 0  # page index into the incoming sequence
+        while idx < n_full:
+            pkey = tuple(tokens[idx * ps:(idx + 1) * ps])
+            child = node.children.get(pkey)
+            if child is None:
+                run_tokens = list(tokens[idx * ps:n_full * ps])
+                run_pages = list(pages[idx:n_full])
+                self.pool.retain(run_pages)
+                new = _Node(run_tokens, run_pages, node)
+                self._claim(new, key)
+                node.children[pkey] = new
+                self._n_nodes += 1
+                self._n_pages += len(run_pages)
+                self._leaves[new] = None
+                self._leaves.pop(node, None)  # parent is no longer a leaf
+                self._touch(new)
+                break
+            n = len(child.pages)
+            take = 1
+            while (
+                take < n
+                and idx + take < n_full
+                and child.tokens[take * ps:(take + 1) * ps]
+                == list(tokens[(idx + take) * ps:(idx + take + 1) * ps])
+            ):
+                take += 1
+            if take < n:
+                # The run extends past this sequence's path — divergence
+                # inside the run, OR our tokens ran out mid-run.  Split at
+                # the boundary either way: the claim below must cover ONLY
+                # the pages this thread's path actually walked, or a short
+                # store would extend its ownership over another thread's
+                # tail (mislabelling own/cross hits and pinning the tail
+                # against invalidate()).
+                self._split(child, take)
+            self._claim(child, key)
+            self._touch(child)
+            node = child
+            idx += take
+        self._evict_to_budget()
+
+    def _split(self, node: _Node, take: int) -> None:
+        """Split `node` at `take` pages; the suffix becomes its child.
+        No refcount changes — the pages just move between nodes."""
+        ps = self.pool.page_size
+        suffix = _Node(node.tokens[take * ps:], node.pages[take:], node)
+        suffix.children = node.children
+        for c in suffix.children.values():
+            c.parent = suffix
+        suffix.keys = OrderedDict(node.keys)
+        node.tokens = node.tokens[: take * ps]
+        node.pages = node.pages[:take]
+        node.children = {tuple(suffix.tokens[:ps]): suffix}
+        self._n_nodes += 1  # pages just moved between the two nodes
+        # leaf status transfers: the prefix now has a child; the suffix is
+        # a leaf iff the original node was one (it inherited the children)
+        self._leaves.pop(node, None)
+        if not suffix.children:
+            self._leaves[suffix] = None
+
+    # -- eviction --------------------------------------------------------
+
+    def _remove(self, node: _Node) -> None:
+        """Detach one node and release its pages.  No eviction counters —
+        pressure eviction (_evict_leaf) counts itself; invalidate()/
+        clear() must not read as cache thrash on /metrics."""
+        ps = self.pool.page_size
+        parent = node.parent
+        if parent is not None:
+            parent.children.pop(tuple(node.tokens[:ps]), None)
+            if parent is not self._root and not parent.children:
+                self._leaves[parent] = None  # parent became a leaf
+        self.pool.release(node.pages)
+        self._n_nodes -= 1
+        self._n_pages -= len(node.pages)
+        self._leaves.pop(node, None)
+        node.parent = None
+
+    def _evict_leaf(self) -> bool:
+        """Release the least-recently-used leaf — O(1) via the recency-
+        ordered leaf map, not a tree walk (reclaim runs on the engine
+        thread's allocation path).  Leaf-LRU by design: shared prefixes
+        near the root outlive their coldest consumer."""
+        if not self._leaves:
             return False
-        _, entry = self._entries.popitem(last=False)
-        self.pool.release(entry.pages)
+        victim = next(iter(self._leaves))
+        self.evictions += 1
+        self.pages_evicted += len(victim.pages)
+        self._remove(victim)
         return True
 
+    def _evict_to_budget(self) -> None:
+        """Enforce the page budget, PAGE-granular: the LRU leaf is trimmed
+        from its tail rather than dropped whole, so a budget smaller than
+        one stored run keeps the head of the shared prefix (the part every
+        thread reuses) instead of zeroing the cache."""
+        if self.max_pages is None:
+            return
+        ps = self.pool.page_size
+        while self._n_pages > self.max_pages and self._leaves:
+            overage = self._n_pages - self.max_pages
+            victim = next(iter(self._leaves))
+            n = min(len(victim.pages), overage)
+            self.pages_evicted += n
+            keep = len(victim.pages) - n
+            if keep <= 0:
+                self.evictions += 1
+                self._remove(victim)
+            else:
+                self.pool.release(victim.pages[keep:])
+                victim.pages = victim.pages[:keep]
+                victim.tokens = victim.tokens[: keep * ps]
+                self._n_pages -= n
+
     def reclaim(self, pages_needed: int) -> bool:
-        """Evict LRU entries until the pool can satisfy `pages_needed`.
+        """Evict LRU leaves until the pool can satisfy `pages_needed`.
 
         Released pages only become free when no live sequence shares them,
-        so eviction is attempted entry-by-entry and may legitimately fail.
+        so eviction proceeds leaf by leaf and may legitimately fail.
         """
         while self.pool.free_pages < pages_needed:
-            if not self._evict_one():
+            if not self._evict_leaf():
                 return False
         return True
 
     def invalidate(self, key: str) -> None:
-        entry = self._entries.pop(key, None)
-        if entry is not None:
-            self.pool.release(entry.pages)
+        """Drop `key`'s claim; free only nodes no other thread claims.
+
+        Shared prefix nodes (another thread's store path crosses them)
+        survive, so deleting one thread never cold-starts its siblings.
+        Claimed nodes form root-anchored paths (store() claims every node
+        it walks), so the traversal descends only children claiming `key`
+        — O(claimed path), not O(tree) — and unwinds iteratively (a long
+        multi-turn thread is a deep node chain; recursion would overflow).
+        Once any claim list has hit _KEYS_CAP the root-anchored invariant
+        may be broken (an ancestor dropped the key while deeper nodes
+        still hold it), so the sweep covers the whole tree instead —
+        correctness over speed, and the tree stays page-bounded anyway.
+        """
+        if self._claims_capped:
+            order: List[_Node] = list(self._iter_nodes())
+        else:
+            stack = [
+                c for c in self._root.children.values() if key in c.keys
+            ]
+            order = []
+            while stack:
+                node = stack.pop()
+                order.append(node)
+                stack.extend(
+                    c for c in node.children.values() if key in c.keys
+                )
+        # preorder reversed: every node is processed before its ancestors,
+        # so a freed leaf can cascade up its now-empty parents
+        for node in reversed(order):
+            node.keys.pop(key, None)
+            if not node.children and not node.keys:
+                self._remove(node)
 
     def clear(self) -> None:
-        while self._evict_one():
-            pass
+        """Release everything (not counted as pressure eviction)."""
+        for node in list(self._iter_nodes()):
+            self.pool.release(node.pages)
+        self._root = _Node([], [], None)
+        self._n_nodes = 0
+        self._n_pages = 0
+        self._leaves = OrderedDict()

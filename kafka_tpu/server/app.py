@@ -141,6 +141,9 @@ def build_tpu_provider(cfg: ServingConfig) -> LLMProvider:
         cp_strategy=cfg.cp_strategy,
         multi_step=cfg.multi_step,
         kv_quantize=cfg.kv_quantize,
+        # 0 disables the radix prefix cache; None = pressure-bounded
+        prefix_cache_entries=0 if cfg.prefix_cache_pages == 0 else 64,
+        prefix_cache_pages=cfg.prefix_cache_pages or None,
         max_ttft_s=cfg.max_ttft_s,
         max_total_s=cfg.request_timeout_s,
         max_waiting=cfg.max_queue_depth,
@@ -755,6 +758,9 @@ async def _collect_completion(
             usage.prompt_tokens += u.get("prompt_tokens", 0)
             usage.completion_tokens += u.get("completion_tokens", 0)
             usage.total_tokens += u.get("total_tokens", 0)
+            usage.cached_prompt_tokens += (
+                u.get("prompt_tokens_details") or {}
+            ).get("cached_tokens", 0)
     final = acc.final_content
     return {
         "id": new_completion_id(),

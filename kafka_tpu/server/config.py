@@ -35,6 +35,13 @@ class ServingConfig:
     # fused decode depth (EngineConfig.multi_step): steps per device
     # dispatch when the batch is busy; 1 disables fusion
     multi_step: int = 16
+    # Radix prefix-cache page budget (KAFKA_TPU_PREFIX_CACHE_PAGES): how
+    # many KV pool pages the cross-thread prefix cache may retain.  None =
+    # bounded only by pool pressure (the engine reclaims cache pages
+    # before it ever preempts a live request); 0 disables the cache.
+    # Replaces the old per-thread entry-count cap — pages are what the
+    # pool actually runs out of.
+    prefix_cache_pages: Optional[int] = None
     # parallelism (SURVEY §2.2): the server builds its mesh from these.
     #   tp — tensor parallel within each engine (attention heads / MLP)
     #   sp — sequence parallel: ring-sharded chunked prefill for long
@@ -174,6 +181,11 @@ class ServingConfig:
             num_pages=get("NUM_PAGES", cls.num_pages, int),
             max_pages_per_seq=get("MAX_PAGES_PER_SEQ", cls.max_pages_per_seq, int),
             multi_step=get("MULTI_STEP", cls.multi_step, int),
+            # clamp nonsense (negative) values to 0 = "disabled" — a raw
+            # negative budget would otherwise evict every store on sight
+            # while leaving the cache machinery running
+            prefix_cache_pages=get("PREFIX_CACHE_PAGES", None,
+                                   lambda v: max(0, int(v))),
             tp_size=get_axis("TP", cls.tp_size),
             sp_size=get_axis("SP", cls.sp_size),
             pp_size=get_axis("PP", cls.pp_size),

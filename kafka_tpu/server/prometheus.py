@@ -181,11 +181,20 @@ def render_prometheus(snap: Dict[str, Any]) -> str:
     pc = snap.get("prefix_cache") or {}
     if pc:
         w.family("kafka_tpu_prefix_cache_entries", "gauge",
-                 "Live prefix-cache entries.")
+                 "Live prefix-cache entries (radix nodes; legacy name).")
         w.sample("kafka_tpu_prefix_cache_entries", pc.get("entries", 0))
+        if "nodes" in pc:
+            w.family("kafka_tpu_prefix_cache_nodes", "gauge",
+                     "Radix-tree nodes (page-aligned token runs).")
+            w.sample("kafka_tpu_prefix_cache_nodes", pc["nodes"])
+        if "cached_pages" in pc:
+            w.family("kafka_tpu_prefix_cache_pages", "gauge",
+                     "KV pages the prefix cache currently retains.")
+            w.sample("kafka_tpu_prefix_cache_pages", pc["cached_pages"])
         w.family("kafka_tpu_prefix_cache_total", "counter",
                  "Prefix-cache events by kind.")
-        for kind in ("hits", "misses", "tokens_reused"):
+        for kind in ("hits", "misses", "tokens_reused",
+                     "cross_thread_hits", "evictions", "pages_evicted"):
             if kind in pc:
                 w.sample("kafka_tpu_prefix_cache_total", pc[kind],
                          {"kind": kind})

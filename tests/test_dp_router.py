@@ -82,6 +82,60 @@ class TestDPRouting:
         assert dp._affinity["thread-A"] == replica
         assert dp.engines[replica].prefix_cache.hits == 1
 
+    def test_cold_thread_routes_to_warm_prefix_replica(self, model):
+        """ISSUE 4: prefix-aware routing — a COLD thread (no affinity pin)
+        whose prompt begins with an already-cached shared prefix must land
+        on the replica holding it (cross-thread radix hit), even when a
+        less-loaded replica exists."""
+        cfg, params = model
+        dp = DataParallelEngines(cfg, params, EngineConfig(**ECFG),
+                                 dp=2, tp=1, kv_dtype=jnp.float32)
+        common = list(np.random.RandomState(21).randint(1, 128, 16))
+        seed = GenRequest(request_id="warm", prompt_ids=common + [3, 5],
+                          max_new_tokens=4, prefix_key="thread-warm")
+        dp.submit(seed)
+        dp.run_to_completion()
+        warm = dp._affinity["thread-warm"]
+        # skew load AWAY from the warm replica: an unkeyed filler parks on
+        # it, so pure least-loaded routing would now pick the other one
+        filler = GenRequest(request_id="filler", prompt_ids=[9] * 8,
+                            max_new_tokens=32)
+        dp.engines[warm].submit(filler)
+        cold = GenRequest(request_id="cold", prompt_ids=common + [7, 11, 13],
+                          max_new_tokens=4, prefix_key="thread-cold")
+        dp.submit(cold)
+        assert dp._route["cold"] == warm  # prefix gravity beat load
+        dp.run_to_completion()
+        assert dp.engines[warm].prefix_cache.cross_thread_hits >= 1
+        assert cold.cached_tokens == 16 and cold.cache_source == "cross"
+        # correctness: identical tokens to an unrouted reference
+        ref = InferenceEngine(cfg, params, EngineConfig(**ECFG),
+                              kv_dtype=jnp.float32).generate(
+            common + [7, 11, 13], max_new_tokens=4)
+        assert cold.output_ids == ref.output_ids
+
+    def test_prefix_gravity_spills_under_load_skew(self, model):
+        """The balance guard: when the warm replica is more than a full
+        batch deeper than the least-loaded one, load wins — the cold
+        replica prefills the prefix once and becomes a second warm home."""
+        cfg, params = model
+        dp = DataParallelEngines(cfg, params, EngineConfig(**ECFG),
+                                 dp=2, tp=1, kv_dtype=jnp.float32)
+        common = list(np.random.RandomState(22).randint(1, 128, 16))
+        dp.submit(GenRequest(request_id="w", prompt_ids=common + [2],
+                             max_new_tokens=4, prefix_key="t-w"))
+        dp.run_to_completion()
+        warm = dp._affinity["t-w"]
+        # pile max_batch+1 requests onto the warm replica (> the guard)
+        for i in range(dp.ecfg.max_batch + 1):
+            dp.engines[warm].submit(GenRequest(
+                request_id=f"pile{i}", prompt_ids=[9] * 8, max_new_tokens=32))
+        cold = GenRequest(request_id="spill", prompt_ids=common + [4, 6],
+                          max_new_tokens=2, prefix_key="t-spill")
+        dp.submit(cold)
+        assert dp._route["spill"] == 1 - warm  # spilled to the cold replica
+        dp.run_to_completion()
+
     def test_cancel_routes_to_owner(self, model):
         cfg, params = model
         dp = DataParallelEngines(cfg, params, EngineConfig(**ECFG),

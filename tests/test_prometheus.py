@@ -79,6 +79,11 @@ def populated_snapshot():
     snap["sandbox"] = {"crashes": 2, "restarts": 1, "crash_loops": 0,
                        "reaped": 2}
     snap["tracing"] = {"traces": 7, "stitched_spans": 3, "slow": 1}
+    snap["prefix_cache"] = {
+        "entries": 3, "nodes": 3, "cached_pages": 11,
+        "hits": 5, "misses": 2, "tokens_reused": 96,
+        "cross_thread_hits": 4, "evictions": 1, "pages_evicted": 2,
+    }
     return snap
 
 
@@ -100,6 +105,12 @@ class TestRenderer:
             "kafka_tpu_batch_occupancy",
             "kafka_tpu_sandbox_total",
             "kafka_tpu_traces_total",
+            # radix prefix-cache families (ISSUE 4): node/page gauges +
+            # the event counter carrying cross-thread hits and evictions
+            "kafka_tpu_prefix_cache_entries",
+            "kafka_tpu_prefix_cache_nodes",
+            "kafka_tpu_prefix_cache_pages",
+            "kafka_tpu_prefix_cache_total",
         ):
             assert expected in names, expected
         assert families["kafka_tpu_requests_total"] == "counter"
@@ -122,6 +133,12 @@ class TestRenderer:
                    (("quantile", "0.5"),))] == 50.0
         assert by[("kafka_tpu_queue_depth", ())] == 4
         assert by[("kafka_tpu_stitched_spans_total", ())] == 3
+        assert by[("kafka_tpu_prefix_cache_total",
+                   (("kind", "cross_thread_hits"),))] == 4
+        assert by[("kafka_tpu_prefix_cache_total",
+                   (("kind", "evictions"),))] == 1
+        assert by[("kafka_tpu_prefix_cache_pages", ())] == 11
+        assert by[("kafka_tpu_prefix_cache_nodes", ())] == 3
 
     def test_dp_aggregate_snapshot_renders(self):
         """The renderer must also swallow the DP aggregate shape (extra
