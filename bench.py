@@ -209,6 +209,134 @@ def shared_prefix_phase(cfg, params, n_threads: int, common_len: int,
     }
 
 
+def speculative_phase(cfg, params, n_lanes: int = 4, prompt_len: int = 160,
+                      gen_len: int = 64, k: int = 8, page_size: int = 16,
+                      seed: int = 5) -> dict:
+    """Draft-free speculative decoding proof (ISSUE 5) on a tool-echo
+    workload: the same greedy batch runs with speculation off (baseline)
+    and on (KAFKA_TPU_SPECULATIVE_K-style EngineConfig.speculative_k=k),
+    and the phase reports accepted-tokens/step, acceptance rate, and
+    end-to-end tok/s uplift.  Outputs must be TOKEN-IDENTICAL between the
+    two engines — speculation is a pure latency/throughput optimization.
+
+    Prompt shape: agent tool loops echo file contents / JSON tool results
+    back into the context, so each prompt embeds the same "tool result"
+    span twice plus a short repeated motif — exactly the regime where
+    n-gram prompt lookup finds long candidate runs (generation that
+    re-derives any part of the span gets proposed its continuation).
+
+    Importable by the tier-1 CPU smoke test (tests/test_speculative.py):
+    acceptance and output-equivalence must hold on any backend; TPU
+    throughput numbers land in BENCH_r06.
+    """
+    from kafka_tpu.runtime import EngineConfig, GenRequest, InferenceEngine
+
+    rng = random.Random(seed)
+    total = prompt_len + gen_len + 2 * page_size
+
+    def mk(spec_k):
+        ecfg = EngineConfig(
+            max_batch=max(2, n_lanes), page_size=page_size,
+            max_pages_per_seq=max(2, -(-total // page_size)),
+            prefill_buckets=(32, 64, 256, 512),
+            speculative_k=spec_k,
+        )
+        ecfg.num_pages = (n_lanes + 2) * ecfg.max_pages_per_seq + 1
+        return InferenceEngine(cfg, params, ecfg)
+
+    def echo_prompt():
+        span = make_prompt(rng, max(8, prompt_len // 4), cfg.vocab_size)
+        motif = make_prompt(rng, 6, cfg.vocab_size)
+        head = make_prompt(rng, max(4, prompt_len // 8), cfg.vocab_size)
+        p = head + span + motif + span + motif
+        if len(p) < prompt_len:
+            p = p + make_prompt(rng, prompt_len - len(p), cfg.vocab_size)
+        return p[:prompt_len]
+
+    prompts = [echo_prompt() for _ in range(n_lanes)]
+
+    def run(spec_k):
+        eng = mk(spec_k)
+        # compile every program outside the measured window — the prefill
+        # buckets, the verify step (a repetitive warm prompt guarantees a
+        # proposal), and the batched-prefill + fused multi-step programs a
+        # concurrent greedy batch reaches (the baseline engine decodes
+        # through those; an in-window XLA compile is the classic bench
+        # pollution)
+        eng.generate(prompts[0], max_new_tokens=2)
+        eng.generate([7] * min(prompt_len, 48), max_new_tokens=16)
+        for i in range(min(4, n_lanes)):
+            eng.submit(GenRequest(
+                request_id=f"spec-warm-{spec_k}-{i}",
+                prompt_ids=make_prompt(rng, max(4, prompt_len // 2),
+                                       cfg.vocab_size),
+                max_new_tokens=eng.ecfg.multi_step + 4))
+        eng.run_to_completion()
+        # the warmup traffic above (including the deliberately repetitive
+        # prompt) lands in the same lifetime counters as the measured
+        # batch — everything reported below is a POST-WARMUP delta
+        steps0 = eng.metrics.decode_steps
+        spec0 = eng.metrics.speculation_snapshot()
+        reqs = [
+            GenRequest(request_id=f"spec-{spec_k}-{i}", prompt_ids=p,
+                       max_new_tokens=gen_len)
+            for i, p in enumerate(prompts)
+        ]
+        t0 = time.monotonic()
+        for r in reqs:
+            eng.submit(r)
+        eng.run_to_completion()
+        wall = time.monotonic() - t0
+        tokens = sum(len(r.output_ids) for r in reqs)
+        steps = eng.metrics.decode_steps - steps0
+        spec1 = eng.metrics.speculation_snapshot()
+        deltas = {
+            key: spec1[key] - spec0[key]
+            for key in ("speculation_proposed_tokens",
+                        "speculation_accepted_tokens",
+                        "speculation_rejected_tokens",
+                        "speculation_verify_steps")
+        }
+        return [r.output_ids for r in reqs], tokens / wall, steps, deltas
+
+    base_out, base_tps, base_steps, _ = run(0)
+    spec_out, spec_tps, spec_steps, spec = run(k)
+    drained = (spec["speculation_accepted_tokens"]
+               + spec["speculation_rejected_tokens"])
+    spec["speculation_acceptance_rate"] = round(
+        spec["speculation_accepted_tokens"] / drained, 4
+    ) if drained else 0.0
+    spec["speculation_accepted_per_step"] = round(
+        spec["speculation_accepted_tokens"]
+        / spec["speculation_verify_steps"], 3
+    ) if spec["speculation_verify_steps"] else 0.0
+    return {
+        "n_lanes": n_lanes,
+        "prompt_len": prompt_len,
+        "gen_len": gen_len,
+        "speculative_k": k,
+        "outputs_match": base_out == spec_out,
+        "decode_tok_s": {"baseline": round(base_tps, 1),
+                         "speculative": round(spec_tps, 1)},
+        "tok_s_uplift": round(spec_tps / base_tps, 2) if base_tps else None,
+        "decode_steps": {"baseline": base_steps,
+                         "speculative": spec_steps},
+        "acceptance_rate": spec["speculation_acceptance_rate"],
+        "accepted_per_step": spec["speculation_accepted_per_step"],
+        "proposed_tokens": spec["speculation_proposed_tokens"],
+        "accepted_tokens": spec["speculation_accepted_tokens"],
+        "verify_steps": spec["speculation_verify_steps"],
+        "note": ("tool-echo greedy workload, speculation on vs off; "
+                 "outputs are token-identical by design (exact-match "
+                 "acceptance with the sequential path's per-(seed, "
+                 "position) sampling keys).  On TPU the uplift is "
+                 "weight-stream amortization (accepted_per_step extra "
+                 "tokens per weight read); CPU smoke walls are partly "
+                 "fetch-pipeline-aging artifacts — acceptance_rate / "
+                 "accepted_per_step are the backend-independent signal"),
+    }
+
+
 def serving_phase(cfg, params, args, quick: bool):
     """Measure the SERVED path end to end: real aiohttp app, real SSE
     clients, agent loop + constrained tool calls (VERDICT r3 next #1;
@@ -369,8 +497,8 @@ def serving_phase(cfg, params, args, quick: bool):
                     # being one confounded number
                     "engine_ttft_breakdown_ms": snap["ttft_breakdown_ms"],
                     "prefix_cache": snap.get("prefix_cache"),
-                    "speculative_waste_frac":
-                        snap["tokens"]["speculative_waste_frac"],
+                    "fetch_pipeline_waste_frac":
+                        snap["tokens"]["fetch_pipeline_waste_frac"],
                     "note": ("client-observed over HTTP/SSE incl. "
                              "tokenization, agent loop, worker handoff, "
                              "aiohttp; turn 2 replays thread history "
@@ -642,10 +770,16 @@ def scale_phase(args, base_cfg, base_params) -> dict:
 
 def main() -> None:
     ap = argparse.ArgumentParser()
+    ap.add_argument("scenario", nargs="?", default="all",
+                    choices=("all", "speculative"),
+                    help="'speculative' runs ONLY the speculative-decoding "
+                         "A/B phase (bench.py speculative)")
     ap.add_argument("--model", default="llama-3.2-1b")
     ap.add_argument("--quick", action="store_true",
                     help="tiny model + short runs (CI smoke)")
     ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--spec-k", type=int, default=8,
+                    help="speculative_k for the speculative phase")
     ap.add_argument("--prompt-len", type=int, default=128)
     ap.add_argument("--gen-len", type=int, default=256)
     ap.add_argument("--cache-prompt-len", type=int, default=2048,
@@ -695,6 +829,27 @@ def main() -> None:
     pbytes = param_bytes(params)
     log(f"params init: {time.monotonic() - t0:.1f}s "
         f"({pbytes / 1e9:.2f} GB)")
+
+    if args.scenario == "speculative":
+        # bench.py speculative: ONLY the draft-free speculation A/B
+        out = speculative_phase(
+            cfg, params,
+            n_lanes=4 if args.quick else min(8, args.batch),
+            prompt_len=48 if args.quick else 160,
+            gen_len=24 if args.quick else 128,
+            k=args.spec_k,
+            page_size=8 if args.quick else 16,
+        )
+        log(f"speculative: uplift {out['tok_s_uplift']}x, acceptance "
+            f"{out['acceptance_rate']}, accepted/step "
+            f"{out['accepted_per_step']}")
+        print(json.dumps({
+            "metric": f"speculative_decode_tok_s_uplift_{cfg.name}",
+            "value": out["tok_s_uplift"],
+            "unit": "x",
+            "extras": out,
+        }))
+        return
 
     ecfg = EngineConfig(
         max_batch=args.batch,
@@ -814,6 +969,20 @@ def main() -> None:
         f"prefill tokens over {shared_prefix['n_threads']} threads "
         f"({shared_prefix['cross_thread_hits']} cross-thread hits); warm "
         f"TTFT {shared_prefix['warm_thread_ttft_ms']}")
+
+    # ---- speculative decoding: tool-echo A/B (spec on vs off) ------------
+    speculative = speculative_phase(
+        cfg, params,
+        n_lanes=4 if args.quick else min(8, args.batch),
+        prompt_len=48 if args.quick else 160,
+        gen_len=24 if args.quick else 128,
+        k=args.spec_k,
+        page_size=8 if args.quick else 16,
+    )
+    log(f"speculative: uplift {speculative['tok_s_uplift']}x, acceptance "
+        f"{speculative['acceptance_rate']}, accepted/step "
+        f"{speculative['accepted_per_step']}, outputs_match "
+        f"{speculative['outputs_match']}")
 
     # ---- decode throughput: full batch, steady state ---------------------
     decode_tps, steps_per_s = decode_phase(
@@ -1004,6 +1173,7 @@ def main() -> None:
                         "nominal BW by chip family table",
             },
             "shared_prefix": shared_prefix,
+            "speculative": speculative,
             "batch_sweep": sweep,
             "fused_depth_ablation": depth_ablation,
             "metrics": {  # same counters the server's GET /metrics exports

@@ -1,0 +1,47 @@
+"""Version-compatibility shims for the pinned jax.
+
+`shard_map` moved from `jax.experimental.shard_map` (kwarg `check_rep`)
+to the public `jax.shard_map` (kwarg `check_vma`).  Call sites use the
+public spelling; this shim maps it onto whichever API the installed jax
+provides so the per-shard kernel dispatch works on both.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+try:  # jax >= 0.6: public API
+    from jax import shard_map as _shard_map
+
+    _public = True
+except ImportError:  # jax 0.4.x: experimental module
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _public = False
+
+# The check_rep -> check_vma kwarg rename did NOT land together with the
+# public re-export (public-but-check_rep versions exist in the 0.5/0.6
+# transition band), so pick the kwarg from the actual signature; the
+# import location is only the fallback when introspection fails.
+try:
+    _params = inspect.signature(_shard_map).parameters
+    _CHECK_KWARG = ("check_vma" if "check_vma" in _params
+                    else "check_rep" if "check_rep" in _params
+                    else ("check_vma" if _public else "check_rep"))
+except (TypeError, ValueError):
+    _CHECK_KWARG = "check_vma" if _public else "check_rep"
+
+
+try:  # jax >= 0.9: explicit varying-mesh-axes casts inside shard_map
+    from jax.lax import pcast
+except ImportError:  # jax 0.4.x has no vma tracking: pcast is a no-op
+
+    def pcast(x, axes=None, *, to=None):
+        return x
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        **{_CHECK_KWARG: check_vma},
+    )

@@ -422,6 +422,9 @@ class TPULLMProvider(LLMProvider):
             "vocab_size": self.model_cfg.vocab_size,
             "supports_tools": True,
             "supports_streaming": True,
+            # draft-free speculative decoding depth (0 = off): surfaced so
+            # operators can confirm the serving shape without reading env
+            "speculative_k": self.engine.ecfg.speculative_k,
         }
 
     def build_tool_call_mask_fn(

@@ -275,6 +275,37 @@ def _attention_block(
         elif (
             cfg.attention_backend == "pallas"
             and s > 1
+            and paged.seq_lens is not None
+            and paged.page_table is not None
+            and not isinstance(k_cache, QTensor)
+        ):
+            # Speculative verify step (engine._get_verify_fn): S = K+1
+            # query tokens per lane against the paged pool, each causally
+            # masked to its own position.  seq_lens present + s>1
+            # distinguishes it from prefill chunks (which carry `start`)
+            # and plain decode (s == 1).  Int8 pools fall through to the
+            # dequantizing XLA gather below.
+            from ..ops.pallas import (
+                paged_verify_attention,
+                paged_verify_attention_sharded,
+            )
+
+            interp = jax.default_backend() != "tpu"
+            if mesh is not None and mesh.size > 1:
+                out = paged_verify_attention_sharded(
+                    mesh, q, k_cache, v_cache,
+                    paged.page_table, paged.seq_lens, paged.chunk_len,
+                    page_size=paged.page_size, interpret=interp,
+                )
+            else:
+                out = paged_verify_attention(
+                    q, k_cache, v_cache,
+                    paged.page_table, paged.seq_lens, paged.chunk_len,
+                    page_size=paged.page_size, interpret=interp,
+                )
+        elif (
+            cfg.attention_backend == "pallas"
+            and s > 1
             and b == 1
             and (mesh is None or mesh.size == 1)
             and not isinstance(k_cache, QTensor)

@@ -42,6 +42,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ...compat import shard_map
+
 NEG_INF = -1e30
 
 
@@ -246,6 +248,248 @@ def paged_decode_attention(
     return out_wide.reshape(B, Hq, Hkv, D)[:, jnp.arange(Hq), kv_of_q]
 
 
+def _verify_kernel(
+    # scalar prefetch
+    page_table_ref,  # [B, P] i32
+    seq_lens_ref,    # [B] i32 tokens cached BEFORE this step
+    q_lens_ref,      # [B] i32 valid queries this step (cand_len + 1)
+    # inputs
+    q_ref,        # [1, S*Hq, Hkv*D] VMEM — block-diagonal expanded q
+    k_pages_hbm,  # [num_pages, ps, Hkv*D]
+    v_pages_hbm,  # [num_pages, ps, Hkv*D]
+    out_ref,      # [1, S*Hq, Hkv*D] VMEM
+    # scratch
+    kbuf, vbuf, ksem, vsem, m_ref, l_ref, acc_ref,
+    *,
+    page_size: int,
+    pages_per_chunk: int,
+    n_queries: int,  # S = speculative_k + 1 (static)
+    heads: int,      # Hq (static)
+    scale: float,
+):
+    """Speculative-verify attention: S = K+1 query tokens per sequence in
+    one kernel launch (the decode kernel generalized from one query row
+    group to S of them).  Query j sits at position seq_len + j and
+    attends positions <= seq_len + j — per-ROW causal masking over the
+    merged-lane score matrix (rows are (query, head) pairs, S-major), on
+    top of the same double-buffered per-page DMA walk the decode kernel
+    does.  One weight... one KV-stream serves all S queries — exactly the
+    amortization speculative decoding exists for."""
+    b = pl.program_id(0)
+    ps, cp = page_size, pages_per_chunk
+    chunk = cp * ps
+    rows = n_queries * heads
+    # valid KV = previously cached tokens + this step's q_len fresh writes
+    n_valid = seq_lens_ref[b] + q_lens_ref[b]
+    n_pages = pl.cdiv(n_valid, ps)
+    n_chunks = pl.cdiv(n_pages, cp)
+
+    def issue(c, slot):
+        for j in range(cp):
+            @pl.when(c * cp + j < n_pages)
+            def _():
+                page = page_table_ref[b, c * cp + j]
+                pltpu.make_async_copy(
+                    k_pages_hbm.at[page],
+                    kbuf.at[slot, pl.ds(j * ps, ps)],
+                    ksem.at[slot, j],
+                ).start()
+                pltpu.make_async_copy(
+                    v_pages_hbm.at[page],
+                    vbuf.at[slot, pl.ds(j * ps, ps)],
+                    vsem.at[slot, j],
+                ).start()
+
+    def wait(c, slot):
+        for j in range(cp):
+            @pl.when(c * cp + j < n_pages)
+            def _():
+                page = page_table_ref[b, c * cp + j]
+                pltpu.make_async_copy(
+                    k_pages_hbm.at[page],
+                    kbuf.at[slot, pl.ds(j * ps, ps)],
+                    ksem.at[slot, j],
+                ).wait()
+                pltpu.make_async_copy(
+                    v_pages_hbm.at[page],
+                    vbuf.at[slot, pl.ds(j * ps, ps)],
+                    vsem.at[slot, j],
+                ).wait()
+
+    m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+    l_ref[...] = jnp.zeros_like(l_ref)
+    acc_ref[...] = jnp.zeros_like(acc_ref)
+    issue(0, 0)
+
+    def body(c, carry):
+        slot = jax.lax.rem(c, 2)
+
+        @pl.when(c + 1 < n_chunks)
+        def _():
+            issue(c + 1, jax.lax.rem(c + 1, 2))
+
+        wait(c, slot)
+
+        remaining = n_valid - c * chunk
+        # per-(query, head)-row causal mask: row r is query r // heads at
+        # position seq_len + r // heads; column g is global slot
+        # c*chunk + local — allow g <= qpos AND g < n_valid (garbage
+        # queries past q_len are clamped to the valid window so stale
+        # never-DMA'd rows cannot leak in; their outputs are discarded)
+        col = jax.lax.broadcasted_iota(jnp.int32, (rows, chunk), 1)
+        row = jax.lax.broadcasted_iota(jnp.int32, (rows, chunk), 0)
+        qpos = seq_lens_ref[b] + row // heads
+        g = c * chunk + col
+        allow = (g <= qpos) & (col < remaining)  # [rows, chunk]
+        local_col = jax.lax.broadcasted_iota(jnp.int32, (chunk, 1), 0)
+        col_mask = local_col < remaining  # [chunk, 1] — zero garbage V
+
+        kc = kbuf[slot].astype(jnp.float32)  # [chunk, HD]
+        vc = jnp.where(col_mask, vbuf[slot].astype(jnp.float32), 0.0)
+        qx = q_ref[0].astype(jnp.float32)  # [rows, HD] block-diagonal
+        s = (
+            jax.lax.dot_general(
+                qx, kc,
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )  # [rows, chunk]
+        s = jnp.where(allow, s, NEG_INF)
+
+        m_prev = m_ref[...]  # [rows, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        pexp = jnp.exp(s - m_new)
+        pexp = jnp.where(allow, pexp, 0.0)
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(pexp, axis=-1,
+                                                  keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            pexp, vc,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[...] = m_new
+        return carry
+
+    jax.lax.fori_loop(0, n_chunks, body, 0)
+    denom = jnp.maximum(l_ref[...], 1e-30)
+    out_ref[0, :, :] = (acc_ref[...] / denom).astype(out_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("page_size", "pages_per_chunk", "scale", "interpret"),
+)
+def paged_verify_attention(
+    q: jnp.ndarray,            # [B, S, Hq, D] — K+1 query tokens per seq
+    k_pool: jnp.ndarray,       # [TOTAL_SLOTS, Hkv*D] merged-lane pool
+    v_pool: jnp.ndarray,       # [TOTAL_SLOTS, Hkv*D]
+    page_table: jnp.ndarray,   # [B, P] i32
+    seq_lens: jnp.ndarray,     # [B] i32 tokens cached before the step
+    q_lens: jnp.ndarray,       # [B] i32 valid queries (cand_len + 1)
+    *,
+    page_size: int,
+    pages_per_chunk: int = 8,
+    scale: float | None = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Speculative-verify attention off the paged pool: [B, S, Hq, D] in
+    q.dtype, each query row causally masked to its own position.  The
+    engine's verify step has already written the S input tokens' KV, so
+    the kernel walks seq_len + q_len valid slots per sequence.  Rows for
+    queries past q_len produce garbage the caller discards — same
+    contract as inactive lanes in the decode kernel."""
+    B, S, Hq, D = q.shape
+    HD = k_pool.shape[1]
+    Hkv = HD // D
+    G = Hq // Hkv
+    P = page_table.shape[1]
+    if scale is None:
+        scale = D**-0.5
+    cp = min(pages_per_chunk, P)
+    k_pages = k_pool.reshape(-1, page_size, HD)
+    v_pages = v_pool.reshape(-1, page_size, HD)
+
+    # block-diagonal query expansion, per query token (see module
+    # docstring): row (s, qh) holds q[b, s, qh] in its own kv head's
+    # D-lane block
+    kv_of_q = jnp.repeat(jnp.arange(Hkv), G)  # [Hq]
+    qx = jnp.zeros((B, S, Hq, Hkv, D), q.dtype)
+    qx = qx.at[:, :, jnp.arange(Hq), kv_of_q].set(q)
+    qx = qx.reshape(B, S * Hq, HD)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec((1, S * Hq, HD), lambda b, pt, sl, ql: (b, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, S * Hq, HD),
+                               lambda b, pt, sl, ql: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, cp * page_size, HD), k_pool.dtype),
+            pltpu.VMEM((2, cp * page_size, HD), v_pool.dtype),
+            pltpu.SemaphoreType.DMA((2, cp)),
+            pltpu.SemaphoreType.DMA((2, cp)),
+            pltpu.VMEM((S * Hq, 1), jnp.float32),
+            pltpu.VMEM((S * Hq, 1), jnp.float32),
+            pltpu.VMEM((S * Hq, HD), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _verify_kernel,
+        page_size=page_size,
+        pages_per_chunk=cp,
+        n_queries=S,
+        heads=Hq,
+        scale=scale,
+    )
+    out_wide = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, S * Hq, HD), q.dtype),
+        interpret=interpret,
+    )(page_table, seq_lens, q_lens, qx, k_pages, v_pages)
+    return out_wide.reshape(B, S, Hq, Hkv, D)[
+        :, :, jnp.arange(Hq), kv_of_q
+    ]
+
+
+def paged_verify_attention_sharded(
+    mesh,
+    q: jnp.ndarray,            # [B, S, Hq, D]
+    k_pool: jnp.ndarray,
+    v_pool: jnp.ndarray,
+    page_table: jnp.ndarray,
+    seq_lens: jnp.ndarray,
+    q_lens: jnp.ndarray,
+    *,
+    page_size: int,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """The verify kernel on a tp(/tq) mesh — same per-shard head-split
+    contract as paged_decode_attention_sharded (caller must have passed
+    pallas_mesh_ok)."""
+    from jax.sharding import PartitionSpec as P
+
+    q_ax = ("tp", "tq") if mesh.shape.get("tq", 1) > 1 else "tp"
+    fn = shard_map(
+        functools.partial(
+            paged_verify_attention, page_size=page_size,
+            interpret=interpret,
+        ),
+        mesh=mesh,
+        in_specs=(P(None, None, q_ax, None), P(None, "tp"), P(None, "tp"),
+                  P(None, None), P(None), P(None)),
+        out_specs=P(None, None, q_ax, None),
+        check_vma=False,
+    )
+    return fn(q, k_pool, v_pool, page_table, seq_lens, q_lens)
+
+
 def pallas_mesh_ok(mesh, num_heads: int, num_kv_heads: int) -> bool:
     """Can the decode kernel run per-shard on this mesh via shard_map?
 
@@ -298,7 +542,7 @@ def paged_decode_attention_sharded(
     from jax.sharding import PartitionSpec as P
 
     q_ax = ("tp", "tq") if mesh.shape.get("tq", 1) > 1 else "tp"
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(
             paged_decode_attention, page_size=page_size, interpret=interpret
         ),
@@ -549,7 +793,7 @@ def paged_decode_attention_int8_sharded(
     from jax.sharding import PartitionSpec as P
 
     q_ax = ("tp", "tq") if mesh.shape.get("tq", 1) > 1 else "tp"
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(
             paged_decode_attention_int8,
             page_size=page_size, interpret=interpret,

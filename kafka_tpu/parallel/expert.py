@@ -28,6 +28,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..compat import shard_map
+
 Params = Dict[str, jnp.ndarray]
 
 
@@ -82,7 +84,7 @@ def moe_mlp_sharded(
         local = jnp.einsum("te,teh->th", w_local, y)
         return lax.psum(local, "ep")
 
-    fn = jax.shard_map(
+    fn = shard_map(
         per_shard,
         mesh=mesh,
         in_specs=(P(), P(), P("ep"), P("ep"), P("ep")),

@@ -41,6 +41,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..compat import pcast, shard_map
 from ..models.config import ModelConfig
 from ..models.llama import Params, _attention_block, _mlp_block
 from ..ops.norms import rms_norm
@@ -188,7 +189,7 @@ def pp_forward_paged(
             h2, (k_new, v_new) = lax.scan(body, h, (layer_params, kp, vp))
             return h2, k_new, v_new
 
-        h = lax.pcast(h, ("pp", "tp"), to="varying")
+        h = pcast(h, ("pp", "tp"), to="varying")
         for s in range(pp):  # sequential stages; only rank s computes
             h, kp, vp = lax.cond(
                 rank == s, run_stage, lambda op: op, (h, kp, vp)
@@ -203,7 +204,7 @@ def pp_forward_paged(
 
     layer_specs = pp_param_specs(cfg, mesh)["layers"]
     pool_spec = kv_pool_spec_pp(cfg, mesh)
-    fn = jax.shard_map(
+    fn = shard_map(
         per_shard,
         mesh=mesh,
         in_specs=(layer_specs, pool_spec, pool_spec,
@@ -262,7 +263,7 @@ def pp_forward(
         # the replicated input becomes rank-varying the moment it meets the
         # stage- and head-sharded weights; cast up front so scan/cond
         # carries type-check (same vma dance as ring_attention)
-        h = lax.pcast(x, ("pp", "tp"), to="varying")
+        h = pcast(x, ("pp", "tp"), to="varying")
         for s in range(pp):  # sequential stages; only rank s computes
             h = lax.cond(rank == s, run_stage, lambda v: v, h)
             if s + 1 < pp:
@@ -281,7 +282,7 @@ def pp_forward(
     x, cos, sin = _embed_and_rope(params, cfg, token_ids, positions)
 
     layer_specs = pp_param_specs(cfg, mesh)["layers"]
-    fn = jax.shard_map(
+    fn = shard_map(
         per_shard,
         mesh=mesh,
         in_specs=(layer_specs, P(), P(), P(), P()),

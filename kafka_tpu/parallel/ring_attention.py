@@ -33,6 +33,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..compat import pcast, shard_map
 from ..ops.attention import NEG_INF, repeat_kv
 
 
@@ -103,7 +104,7 @@ def ring_attention(
         # mark the accumulators as varying over the ring axis so the scan
         # carry type matches its output (JAX >= 0.9 shard_map vma tracking)
         acc, m, l = (
-            lax.pcast(x, (axis_name,), to="varying") for x in (acc, m, l)
+            pcast(x, (axis_name,), to="varying") for x in (acc, m, l)
         )
 
     def body(carry, _):
@@ -149,7 +150,7 @@ def ring_attention_sharded(
     """shard_map wrapper: global [B, S, H, D] inputs sharded on S over sp."""
     spec_a = P(None, axis_name, None, None)
     spec_p = P(None, axis_name)
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(ring_attention, axis_name=axis_name),
         mesh=mesh,
         in_specs=(spec_a, spec_a, spec_a, spec_p, spec_p),
@@ -206,7 +207,7 @@ def _prefill_sharded(
     rep_kv = P(None, None, kv_ax, None)
     rep_p = P(None, None)
 
-    fn = jax.shard_map(
+    fn = shard_map(
         per_shard,
         mesh=mesh,
         in_specs=(spec_q, spec_kv, spec_kv, spec_p,
@@ -387,7 +388,7 @@ def ulysses_attention_sharded(
 ) -> jnp.ndarray:
     spec_a = P(None, axis_name, None, None)
     spec_p = P(None, axis_name)
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(ulysses_attention, axis_name=axis_name),
         mesh=mesh,
         in_specs=(spec_a, spec_a, spec_a, spec_p),

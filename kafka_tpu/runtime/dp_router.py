@@ -48,7 +48,7 @@ import logging
 import os
 import time
 from collections import OrderedDict
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 
@@ -134,6 +134,18 @@ class DataParallelEngines:
         # long evicted shouldn't stay pinned (or leak memory) forever
         self._affinity: "OrderedDict[str, int]" = OrderedDict()
         self._affinity_cap = 4096
+        # Probe memoization for the shared system-prompt head (PR 5
+        # satellite): keyed by the prompt's first page of tokens, caching
+        # each replica's match_tokens result alongside the prefix-cache
+        # generation it was computed at.  The fan-out agent shape probes
+        # the SAME multi-page head once per keyed submit per replica —
+        # O(match) * dp on the engine thread at wide dp; with the memo a
+        # warm head costs one O(match) verification per submit and O(1)
+        # per replica.  See _probe_matches for the exact validity rules.
+        self._probe_memo: "OrderedDict[Tuple[int, ...], Dict[str, Any]]" = (
+            OrderedDict()
+        )
+        self._probe_memo_cap = 32
         # which replica raised out of step(), so recovery targets it alone
         self._failed_replica: Optional[int] = None
         self._pre_failure_events: List[TokenEvent] = []
@@ -346,12 +358,7 @@ class DataParallelEngines:
                         and pc.match_tokens(req.prompt_ids) >= max_match
                     ):
                         return pin
-            match = {}
-            for i in routable:
-                pc = self.engines[i].prefix_cache
-                match[i] = (
-                    pc.match_tokens(req.prompt_ids) if pc is not None else 0
-                )
+            match = self._probe_matches(routable, req.prompt_ids)
             best = max(match.values())
             if best > 0:
                 cands = [i for i in routable if match[i] == best]
@@ -368,6 +375,73 @@ class DataParallelEngines:
         if pin is not None:
             return pin
         return min(routable, key=self._load)
+
+    def _probe_matches(
+        self, routable: List[int], prompt_ids: List[int]
+    ) -> Dict[int, int]:
+        """Per-replica radix-probe results, memoized for the shared head.
+
+        Soundness: a replica's memoized match may be reused only while its
+        prefix-cache generation is unchanged (identical tree contents),
+        the new prompt still starts with the memoized matched run (every
+        per-replica match is a prefix of the deepest one, so one O(match)
+        list compare per SUBMIT validates all replicas at once), and the
+        memoized match ended strictly INSIDE the run — such a match hit a
+        tree divergence inside tokens the new prompt shares, so it is
+        exact for the new prompt too.  A match that reached the END of
+        the run proves nothing about this prompt's different continuation
+        (the old walk may have been stopped by the old prompt's content
+        or page cap where the tree goes deeper), so the deepest-match
+        replica re-probes every submit: per submit the memo costs one
+        O(match) walk for the warmest replica and O(1) for every other,
+        instead of O(match) x dp.  Anything else re-probes that replica
+        and refreshes the memo.
+        """
+        pcs = {i: self.engines[i].prefix_cache for i in routable}
+        if any(pc is None for pc in pcs.values()):
+            return {
+                i: (pc.match_tokens(prompt_ids) if pc is not None else 0)
+                for i, pc in pcs.items()
+            }
+        ps = next(iter(pcs.values())).pool.page_size
+        if len(prompt_ids) <= ps:
+            # sub-page prompt: nothing matchable beyond the head anyway
+            return {i: pc.match_tokens(prompt_ids) for i, pc in pcs.items()}
+        head = tuple(prompt_ids[:ps])
+        memo = self._probe_memo.get(head)
+        out: Dict[int, int] = {}
+        if memo is not None:
+            run = memo["tokens"]
+            L = len(run)
+            if len(prompt_ids) > L and list(prompt_ids[:L]) == run:
+                for i in routable:
+                    if memo["gens"].get(i) != pcs[i].generation:
+                        continue  # cache mutated: re-probe
+                    cached = memo["matches"].get(i)
+                    if cached is None:
+                        continue
+                    if L > 0 and cached >= L:
+                        # the memoized walk consumed the WHOLE run: the
+                        # tree may continue past it where the old prompt
+                        # diverged or was cap-cut, and this prompt's
+                        # continuation could match deeper — re-probe.
+                        # (L == 0 stays reusable: that walk failed on the
+                        # head page itself, which the memo key shares.)
+                        continue
+                    out[i] = cached
+        for i in routable:
+            if i not in out:
+                out[i] = pcs[i].match_tokens(prompt_ids)
+        best = max(out.values(), default=0)
+        self._probe_memo[head] = {
+            "tokens": list(prompt_ids[:best]),
+            "gens": {i: pcs[i].generation for i in routable},
+            "matches": dict(out),
+        }
+        self._probe_memo.move_to_end(head)
+        while len(self._probe_memo) > self._probe_memo_cap:
+            self._probe_memo.popitem(last=False)
+        return out
 
     def submit(self, req: GenRequest) -> None:
         idx = self._pick(req)
@@ -472,6 +546,7 @@ class DataParallelEngines:
         # replica indices changed meaning: stale pins/routes must not leak
         self._affinity.clear()
         self._route.clear()
+        self._probe_memo.clear()
         for req in sorted(pending, key=lambda r: r.submit_time):
             j = min(range(dp), key=lambda t: len(self.engines[t].waiting))
             self.engines[j].adopt(req)
@@ -548,14 +623,48 @@ class _AggregateMetrics:
             "depth": sum(s["queue"]["depth"] for s in snaps),
             "peak": max(s["queue"]["peak"] for s in snaps),
         }
+        gen = sum(s["tokens"]["generated"] for s in snaps)
+        wasted = sum(s["tokens"]["fetch_pipeline_wasted"] for s in snaps)
         agg["tokens"] = {
             "prompt": sum(s["tokens"]["prompt"] for s in snaps),
-            "generated": sum(s["tokens"]["generated"] for s in snaps),
+            "generated": gen,
             # rates sum across replicas (each is tokens over the same wall
             # clock), ratios do not — recompute anything derived
             "generated_per_s": round(
                 sum(s["tokens"]["generated_per_s"] for s in snaps), 2
             ),
+            "fetch_pipeline_wasted": wasted,
+            "fetch_pipeline_waste_frac": round(
+                wasted / (gen + wasted), 4
+            ) if (gen + wasted) else 0.0,
+        }
+        # deprecated aliases (one release — see runtime/metrics.py)
+        agg["tokens"]["speculative_wasted"] = wasted
+        agg["tokens"]["speculative_waste_frac"] = \
+            agg["tokens"]["fetch_pipeline_waste_frac"]
+        # speculative decoding: counters sum, rates recompute.  Summed
+        # from the SAME snaps as the exported per-replica detail so the
+        # aggregate always equals the sum of agg["replicas"] within one
+        # scrape (live re-reads could disagree)
+        prop = sum(s["speculation"]["speculation_proposed_tokens"]
+                   for s in snaps)
+        acc = sum(s["speculation"]["speculation_accepted_tokens"]
+                  for s in snaps)
+        rej = sum(s["speculation"]["speculation_rejected_tokens"]
+                  for s in snaps)
+        steps_v = sum(s["speculation"]["speculation_verify_steps"]
+                      for s in snaps)
+        agg["speculation"] = {
+            "speculation_proposed_tokens": prop,
+            "speculation_accepted_tokens": acc,
+            "speculation_rejected_tokens": rej,
+            "speculation_verify_steps": steps_v,
+            "speculation_acceptance_rate": round(
+                acc / (acc + rej), 4
+            ) if (acc + rej) else 0.0,
+            "speculation_accepted_per_step": round(
+                acc / steps_v, 3
+            ) if steps_v else 0.0,
         }
         # latency percentiles cannot be combined from per-replica
         # percentiles — pool the raw samples and recompute

@@ -75,6 +75,7 @@ from .kv_cache import (
 )
 from .metrics import EngineMetrics
 from .prefix_cache import PrefixCache
+from .speculative import LaneSpeculator
 from .tracing import add_event, profiler_annotations_enabled, record_span
 
 logger = logging.getLogger("kafka_tpu.engine")
@@ -182,6 +183,17 @@ class EngineConfig:
     # waiting queue holds this many requests (0 = unbounded).  The serving
     # layer surfaces it as HTTP 429 + Retry-After.
     max_waiting: int = 0
+    # Draft-free speculative decoding (runtime/speculative.py): up to K
+    # n-gram prompt-lookup candidates per lane are verified in ONE
+    # [B, K+1]-query device dispatch — each accepted run amortizes one
+    # weight-stream over several tokens (decode is HBM-bound).  0 (the
+    # default) disables it completely: no verify program is built and the
+    # dispatch paths are byte-for-byte the non-speculative ones.  Greedy
+    # output is bit-identical to plain decode and sampled output follows
+    # the target distribution at any temperature (exact-match acceptance
+    # with the sequential path's own per-(seed, position) keys).  Does not
+    # compose with sp/pp meshes yet (validated at construction).
+    speculative_k: int = 0
 
     @property
     def max_window(self) -> int:
@@ -279,6 +291,14 @@ class GenRequest:
     # per-chunk slices.  None = text-only request.
     override_pos: Optional[Any] = None   # np [K] int32
     override_rows: Optional[Any] = None  # np [K, H] float
+    # Speculative decoding (EngineConfig.speculative_k > 0): the lane's
+    # n-gram proposer + acceptance EWMA (runtime/speculative.py), created
+    # at submit.  spec_ahead > 0 while a verify dispatch for this lane is
+    # in flight — the lane's host seq.length/dispatched are then
+    # confirmed-only (the actual advance, 1..K+1 tokens, reconciles at
+    # drain) and the lane is masked out of every dispatch until it drains.
+    spec: Optional[LaneSpeculator] = None
+    spec_ahead: int = 0
 
     @property
     def cached_len(self) -> int:
@@ -295,6 +315,20 @@ class TokenEvent:
     finish_reason: Optional[str] = None
 
 
+@dataclasses.dataclass
+class _SpecMeta:
+    """Per-lane candidate widths of one speculative verify dispatch.
+
+    cand_lens[i] == 0 marks a RIDER lane: it rode the verify program
+    masked down to ordinary 1-token decode and keeps the plain path's
+    at-dispatch accounting.  cand_lens[i] > 0 marks a PROPOSER: its
+    actual advance (accepted+1 tokens) is only known at drain, so its
+    host accounting reconciles there (engine._finish_verify_entry)."""
+
+    cand_lens: List[int]
+    width: int  # K + 1 sample columns per lane in the fetched array
+
+
 @dataclasses.dataclass(eq=False)  # identity semantics (list.remove / `is`)
 class _Fetch:
     """One in-flight sampled-token transfer awaiting host processing.
@@ -305,6 +339,11 @@ class _Fetch:
     scalar and `items` has one entry.  `final` is per step then per lane:
     `final[j][i]` marks the request's last dispatched token (it hit a
     length/window limit at dispatch time) with its finish reason.
+
+    Speculative verify dispatches set `spec`: `arr` is then [B, K+2]
+    (K+1 samples + the accepted count per lane), `steps` counts the
+    dispatch's candidate-token width in the fetch_lag FIFO, and `final`
+    holds one row covering only the rider lanes.
     """
 
     arr: jnp.ndarray
@@ -316,6 +355,7 @@ class _Fetch:
     # async host copy starts at compute completion and lands ~RTT later —
     # t_ready + rtt_est is when popping becomes non-blocking
     t_ready: Optional[float] = None
+    spec: Optional[_SpecMeta] = None
 
 
 class InferenceEngine:
@@ -426,6 +466,21 @@ class InferenceEngine:
                         f"({per_shard_heads}) divisible by sp={sp}; use "
                         "cp_strategy='ring'"
                     )
+        if self.ecfg.speculative_k < 0:
+            raise ValueError("speculative_k must be >= 0 (0 disables)")
+        if self.ecfg.speculative_k > 0:
+            if sp > 1 or self._pp > 1:
+                raise ValueError(
+                    "speculative decoding (speculative_k>0) does not "
+                    "compose with sp/pp meshes yet: the verify step's "
+                    "K+1-query attention takes the single-chunk paged "
+                    "path (tp/tq/dp compose)"
+                )
+            if self.ecfg.speculative_k + 2 > self.ecfg.max_window:
+                raise ValueError(
+                    f"speculative_k={self.ecfg.speculative_k} does not fit "
+                    f"the attention window ({self.ecfg.max_window})"
+                )
         if (
             self.ecfg.attention_backend == "pallas"
             and mesh is not None
@@ -545,6 +600,10 @@ class InferenceEngine:
         # text-only chunks) — see _zero_override
         self._zero_ov_cache: Dict[Tuple, Tuple[Any, Any]] = {}
         self._decode_fn = self._build_decode_fn()
+        # speculative verify program, built lazily on the FIRST proposal
+        # (speculative_k=0 engines never compile it — hard acceptance
+        # criterion for the default-off path)
+        self._verify_fn: Optional[Callable] = None
         self._counter = itertools.count()
         # device-resident decode control state (see module docstring)
         self._d_last = self._dev(np.zeros(B, np.int32))
@@ -901,6 +960,106 @@ class InferenceEngine:
         _FN_CACHE[cache_key] = jitted
         return jitted
 
+    def _get_verify_fn(self):
+        """The speculative verify program: advance every lane 1..K+1 tokens
+        in ONE dispatch (EngineConfig.speculative_k).
+
+        A [B, K+1]-query forward over the paged pool — the batched-prefill
+        attention formulation with per-query causal masking (on pallas
+        backends models/llama.py routes it to the K+1-query paged verify
+        kernel; elsewhere the page-granular XLA gather).  Non-proposing
+        lanes run with cand_len 0: position 0 is their ordinary decode
+        step and the K candidate positions write the trash page — same
+        compiled program whatever the batch mix, nothing recompiles.
+
+        Every position samples with the sequential decode path's OWN
+        per-(seed, position) key, and acceptance keeps candidates exactly
+        while `sample == candidate` — the emitted tokens ARE the
+        sequential path's samples, so greedy is bit-identical and sampled
+        output follows the target distribution at any temperature (the
+        exact-match special case of Leviathan rejection sampling for a
+        point-mass draft).  Rejected-tail KV is rolled back by clamping
+        the returned seq_lens to the accepted length: stale KV past it is
+        masked by kv_valid in later steps and overwritten when those
+        positions are next written.
+        """
+        if self._verify_fn is not None:
+            return self._verify_fn
+        cfg, ecfg, mesh = self.cfg, self.ecfg, self.mesh
+        ps, C, B = ecfg.page_size, ecfg.max_window, ecfg.max_batch
+        K = ecfg.speculative_k
+        S = K + 1
+        cache_key = ("verify", cfg, ps, C, B, self.mesh, K)
+        if cache_key in _FN_CACHE:
+            self._verify_fn = _FN_CACHE[cache_key]
+            return self._verify_fn
+
+        def fn(params, k_pool, v_pool, page_table, last_tokens, seq_lens,
+               active, temps, top_ks, top_ps, seeds, cands, cand_lens):
+            # inputs per lane: [last_token, c_1..c_K] at positions
+            # seq_len..seq_len+K; positions past cand_len are garbage
+            # lanes' padding and write the trash page
+            toks_in = jnp.concatenate([last_tokens[:, None], cands], axis=1)
+            local = jnp.arange(S)[None, :]
+            pos = seq_lens[:, None] + local  # [B, S]
+            in_run = (local <= cand_lens[:, None]) & active[:, None]
+            page_idx = jnp.take_along_axis(
+                page_table,
+                jnp.minimum(pos // ps, page_table.shape[1] - 1),
+                axis=1,
+            )
+            write_idx = jnp.where(
+                in_run, page_idx * ps + pos % ps, local % ps
+            )
+            read_idx = (
+                page_table[:, :, None] * ps + jnp.arange(ps)[None, None, :]
+            ).reshape(B, C)
+            kv_positions = jnp.broadcast_to(jnp.arange(C)[None, :], (B, C))
+            kv_valid = (
+                kv_positions <= (seq_lens + cand_lens)[:, None]
+            ) & active[:, None]
+            paged = PagedView(
+                write_idx, read_idx, kv_positions, kv_valid,
+                page_table=page_table, seq_lens=seq_lens, page_size=ps,
+                chunk_len=cand_lens + 1,
+            )
+            logits, cache = forward(
+                params, cfg, toks_in, pos,
+                kv_cache=KVCache(k_pool, v_pool), paged=paged, mesh=mesh,
+            )  # [B, S, V]
+            # per-(seed, position) keys — IDENTICAL to the keys the
+            # sequential decode path folds for these positions
+            keys = jax.vmap(
+                lambda s, prow: jax.vmap(
+                    lambda p: jax.random.fold_in(jax.random.key(s), p)
+                )(prow)
+            )(seeds, pos)
+            V = logits.shape[-1]
+            rep = lambda x: jnp.repeat(x, S)
+            samples = sample_tokens_per_slot(
+                logits.reshape(B * S, V),
+                SamplingParams(rep(temps), rep(top_ks), rep(top_ps)),
+                keys.reshape(B * S),
+                None,
+            ).reshape(B, S)
+            # longest exactly-matching candidate prefix, then the bonus
+            # token (the sample after the last accepted candidate)
+            good = (samples[:, :K] == cands) & (
+                jnp.arange(K)[None, :] < cand_lens[:, None]
+            )
+            m = jnp.sum(jnp.cumprod(good.astype(jnp.int32), axis=1), axis=1)
+            adv = jnp.where(active, m + 1, 0)
+            new_lens = seq_lens + adv  # rejected-tail KV rolled back here
+            bonus = jnp.take_along_axis(samples, m[:, None], axis=1)[:, 0]
+            new_last = jnp.where(active, bonus, last_tokens)
+            out = jnp.concatenate([samples, m[:, None]], axis=1)  # [B, S+1]
+            return cache.k, cache.v, out, new_last, new_lens
+
+        jitted = jax.jit(fn, donate_argnums=(1, 2))
+        _FN_CACHE[cache_key] = jitted
+        self._verify_fn = jitted
+        return jitted
+
     def _get_prefill_fn(self, bucket: int):
         if bucket in self._prefill_fns:
             return self._prefill_fns[bucket]
@@ -996,11 +1155,44 @@ class InferenceEngine:
             # it can wrap the JSON up before tokens run out
             req.logits_mask_fn.set_budget(req.max_new_tokens)
         req.prefill_ids = list(req.prompt_ids)
+        if (
+            self.ecfg.speculative_k > 0
+            and req.logits_mask_fn is None
+            and req.spec is None
+        ):
+            # constrained lanes never speculate: their masks need per-token
+            # host turnaround, the opposite of a K-token device run
+            req.spec = LaneSpeculator(req.prompt_ids)
         req.submit_time = time.monotonic()
         self.metrics.record_submit(len(req.prompt_ids))
         req.state = WAITING
         self.waiting.append(req)
         self._requests[req.request_id] = req
+
+    def warmup_verify(self) -> None:
+        """Compile the speculative verify program outside serving.
+
+        Organic engagement depends on *generated* repetition, which a
+        warm prompt cannot guarantee, so server warmup triggers the
+        compile with an all-inactive dispatch: every write is masked to
+        the trash page, seq_lens don't advance, and no scheduler state
+        changes.  No-op when speculative_k is 0 (the program must never
+        exist then)."""
+        if self.ecfg.speculative_k <= 0:
+            return
+        B, K = self.ecfg.max_batch, self.ecfg.speculative_k
+        if self._d_table is None or self._ctl_dirty:
+            self._refresh_ctl()
+        fn = self._get_verify_fn()
+        (self.k_pool, self.v_pool, out, self._d_last, self._d_seq_lens) = fn(
+            self.params, self.k_pool, self.v_pool,
+            self._d_table, self._d_last, self._d_seq_lens,
+            self._dev(np.zeros(B, bool)),
+            self._d_temps, self._d_top_ks, self._d_top_ps, self._d_seeds,
+            self._arg(np.zeros((B, K), np.int32)),
+            self._arg(np.zeros(B, np.int32)),
+        )
+        np.asarray(out)  # block until the compile + dispatch complete
 
     def take_waiting(self) -> List[GenRequest]:
         """Remove and return every WAITING request (they own no device
@@ -1273,6 +1465,7 @@ class InferenceEngine:
                 if req.seq is not None:
                     self.pool.free_sequence(req.seq)
                     req.seq = None
+                req.spec_ahead = 0  # any in-flight verify was discarded
                 if req not in self.waiting:
                     self.waiting.append(req)
                 continue
@@ -1328,8 +1521,20 @@ class InferenceEngine:
                 within_lag = self._pending_steps <= self.ecfg.fetch_lag
                 now = time.monotonic()
                 aged = now - entry.t0 >= wait
+                landed = (
+                    entry.t_ready is not None
+                    and now - entry.t_ready >= self._rtt_est
+                )
                 if within_lag and not aged:
-                    break
+                    # Speculation trades a little host batching for
+                    # context freshness: a lane can only propose its next
+                    # candidate run once its history is fully drained, so
+                    # with speculative_k on, LANDED entries pop
+                    # immediately (popping a landed transfer never blocks
+                    # the dispatch thread — the age bound exists to avoid
+                    # blocking, not to delay free pops).
+                    if not (self.ecfg.speculative_k > 0 and landed):
+                        break
                 # Aged is necessary but not sufficient: the host dispatch
                 # loop runs several entries ahead of device execution, so
                 # an aged entry may not have EXECUTED yet — and even once
@@ -1342,10 +1547,7 @@ class InferenceEngine:
                 # has been observed compute-done for ~an RTT (the copy
                 # has landed; np.asarray is then free); the fetch_lag
                 # depth bound still force-pops as the memory backstop.
-                if within_lag and (
-                    entry.t_ready is None
-                    or now - entry.t_ready < self._rtt_est
-                ):
+                elif within_lag and not landed:
                     break
             popped = self._pending.pop(0)
             self._pending_steps -= popped.steps
@@ -1421,7 +1623,7 @@ class InferenceEngine:
         """Materialize one fetch (blocks if the transfer hasn't landed).
         Returns the number of tokens processed."""
         t0 = time.monotonic()
-        vals = np.asarray(entry.arr).reshape(entry.steps, -1)
+        raw = np.asarray(entry.arr)
         now = time.monotonic()
         if now - t0 > 0.001:
             # The transfer hadn't landed when we popped.  dispatch→landed
@@ -1438,6 +1640,9 @@ class InferenceEngine:
                     0.9 * self._rtt_est + 0.1 * sample,
                     max(2.0 * self._rtt_probe, 0.001),
                 )
+        if entry.spec is not None:
+            return self._finish_verify_entry(entry, raw)
+        vals = raw.reshape(entry.steps, -1)
         n = 0
         for j in range(entry.steps):
             row = vals[j]
@@ -1456,6 +1661,73 @@ class InferenceEngine:
                 )
         return n
 
+    def _finish_verify_entry(self, entry: _Fetch, raw: np.ndarray) -> int:
+        """Drain one speculative verify dispatch: reconcile each proposing
+        lane's host accounting to the ACTUAL accepted run (the device
+        already clamped seq_lens/last_tokens at dispatch) and emit the
+        1..K+1 tokens through the normal per-token path (stop detection,
+        TTFT, metrics).  Rider lanes (cand_len 0) drain exactly like a
+        plain decode row."""
+        meta = entry.spec
+        vals = raw.reshape(len(entry.items), meta.width + 1)
+        finals = entry.final[0]
+        n = 0
+        for i, req in enumerate(entry.items):
+            if req is None:
+                continue
+            row = vals[i]
+            cl = meta.cand_lens[i]
+            if cl == 0:
+                # rider: one ordinary decode token (at-dispatch accounting)
+                if req.state == FINISHED:
+                    self.metrics.record_wasted_token()
+                    continue
+                n += 1
+                self._process_token(req, int(row[0]), finals[i])
+                continue
+            m = int(row[meta.width])  # accepted candidates (0..cl)
+            req.spec_ahead = 0
+            if req.state == FINISHED:
+                # cancelled/timed out while the verify was in flight: the
+                # whole run is discarded — candidates all count rejected
+                # (monotone identity proposed == accepted+rejected+inflight)
+                # and the would-be emissions are fetch-pipeline waste
+                self.metrics.record_verify_drain(0, cl)
+                self.metrics.record_wasted_token(m + 1)
+                continue
+            emit = m + 1  # accepted run + the bonus token
+            old_len, old_disp = req.seq.length, req.dispatched
+            req.seq.length += emit
+            req.dispatched += emit
+            self.metrics.record_verify_drain(m, cl - m)
+            if req.spec is not None:
+                req.spec.observe(m, cl)
+            if req.trace is not None:
+                now_mono = time.monotonic()
+                prev = (req.trace_last_t or req.t_first_dispatch
+                        or now_mono)
+                record_span(
+                    req.trace, "engine.decode", now_mono - prev,
+                    attrs=self._tattrs(steps=1, proposed=cl, accepted=m),
+                )
+                req.trace_last_t = now_mono
+            for j in range(emit):
+                # host-known limits, applied with sequential semantics: a
+                # budget/window boundary inside the accepted run truncates
+                # it exactly where single-step dispatching would have
+                final = None
+                if old_disp + j + 1 >= req.max_new_tokens:
+                    final = "length"
+                elif old_len + j + 2 >= self.ecfg.max_window:
+                    final = "length"
+                n += 1
+                self._process_token(req, int(row[j]), final)
+                if req.state == FINISHED:
+                    # stop/limit cut the run short: the rest is discarded
+                    self.metrics.record_wasted_token(emit - (j + 1))
+                    break
+        return n
+
     def _process_token(self, req: GenRequest, token: int,
                        final_reason: Optional[str]) -> None:
         req.drained += 1
@@ -1467,6 +1739,8 @@ class InferenceEngine:
                 f"constrained prediction diverged: {expected} != {token}"
             )
         req.output_ids.append(token)
+        if req.spec is not None:
+            req.spec.push(token)  # keep the n-gram index tail-accurate
         if req.first_token_time is None:
             req.first_token_time = time.monotonic()
             self.metrics.record_first_token(
@@ -2059,35 +2333,58 @@ class InferenceEngine:
     def _dispatch_decode(self) -> None:
         ecfg = self.ecfg
 
-        # grow pages for sequences about to write past their capacity
+        # grow pages for sequences about to write past their capacity.
+        # Lanes with an in-flight verify dispatch are skipped: their host
+        # seq.length is confirmed-only (stale-low) and their pages were
+        # already grown to cover the whole speculative span at dispatch.
         for req in list(s for s in self.slots if s is not None):
-            if req.state != ACTIVE or req.seq is None:
+            if req.state != ACTIVE or req.seq is None or req.spec_ahead:
                 continue  # already preempted/retired by an earlier iteration
             if self._ensure_pages(req):
                 continue
 
         # PREFILLING lanes are masked out of decode entirely (they are
         # mid-chunk; their seq state must not be touched by decode
-        # bookkeeping)
+        # bookkeeping).  So are lanes awaiting a speculative verify drain
+        # (spec_ahead > 0; always 0 with speculative_k=0): dispatching
+        # them again before the drain would double-advance their state.
         active_slots = [
-            s for s in self.slots if s is not None and s.state == ACTIVE
+            s for s in self.slots
+            if s is not None and s.state == ACTIVE and s.spec_ahead == 0
         ]
+        spec_wait = any(
+            s is not None and s.state == ACTIVE and s.spec_ahead > 0
+            for s in self.slots
+        )
         if not active_slots:
             return
-        k = self._pick_multi_step(active_slots)
+        if self.ecfg.speculative_k > 0 and self._try_dispatch_verify(
+            active_slots
+        ):
+            return
+        k = 1 if spec_wait else self._pick_multi_step(active_slots)
         if k > 1:
             self._dispatch_multi(k)
             return
         if self._ctl_dirty:
             self._refresh_ctl()
         full_batch = [
-            s if (s is not None and s.state == ACTIVE) else None
+            s if (s is not None and s.state == ACTIVE
+                  and s.spec_ahead == 0) else None
             for s in self.slots
         ]
         if all(s.logits_mask_fn is None for s in active_slots):
             # common case: every decodable lane is unconstrained + pipelined
-            self._dispatch_group(full_batch, self._d_active, None,
-                                 full=True)
+            if spec_wait:
+                # _d_active marks spec-waiting lanes active; mask them out
+                # with an explicit group mask for this dispatch
+                d_act = self._dev(
+                    np.array([m is not None for m in full_batch])
+                )
+                self._dispatch_group(full_batch, d_act, None, full=False)
+            else:
+                self._dispatch_group(full_batch, self._d_active, None,
+                                     full=True)
             self.metrics.record_decode_step(len(active_slots))
             return
         # Mixed/constrained batch.  A constrained lane's next mask depends on
@@ -2101,6 +2398,7 @@ class InferenceEngine:
         # now-complete output_ids and redispatch.
         uncon = [
             s if (s is not None and s.state == ACTIVE
+                  and s.spec_ahead == 0
                   and s.logits_mask_fn is None) else None
             for s in self.slots
         ]
@@ -2233,6 +2531,178 @@ class InferenceEngine:
             self.metrics.record_decode_step(
                 n_uncon + n_chain + n_amb_dispatched
             )
+
+    def _assert_private_tail(self, req: GenRequest, cl: int) -> None:
+        """Speculative writes only ever land in the lane's PRIVATE tail
+        pages — never in radix-shared prefix pages (PR 4 invariant).  The
+        verify step writes positions seq_len..seq_len+cl; every page in
+        that span must be solely owned by this sequence (refcount 1) and
+        unknown to the prefix cache.  This holds by construction (cache
+        lookups share only whole pages strictly before the prefill resume
+        point, and store() only retains pages at finish), so the assert is
+        a cheap tripwire over a handful of tail pages per dispatch."""
+        ps = self.ecfg.page_size
+        first = req.seq.length // ps
+        last = (req.seq.length + cl) // ps
+        pages = req.seq.pages[first:last + 1]
+        assert all(int(self.pool.refcount[p]) == 1 for p in pages), (
+            f"speculative write span of {req.request_id} covers shared "
+            f"pages {[p for p in pages if self.pool.refcount[p] != 1]}"
+        )
+        assert self.prefix_cache is None or not \
+            self.prefix_cache.owns_any(pages), (
+                f"speculative write span of {req.request_id} covers "
+                "radix-cached pages"
+            )
+
+    def _try_dispatch_verify(self, lanes: List[GenRequest]) -> bool:
+        """Propose + dispatch one [B, K+1] speculative verify step.
+
+        Returns False when no lane has a usable candidate run this
+        iteration (the plain decode paths then dispatch exactly as
+        without speculation).  A lane proposes only when its token history
+        is fully drained (the n-gram anchor must be the true tail) and
+        its acceptance EWMA hasn't throttled it; candidate runs are
+        clamped so even a fully-accepted run stays inside the token
+        budget and the attention window.  Lanes without proposals ride
+        the same dispatch as ordinary 1-token decode (cand_len 0) and
+        keep the plain path's at-dispatch accounting.
+        """
+        ecfg = self.ecfg
+        K = ecfg.speculative_k
+        proposals: Dict[int, List[int]] = {}
+        for s in lanes:
+            if (
+                s.spec is None
+                or s.logits_mask_fn is not None
+                or s.dispatched != s.drained
+            ):
+                continue
+            room = min(
+                K,
+                s.max_new_tokens - s.dispatched - 1,
+                ecfg.max_window - 2 - s.seq.length,
+            )
+            cands = s.spec.propose(room)
+            if cands:
+                proposals[id(s)] = [int(c) for c in cands]
+        if not proposals:
+            return False
+        # grow pages to cover each proposer's whole speculative span
+        # (positions seq_len..seq_len+cl) BEFORE the ctl refresh; riders
+        # already got their +1 from the _dispatch_decode growth loop.  A
+        # page-blocked proposal shrinks to a plain ride rather than
+        # invoking the preemption machinery for speculative work.
+        for s in lanes:
+            cands = proposals.get(id(s))
+            if not cands:
+                continue
+            try:
+                if self.pool.ensure_capacity(
+                    s.seq, s.seq.length + len(cands) + 1
+                ):
+                    self._ctl_dirty = True
+            except OutOfPagesError:
+                # reclaim() takes PAGES: evicting a candidate-count of
+                # pages would cold-start other threads' warm prefixes for
+                # a span that needs at most a page or two
+                pages_short = (
+                    -(-(s.seq.length + len(cands) + 1) // ecfg.page_size)
+                    - len(s.seq.pages)
+                )
+                if not (
+                    self.prefix_cache is not None
+                    and self.prefix_cache.reclaim(max(1, pages_short))
+                ):
+                    proposals.pop(id(s))
+                    continue
+                try:
+                    if self.pool.ensure_capacity(
+                        s.seq, s.seq.length + len(cands) + 1
+                    ):
+                        self._ctl_dirty = True
+                except OutOfPagesError:
+                    proposals.pop(id(s))
+        if not proposals:
+            return False
+        if self._ctl_dirty:
+            self._refresh_ctl()
+        B = ecfg.max_batch
+        members: List[Optional[GenRequest]] = [None] * B
+        for s in lanes:
+            # Constrained lanes NEVER ride a verify dispatch: the verify fn
+            # samples every position with allowed_mask=None, so a riding
+            # constrained lane would emit grammar-violating tokens (and a
+            # lane awaiting its constrained micro-batch fetch would be
+            # double-advanced).  They sit this iteration out and dispatch
+            # through the mixed path next iteration, exactly at the fetch
+            # cadence they already run at.
+            if s.logits_mask_fn is None:
+                members[s.slot] = s
+        cand_arr = np.zeros((B, K), np.int32)
+        cand_lens = [0] * B
+        n_proposed = 0
+        for s in lanes:
+            cands = proposals.get(id(s))
+            if not cands:
+                continue
+            cl = len(cands)
+            cand_arr[s.slot, :cl] = cands
+            cand_lens[s.slot] = cl
+            n_proposed += cl
+            self._assert_private_tail(s, cl)
+            s.spec_ahead = cl + 1
+        d_act = self._dev(np.array([m is not None for m in members]))
+        fn = self._get_verify_fn()
+        with self._dispatch_scope(members):
+            (self.k_pool, self.v_pool, out, new_last, new_lens) = fn(
+                self.params, self.k_pool, self.v_pool,
+                self._d_table, self._d_last, self._d_seq_lens, d_act,
+                self._d_temps, self._d_top_ks, self._d_top_ps,
+                self._d_seeds,
+                self._arg(cand_arr),
+                self._arg(np.asarray(cand_lens, np.int32)),
+            )
+        # device-resident truth: the fn already clamped per-lane advances
+        # to the accepted length and kept inactive lanes' values
+        self._d_last = new_last
+        self._d_seq_lens = new_lens
+        out.copy_to_host_async()
+        self._step_count += 1
+        finals: List[Optional[str]] = [None] * B
+        now_mono: Optional[float] = None
+        busy = sum(1 for m in members if m is not None)
+        for i, req in enumerate(members):
+            if req is None or cand_lens[i] > 0:
+                continue  # proposers: accounting + span at drain
+            req.seq.length += 1
+            req.dispatched += 1
+            finals[i] = self._limit_reason_after_dispatch(req)
+            if req.trace is not None:
+                if now_mono is None:
+                    now_mono = time.monotonic()
+                record_span(
+                    req.trace, "engine.decode",
+                    now_mono - (req.trace_last_t or req.t_first_dispatch
+                                or now_mono),
+                    attrs=self._tattrs(steps=1, busy=busy),
+                )
+                req.trace_last_t = now_mono
+        entry = _Fetch(
+            arr=out, items=list(members), final=[finals],
+            t0=time.monotonic(),
+            # the FIFO depth bound is in tokens-per-dispatch: a verify
+            # entry counts its candidate width (ISSUE 5)
+            steps=max(cand_lens) + 1,
+            spec=_SpecMeta(cand_lens=cand_lens, width=K + 1),
+        )
+        self._push_entry(entry)
+        for req, fin in zip(members, finals):
+            if req is not None and fin is not None:
+                self._to_draining(req)
+        self.metrics.record_decode_step(busy)
+        self.metrics.record_verify_dispatch(n_proposed)
+        return True
 
     def _pick_multi_step(self, active_slots: List[GenRequest]) -> int:
         """How many decode steps to fuse into the next dispatch.
@@ -2482,10 +2952,24 @@ class InferenceEngine:
         self._d_table = self._dev(page_table_array(
             [s.seq if s else None for s in slots], self.ecfg.max_pages_per_seq
         ))
-        self._d_seq_lens = self._dev(np.array(
+        host_lens = self._dev(np.array(
             [s.seq.length if s is not None and s.seq else 0 for s in slots],
             np.int32,
         ))
+        keep = [
+            s is not None and s.state == ACTIVE and s.spec_ahead > 0
+            for s in slots
+        ]
+        if any(keep):
+            # lanes with an in-flight verify dispatch: the device value is
+            # the truth-after-dispatch (the verify fn clamped it to the
+            # accepted length); host seq.length is confirmed-only until
+            # the entry drains — re-uploading it would roll the lane back
+            self._d_seq_lens = jnp.where(
+                self._dev(np.array(keep)), self._d_seq_lens, host_lens
+            )
+        else:
+            self._d_seq_lens = host_lens
         self._d_active = self._dev(np.array(
             [s is not None and s.state == ACTIVE for s in slots], bool
         ))
@@ -2582,6 +3066,10 @@ class InferenceEngine:
         assert victim.dispatched == victim.drained, (
             "preempt victim has unprocessed dispatched tokens"
         )
+        # a drained pipeline implies every verify entry reconciled; the
+        # victim's n-gram history survives preemption (outputs never
+        # rewind), so speculation resumes cleanly after re-prefill
+        victim.spec_ahead = 0
         self._release_slot(victim)
         # Re-prefill later over prompt + generated-so-far, derived from the
         # immutable prompt (idempotent across repeated preemptions). The

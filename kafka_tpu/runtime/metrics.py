@@ -18,6 +18,20 @@ import dataclasses
 import time
 from typing import Deque, Dict, List, Optional
 
+# Speculative-decoding metric keys (ISSUE 5).  Registry shared with the
+# Prometheus exposition layer the same way failpoints.SITES / tracing.SPANS
+# are: a static test asserts every name here appears in BOTH
+# runtime/metrics.py (this snapshot) and server/prometheus.py (the text
+# format), and that neither file invents speculation metrics outside it.
+SPECULATION_METRIC_KEYS = (
+    "speculation_proposed_tokens",
+    "speculation_accepted_tokens",
+    "speculation_rejected_tokens",
+    "speculation_verify_steps",
+    "speculation_acceptance_rate",
+    "speculation_accepted_per_step",
+)
+
 
 def _copy_samples(dq) -> List[float]:
     """Snapshot a histogram deque that another thread may be appending to.
@@ -95,9 +109,22 @@ class EngineMetrics:
     decode_busy_slots: int = 0  # sum over steps -> occupancy = /steps/B
     # Tokens dispatched for a lane whose request was already finished when
     # the fetch matured (stop token discovered in flight, or a cancel) —
-    # the cost of the pipelined/fused speculative dispatch.  These occupied
-    # batch slots; wasted/(generated+wasted) is the throughput tax.
-    speculative_wasted_tokens: int = 0
+    # the cost of the pipelined/fused dispatch running ahead of drain.
+    # These occupied batch slots; wasted/(generated+wasted) is the
+    # throughput tax.  RENAMED from speculative_wasted_tokens (PR 5): this
+    # is FETCH-PIPELINE waste, not speculative-decoding waste — the old
+    # /metrics JSON keys survive one release as deprecated aliases.
+    fetch_pipeline_wasted_tokens: int = 0
+    # Real speculative decoding (draft-free n-gram proposals + batched
+    # verify, runtime/speculative.py + engine verify step).  proposed
+    # counts candidate tokens at dispatch; accepted/rejected reconcile at
+    # drain (a discarded entry counts all its candidates rejected), so
+    # proposed == accepted + rejected + in-flight and every counter stays
+    # monotone across preemption/rollback.
+    speculation_proposed_tokens: int = 0
+    speculation_accepted_tokens: int = 0
+    speculation_rejected_tokens: int = 0
+    speculation_verify_steps: int = 0  # verify dispatches (1 per step)
     # genuine constrained choice points that awaited a device->host round
     # trip (engine._dispatch_decode awaited micro-batch)
     constrained_roundtrips: int = 0
@@ -144,8 +171,18 @@ class EngineMetrics:
     def record_token(self) -> None:
         self.generated_tokens += 1
 
-    def record_wasted_token(self) -> None:
-        self.speculative_wasted_tokens += 1
+    def record_wasted_token(self, n: int = 1) -> None:
+        self.fetch_pipeline_wasted_tokens += n
+
+    def record_verify_dispatch(self, proposed: int) -> None:
+        """One verify step dispatched with `proposed` candidate tokens."""
+        self.speculation_verify_steps += 1
+        self.speculation_proposed_tokens += proposed
+
+    def record_verify_drain(self, accepted: int, rejected: int) -> None:
+        """One proposing lane's verify result reconciled at drain."""
+        self.speculation_accepted_tokens += accepted
+        self.speculation_rejected_tokens += rejected
 
     def record_decode_step(self, busy_slots: int, steps: int = 1) -> None:
         """steps>1 = a fused multi-step dispatch.  The gap between this
@@ -211,6 +248,27 @@ class EngineMetrics:
 
     # -- cross-thread export --------------------------------------------
 
+    def speculation_snapshot(self) -> Dict[str, object]:
+        """The speculative-decoding section (SPECULATION_METRIC_KEYS):
+        raw monotone counters plus the two derived rates dashboards want
+        (acceptance = accepted/proposed over drained rounds; accepted per
+        verify step = the amortization factor the weight-stream gains)."""
+        drained = (self.speculation_accepted_tokens
+                   + self.speculation_rejected_tokens)
+        return {
+            "speculation_proposed_tokens": self.speculation_proposed_tokens,
+            "speculation_accepted_tokens": self.speculation_accepted_tokens,
+            "speculation_rejected_tokens": self.speculation_rejected_tokens,
+            "speculation_verify_steps": self.speculation_verify_steps,
+            "speculation_acceptance_rate": round(
+                self.speculation_accepted_tokens / drained, 4
+            ) if drained else 0.0,
+            "speculation_accepted_per_step": round(
+                self.speculation_accepted_tokens
+                / self.speculation_verify_steps, 3
+            ) if self.speculation_verify_steps else 0.0,
+        }
+
     def snapshot(self, engine=None) -> Dict[str, object]:
         up = time.monotonic() - self._started
         snap: Dict[str, object] = {
@@ -233,13 +291,14 @@ class EngineMetrics:
                 "generated": self.generated_tokens,
                 "generated_per_s": round(self.generated_tokens / up, 2)
                 if up > 0 else 0.0,
-                "speculative_wasted": self.speculative_wasted_tokens,
-                "speculative_waste_frac": round(
-                    self.speculative_wasted_tokens
-                    / (self.generated_tokens + self.speculative_wasted_tokens),
+                "fetch_pipeline_wasted": self.fetch_pipeline_wasted_tokens,
+                "fetch_pipeline_waste_frac": round(
+                    self.fetch_pipeline_wasted_tokens
+                    / (self.generated_tokens
+                       + self.fetch_pipeline_wasted_tokens),
                     4,
                 ) if (self.generated_tokens
-                      + self.speculative_wasted_tokens) else 0.0,
+                      + self.fetch_pipeline_wasted_tokens) else 0.0,
             },
             "ttft_ms": {k: round(v, 2) for k, v in
                         _percentiles(_copy_samples(self.ttft_ms)).items()},
@@ -253,6 +312,7 @@ class EngineMetrics:
                 )
             },
             "constrained_roundtrips": self.constrained_roundtrips,
+            "speculation": self.speculation_snapshot(),
             "tpot_ms": {k: round(v, 2) for k, v in
                         _percentiles(_copy_samples(self.tpot_ms)).items()},
             "decode": {
@@ -272,6 +332,13 @@ class EngineMetrics:
                 },
             },
         }
+        # DEPRECATED aliases (one release, PR 5): the fetch-pipeline waste
+        # counters used to be exported as speculative_* — before real
+        # speculative decoding existed.  Dashboards keyed on the old names
+        # keep working while they migrate; README documents the rename.
+        tok = snap["tokens"]
+        tok["speculative_wasted"] = tok["fetch_pipeline_wasted"]
+        tok["speculative_waste_frac"] = tok["fetch_pipeline_waste_frac"]
         if engine is not None:
             snap["engine"] = {
                 "active": engine.num_active,

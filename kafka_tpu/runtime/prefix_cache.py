@@ -118,6 +118,18 @@ class PrefixCache:
         # root-anchored invariant invalidate()'s fast path walks — it then
         # degrades to a full-tree sweep (tree size is page-bounded).
         self._claims_capped = False
+        # Incremental page -> retain-count index mirroring the tree's
+        # holdings.  Two consumers: page_owners() (engine self_check) no
+        # longer walks the tree, and owns_any() answers the speculative-
+        # decoding write-span invariant ("verify writes never touch
+        # radix-shared pages") in O(span) per dispatch.
+        self._page_retains: Dict[int, int] = {}
+        # Content generation: bumped whenever the set of cached (token,
+        # page) runs changes (store of new pages, any eviction/removal).
+        # The DP router's probe memoization keys its per-replica
+        # match_tokens results on this — an unchanged generation means an
+        # identical radix walk result for an identical prompt head.
+        self.generation = 0
         # counters (observability + tests)
         self.hits = 0
         self.misses = 0
@@ -125,6 +137,7 @@ class PrefixCache:
         self.cross_thread_hits = 0  # hits whose deepest node another thread wrote
         self.evictions = 0  # nodes evicted under pressure (leaf-LRU + budget)
         self.pages_evicted = 0
+        self.probes = 0  # read-only match_tokens walks (router memo tests)
 
     # -- introspection ---------------------------------------------------
 
@@ -146,12 +159,29 @@ class PrefixCache:
 
     def page_owners(self) -> Dict[int, int]:
         """Per-page retain counts held by the tree (engine self_check:
-        these are legitimate owners alongside live sequences)."""
-        owners: Dict[int, int] = {}
-        for node in self._iter_nodes():
-            for p in node.pages:
-                owners[p] = owners.get(p, 0) + 1
-        return owners
+        these are legitimate owners alongside live sequences).  Served
+        from the incremental index — O(cached pages), no tree walk."""
+        return dict(self._page_retains)
+
+    def owns_any(self, pages: Sequence[int]) -> bool:
+        """Does the cache retain ANY of `pages`?  O(len(pages)) probe for
+        the speculative-decoding invariant (engine._assert_private_tail):
+        verify-step writes must never land in a radix-cached page."""
+        return any(p in self._page_retains for p in pages)
+
+    def _retain_pages(self, pages: Sequence[int]) -> None:
+        self.pool.retain(pages)
+        for p in pages:
+            self._page_retains[p] = self._page_retains.get(p, 0) + 1
+
+    def _release_pages(self, pages: Sequence[int]) -> None:
+        self.pool.release(pages)
+        for p in pages:
+            left = self._page_retains.get(p, 0) - 1
+            if left <= 0:
+                self._page_retains.pop(p, None)
+            else:
+                self._page_retains[p] = left
 
     def _claim(self, node: _Node, key: str) -> None:
         node.keys[key] = None
@@ -206,9 +236,11 @@ class PrefixCache:
 
     def match_tokens(self, prompt_ids: Sequence[int]) -> int:
         """Longest cached prefix in TOKENS — a read-only probe (no retains,
-        no LRU touch, no counters).  The DP router scores replicas with
-        this so cold threads land where their system prompt is already
-        hot (runtime/dp_router.py _pick)."""
+        no LRU touch, no hit/miss counters; `probes` only counts walks so
+        the router's memoization is testable).  The DP router scores
+        replicas with this so cold threads land where their system prompt
+        is already hot (runtime/dp_router.py _pick)."""
+        self.probes += 1
         _, matched, _ = self._walk(prompt_ids)
         return matched * self.pool.page_size
 
@@ -269,7 +301,8 @@ class PrefixCache:
             if child is None:
                 run_tokens = list(tokens[idx * ps:n_full * ps])
                 run_pages = list(pages[idx:n_full])
-                self.pool.retain(run_pages)
+                self._retain_pages(run_pages)
+                self.generation += 1
                 new = _Node(run_tokens, run_pages, node)
                 self._claim(new, key)
                 node.children[pkey] = new
@@ -334,7 +367,8 @@ class PrefixCache:
             parent.children.pop(tuple(node.tokens[:ps]), None)
             if parent is not self._root and not parent.children:
                 self._leaves[parent] = None  # parent became a leaf
-        self.pool.release(node.pages)
+        self._release_pages(node.pages)
+        self.generation += 1
         self._n_nodes -= 1
         self._n_pages -= len(node.pages)
         self._leaves.pop(node, None)
@@ -371,7 +405,8 @@ class PrefixCache:
                 self.evictions += 1
                 self._remove(victim)
             else:
-                self.pool.release(victim.pages[keep:])
+                self._release_pages(victim.pages[keep:])
+                self.generation += 1
                 victim.pages = victim.pages[:keep]
                 victim.tokens = victim.tokens[: keep * ps]
                 self._n_pages -= n
@@ -429,3 +464,5 @@ class PrefixCache:
         self._n_nodes = 0
         self._n_pages = 0
         self._leaves = OrderedDict()
+        self._page_retains = {}
+        self.generation += 1

@@ -140,6 +140,7 @@ def build_tpu_provider(cfg: ServingConfig) -> LLMProvider:
         max_new_tokens_default=cfg.max_new_tokens_default,
         cp_strategy=cfg.cp_strategy,
         multi_step=cfg.multi_step,
+        speculative_k=cfg.speculative_k,
         kv_quantize=cfg.kv_quantize,
         # 0 disables the radix prefix cache; None = pressure-bounded
         prefix_cache_entries=0 if cfg.prefix_cache_pages == 0 else 64,
@@ -293,6 +294,10 @@ def build_tpu_provider(cfg: ServingConfig) -> LLMProvider:
                 ),
             ))
             e.run_to_completion()
+            # speculative verify program (KAFKA_TPU_SPECULATIVE_K > 0):
+            # organic engagement depends on generated repetition, so the
+            # engine compiles it via an all-masked dispatch (no-op at K=0)
+            e.warmup_verify()
         engine.run_to_completion()
         engine_cfg.max_waiting = _admission_bound
         for e in engines:

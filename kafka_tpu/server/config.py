@@ -35,6 +35,14 @@ class ServingConfig:
     # fused decode depth (EngineConfig.multi_step): steps per device
     # dispatch when the batch is busy; 1 disables fusion
     multi_step: int = 16
+    # Draft-free speculative decoding (KAFKA_TPU_SPECULATIVE_K): up to K
+    # n-gram prompt-lookup candidates per lane verified in one [B, K+1]
+    # device dispatch (README "Speculative decoding").  0 (default)
+    # disables it entirely — no verify program is compiled and the
+    # dispatch paths are the plain ones.  Best on the repetition-heavy
+    # agent workload (tool echoes, JSON, code spans); leave it off for
+    # high-entropy creative sampling.
+    speculative_k: int = 0
     # Radix prefix-cache page budget (KAFKA_TPU_PREFIX_CACHE_PAGES): how
     # many KV pool pages the cross-thread prefix cache may retain.  None =
     # bounded only by pool pressure (the engine reclaims cache pages
@@ -181,6 +189,10 @@ class ServingConfig:
             num_pages=get("NUM_PAGES", cls.num_pages, int),
             max_pages_per_seq=get("MAX_PAGES_PER_SEQ", cls.max_pages_per_seq, int),
             multi_step=get("MULTI_STEP", cls.multi_step, int),
+            # clamp negatives to 0 = disabled (same policy as the cache
+            # budget below: nonsense env values must not half-enable)
+            speculative_k=get("SPECULATIVE_K", cls.speculative_k,
+                              lambda v: max(0, int(v))),
             # clamp nonsense (negative) values to 0 = "disabled" — a raw
             # negative budget would otherwise evict every store on sight
             # while leaving the cache machinery running

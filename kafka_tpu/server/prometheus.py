@@ -106,7 +106,10 @@ def render_prometheus(snap: Dict[str, Any]) -> str:
     if tokens:
         w.family("kafka_tpu_tokens_total", "counter",
                  "Token counters by kind.")
-        for kind in ("prompt", "generated", "speculative_wasted"):
+        # fetch_pipeline_wasted was exported as kind="speculative_wasted"
+        # before real speculative decoding existed (renamed PR 5; the
+        # JSON endpoint keeps the old keys as deprecated aliases)
+        for kind in ("prompt", "generated", "fetch_pipeline_wasted"):
             if kind in tokens:
                 w.sample("kafka_tpu_tokens_total", tokens[kind],
                          {"kind": kind})
@@ -151,6 +154,37 @@ def render_prometheus(snap: Dict[str, Any]) -> str:
         w.sample("kafka_tpu_constrained_roundtrips_total",
                  snap["constrained_roundtrips"])
 
+    spec = snap.get("speculation") or {}
+    if spec:
+        # speculative decoding (draft-free n-gram + batched verify).
+        # Family names mirror runtime/metrics.SPECULATION_METRIC_KEYS —
+        # the registry a static test enforces in both files.
+        w.family("kafka_tpu_speculation_tokens_total", "counter",
+                 "Speculative candidate tokens by outcome.")
+        for key, kind in (
+            ("speculation_proposed_tokens", "proposed"),
+            ("speculation_accepted_tokens", "accepted"),
+            ("speculation_rejected_tokens", "rejected"),
+        ):
+            if key in spec:
+                w.sample("kafka_tpu_speculation_tokens_total", spec[key],
+                         {"kind": kind})
+        if "speculation_verify_steps" in spec:
+            w.family("kafka_tpu_speculation_verify_steps_total", "counter",
+                     "Speculative verify dispatches.")
+            w.sample("kafka_tpu_speculation_verify_steps_total",
+                     spec["speculation_verify_steps"])
+        if "speculation_acceptance_rate" in spec:
+            w.family("kafka_tpu_speculation_acceptance_rate", "gauge",
+                     "Accepted / (accepted + rejected) candidate tokens.")
+            w.sample("kafka_tpu_speculation_acceptance_rate",
+                     spec["speculation_acceptance_rate"])
+        if "speculation_accepted_per_step" in spec:
+            w.family("kafka_tpu_speculation_accepted_per_step", "gauge",
+                     "Mean accepted candidates per verify dispatch.")
+            w.sample("kafka_tpu_speculation_accepted_per_step",
+                     spec["speculation_accepted_per_step"])
+
     engine = snap.get("engine") or {}
     if engine:
         w.family("kafka_tpu_engine_active", "gauge",
@@ -179,18 +213,42 @@ def render_prometheus(snap: Dict[str, Any]) -> str:
         w.sample("kafka_tpu_dp_replicas", snap["dp"])
 
     pc = snap.get("prefix_cache") or {}
+    # DP aggregates sum per-replica prefix caches; export each replica's
+    # cache as its own labeled series too (replica="<i>") so a dashboard
+    # can see WHERE the radix trees are hot, while the unlabeled aggregate
+    # series keeps existing dashboards working.  The exposition format
+    # requires every sample of a family in ONE contiguous group, so the
+    # aggregate and replica-labeled samples are emitted per family, not
+    # per section.
+    replica_pcs = [
+        (idx, rs.get("prefix_cache") or {})
+        for idx, rs in enumerate(snap.get("replicas") or [])
+        if rs.get("prefix_cache")
+    ]
     if pc:
         w.family("kafka_tpu_prefix_cache_entries", "gauge",
                  "Live prefix-cache entries (radix nodes; legacy name).")
         w.sample("kafka_tpu_prefix_cache_entries", pc.get("entries", 0))
+    if "nodes" in pc or any("nodes" in r for _, r in replica_pcs):
+        w.family("kafka_tpu_prefix_cache_nodes", "gauge",
+                 "Radix-tree nodes (page-aligned token runs).")
         if "nodes" in pc:
-            w.family("kafka_tpu_prefix_cache_nodes", "gauge",
-                     "Radix-tree nodes (page-aligned token runs).")
             w.sample("kafka_tpu_prefix_cache_nodes", pc["nodes"])
+        for idx, rpc in replica_pcs:
+            if "nodes" in rpc:
+                w.sample("kafka_tpu_prefix_cache_nodes", rpc["nodes"],
+                         {"replica": idx})
+    if "cached_pages" in pc or any("cached_pages" in r
+                                   for _, r in replica_pcs):
+        w.family("kafka_tpu_prefix_cache_pages", "gauge",
+                 "KV pages the prefix cache currently retains.")
         if "cached_pages" in pc:
-            w.family("kafka_tpu_prefix_cache_pages", "gauge",
-                     "KV pages the prefix cache currently retains.")
             w.sample("kafka_tpu_prefix_cache_pages", pc["cached_pages"])
+        for idx, rpc in replica_pcs:
+            if "cached_pages" in rpc:
+                w.sample("kafka_tpu_prefix_cache_pages",
+                         rpc["cached_pages"], {"replica": idx})
+    if pc or replica_pcs:
         w.family("kafka_tpu_prefix_cache_total", "counter",
                  "Prefix-cache events by kind.")
         for kind in ("hits", "misses", "tokens_reused",
@@ -198,6 +256,13 @@ def render_prometheus(snap: Dict[str, Any]) -> str:
             if kind in pc:
                 w.sample("kafka_tpu_prefix_cache_total", pc[kind],
                          {"kind": kind})
+        for idx, rpc in replica_pcs:
+            for kind in ("hits", "misses", "tokens_reused",
+                         "cross_thread_hits", "evictions",
+                         "pages_evicted"):
+                if kind in rpc:
+                    w.sample("kafka_tpu_prefix_cache_total", rpc[kind],
+                             {"replica": idx, "kind": kind})
 
     sandbox = snap.get("sandbox") or {}
     if sandbox:

@@ -77,7 +77,9 @@ SPANS = (
     "compaction",     # context-compaction retry (agents/base)
     "engine.queue",   # submit -> first prefill chunk dispatch (engine)
     "engine.prefill", # prefill chunks -> first token sampled (engine)
-    "engine.decode",  # one decode dispatch burst; attrs: steps, busy (engine)
+    "engine.decode",  # one decode dispatch burst; attrs: steps, busy — and
+                      # on speculative verify dispatches proposed/accepted
+                      # (candidate tokens offered / kept that round) (engine)
     "emit",           # first dispatch -> first token on host (engine)
     "sandbox.exec",   # tool execution INSIDE the sandbox subprocess
 )

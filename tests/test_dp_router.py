@@ -436,3 +436,126 @@ class TestTopologyRebuild:
             await provider.aclose()
 
         asyncio.run(go())
+
+
+class TestProbeMemoization:
+    """PR 4 follow-up (ISSUE 5 satellite): the per-replica radix probe in
+    _pick is memoized for the shared system-prompt head — O(1) per replica
+    per keyed submit while the caches' generations are unchanged, with one
+    O(match) head verification per submit."""
+
+    def _dp(self, model, dp=2):
+        cfg, params = model
+        return DataParallelEngines(cfg, params, EngineConfig(**ECFG),
+                                   dp=dp, tp=1, kv_dtype=jnp.float32)
+
+    def test_warm_head_probes_once_per_submit(self, model):
+        cfg, params = model
+        dp = self._dp(model)
+        common = list(np.random.RandomState(31).randint(1, 128, 16))
+        # seed one replica's cache with the shared head
+        dp.submit(GenRequest(request_id="seed", prompt_ids=common + [3],
+                             max_new_tokens=2, prefix_key="t-seed"))
+        dp.run_to_completion()
+        probes0 = sum(e.prefix_cache.probes for e in dp.engines)
+        # submit several cold threads sharing the head BEFORE any of them
+        # finishes (no store -> no generation bump between submits)
+        for i in range(4):
+            dp.submit(GenRequest(request_id=f"cold{i}",
+                                 prompt_ids=common + [7 + i],
+                                 max_new_tokens=2,
+                                 prefix_key=f"t-cold-{i}"))
+        probed = sum(e.prefix_cache.probes for e in dp.engines) - probes0
+        # Soundness requires the DEEPEST-match replica to re-probe every
+        # submit (its memoized walk ended at the run boundary, so a deeper
+        # match for a new continuation can't be ruled out); every OTHER
+        # replica (match strictly inside the run, or 0) is O(1) via the
+        # memo.  4 submits -> at most 4 warm-replica probes + one initial
+        # walk per cold replica.
+        assert probed <= 4 + (len(dp.engines) - 1), (
+            f"{probed} probes for 4 same-head submits across "
+            f"{len(dp.engines)} replicas — memoization not engaged"
+        )
+        dp.run_to_completion()
+
+    def test_generation_bump_invalidates_memo(self, model):
+        cfg, params = model
+        dp = self._dp(model)
+        common = list(np.random.RandomState(32).randint(1, 128, 16))
+        dp.submit(GenRequest(request_id="s", prompt_ids=common + [3],
+                             max_new_tokens=2, prefix_key="t-a"))
+        dp.run_to_completion()
+        # c1's prompt extends one FULL page past the shared head so its
+        # store inserts a new node (a same-content store would leave the
+        # tree — and the generation — untouched, and memo reuse would be
+        # sound)
+        dp.submit(GenRequest(request_id="c1", prompt_ids=common + [9] * 8,
+                             max_new_tokens=2, prefix_key="t-b"))
+        dp.run_to_completion()  # finish -> store new node -> generation bump
+        warm = dp._route["c1"]
+        probes0 = dp.engines[warm].prefix_cache.probes
+        dp.submit(GenRequest(request_id="c2", prompt_ids=common + [11],
+                             max_new_tokens=2, prefix_key="t-c"))
+        # the mutated replica must be re-probed (stale match would
+        # mis-route), and routing still steers to the warm replica
+        assert dp.engines[warm].prefix_cache.probes > probes0
+        assert dp._route["c2"] == warm
+        dp.run_to_completion()
+
+    def test_full_run_match_reprobes_for_deeper_continuation(self, model):
+        """A memoized match that consumed the WHOLE run must re-probe on
+        the next submit: the warm tree continues past the run where the
+        OLD prompt diverged, and a new prompt whose continuation follows
+        the tree would match deeper — stale reuse would under-score the
+        warmest replica."""
+        cfg, params = model
+        dp = self._dp(model)
+        common = list(np.random.RandomState(36).randint(1, 128, 16))
+        deep = [9] * 8  # page 3 of the stored path
+        dp.submit(GenRequest(request_id="s", prompt_ids=common + deep + [3],
+                             max_new_tokens=2, prefix_key="t-s"))
+        dp.run_to_completion()  # warm tree: [common p0, common p1, deep]
+        warm = dp._route["s"]
+        # diverges at page 3 -> memo records match == run length (16)
+        dp.submit(GenRequest(request_id="x",
+                             prompt_ids=common + [7] * 8 + [4],
+                             max_new_tokens=2, prefix_key="t-x"))
+        probes0 = dp.engines[warm].prefix_cache.probes
+        # same head, but the continuation FOLLOWS the stored path: the
+        # true match is 24 tokens, knowable only by re-probing (the warm
+        # generation is unchanged since the memo refresh, so a stale
+        # reuse would score 16)
+        dp.submit(GenRequest(request_id="y",
+                             prompt_ids=common + deep + [5],
+                             max_new_tokens=2, prefix_key="t-y"))
+        assert dp.engines[warm].prefix_cache.probes > probes0
+        assert dp._route["y"] == warm
+        dp.run_to_completion()
+
+    def test_divergent_head_reprobes(self, model):
+        """A prompt with a DIFFERENT head must not reuse another head's
+        memo entry (keyed on the first page of tokens)."""
+        cfg, params = model
+        dp = self._dp(model)
+        a = list(np.random.RandomState(33).randint(1, 128, 16))
+        b = list(np.random.RandomState(34).randint(1, 128, 16))
+        dp.submit(GenRequest(request_id="a", prompt_ids=a + [2],
+                             max_new_tokens=2, prefix_key="t-a"))
+        dp.run_to_completion()
+        warm = dp._route["a"]
+        probes0 = sum(e.prefix_cache.probes for e in dp.engines)
+        dp.submit(GenRequest(request_id="b", prompt_ids=b + [2],
+                             max_new_tokens=2, prefix_key="t-b"))
+        assert sum(e.prefix_cache.probes for e in dp.engines) > probes0
+        dp.run_to_completion()
+
+    def test_rebuild_clears_memo(self, model):
+        cfg, params = model
+        dp = self._dp(model)
+        common = list(np.random.RandomState(35).randint(1, 128, 16))
+        dp.submit(GenRequest(request_id="s", prompt_ids=common + [3],
+                             max_new_tokens=2, prefix_key="t-s"))
+        dp.run_to_completion()
+        assert dp._probe_memo
+        dp.rebuild(dp=1)
+        assert not dp._probe_memo

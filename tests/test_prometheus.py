@@ -28,6 +28,8 @@ def parse_exposition(text: str):
     families = {}
     samples = []
     seen = set()
+    closed = set()  # families whose sample group has ended
+    current = None  # family of the previous sample line
     for line in text.splitlines():
         if not line.strip():
             continue
@@ -56,6 +58,12 @@ def parse_exposition(text: str):
         # every sample belongs to a TYPEd family (summary samples share
         # the family's base name in the classic text format)
         assert name in families, f"sample {name} has no TYPE line"
+        # all samples of one family must form a single contiguous group
+        if name != current:
+            assert name not in closed, f"non-contiguous family: {name}"
+            if current is not None:
+                closed.add(current)
+            current = name
         samples.append((name, labels, float(value)))
     return families, samples
 
@@ -161,6 +169,64 @@ class TestRenderer:
         assert ({"replica": "1"}, 0.5) in by_name["kafka_tpu_replica_health"]
         assert families["kafka_tpu_replica_supervisor_total"] == "counter"
         assert by_name["kafka_tpu_dp_replicas"] == [({}, 2.0)]
+
+    def test_speculation_families_render(self):
+        """Speculative-decoding counters/gauges (ISSUE 5) render as typed
+        families, and the token counter carries the RENAMED
+        fetch_pipeline_wasted kind (old kind gone from the exposition;
+        JSON keeps deprecated aliases instead)."""
+        m = EngineMetrics()
+        m.record_verify_dispatch(8)
+        m.record_verify_drain(5, 3)
+        m.record_wasted_token(2)
+        for _ in range(5):
+            m.record_token()
+        families, samples = parse_exposition(
+            render_prometheus(m.snapshot())
+        )
+        by = {(n, tuple(sorted(l.items()))): v for n, l, v in samples}
+        assert families["kafka_tpu_speculation_tokens_total"] == "counter"
+        assert by[("kafka_tpu_speculation_tokens_total",
+                   (("kind", "proposed"),))] == 8
+        assert by[("kafka_tpu_speculation_tokens_total",
+                   (("kind", "accepted"),))] == 5
+        assert by[("kafka_tpu_speculation_tokens_total",
+                   (("kind", "rejected"),))] == 3
+        assert by[("kafka_tpu_speculation_verify_steps_total", ())] == 1
+        assert families["kafka_tpu_speculation_acceptance_rate"] == "gauge"
+        assert by[("kafka_tpu_tokens_total",
+                   (("kind", "fetch_pipeline_wasted"),))] == 2
+        assert ("kafka_tpu_tokens_total",
+                (("kind", "speculative_wasted"),)) not in by
+
+    def test_per_replica_prefix_cache_label_families(self):
+        """DP aggregates export each replica's prefix cache as labeled
+        series (replica="<i>") ALONGSIDE the summed aggregate series
+        (ISSUE 5 satellite — PR 4 follow-up)."""
+        snap = populated_snapshot()
+        snap["dp"] = 2
+        rep = {
+            "prefix_cache": {
+                "entries": 1, "nodes": 1, "cached_pages": 4,
+                "hits": 2, "misses": 1, "tokens_reused": 32,
+                "cross_thread_hits": 1, "evictions": 0,
+                "pages_evicted": 0,
+            }
+        }
+        snap["replicas"] = [rep, {}]  # replica 1 has no cache section
+        families, samples = parse_exposition(render_prometheus(snap))
+        by = {(n, tuple(sorted(l.items()))): v for n, l, v in samples}
+        # aggregate (unlabeled) series survive for existing dashboards
+        assert by[("kafka_tpu_prefix_cache_pages", ())] == 11
+        assert by[("kafka_tpu_prefix_cache_total",
+                   (("kind", "hits"),))] == 5
+        # per-replica labeled series
+        assert by[("kafka_tpu_prefix_cache_pages",
+                   (("replica", "0"),))] == 4
+        assert by[("kafka_tpu_prefix_cache_total",
+                   (("kind", "hits"), ("replica", "0")))] == 2
+        assert ("kafka_tpu_prefix_cache_pages",
+                (("replica", "1"),)) not in by
 
     def test_label_escaping(self):
         from kafka_tpu.server.prometheus import _escape
