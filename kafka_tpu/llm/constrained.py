@@ -377,12 +377,25 @@ class ToolCallAutomaton:
       done            only end-of-turn may follow
     """
 
+    # Nesting cap for free JSON parameter VALUES (JsonPDA.max_depth).
+    # Shared by the host mask path and the compiled on-device FSM — the
+    # two must accept the SAME language or their token streams diverge.
+    # Each extra level doubles the compiled automaton's stack alphabet
+    # (2^depth stack shapes), so the cap is also what keeps the
+    # grammar->table compile small; 4 levels is ample for tool arguments.
+    MAX_VALUE_DEPTH = 4
+
     def __init__(
         self,
         tools: Sequence[Dict[str, Any]],
         force_name: Optional[str] = None,
+        max_value_depth: Optional[int] = None,
     ):
         self._props_by_name: Dict[str, Optional[List[str]]] = {}
+        self._value_depth = (
+            max_value_depth if max_value_depth is not None
+            else self.MAX_VALUE_DEPTH
+        )
         names = []
         for t in tools:
             fn = t.get("function", t)
@@ -405,6 +418,13 @@ class ToolCallAutomaton:
         if not names:
             raise ValueError("no tools to constrain to")
         self._name_trie = _Trie(names)
+        # key tries are built ONCE per tool and shared across copies so
+        # that automaton-state signatures (the grammar compiler's dedup
+        # key) can use trie-node identity
+        self._key_tries: Dict[str, Optional[_Trie]] = {
+            name: (_Trie(props) if props is not None else None)
+            for name, props in self._props_by_name.items()
+        }
         self.reset()
 
     def reset(self) -> None:
@@ -420,6 +440,8 @@ class ToolCallAutomaton:
         c = ToolCallAutomaton.__new__(ToolCallAutomaton)
         c._props_by_name = self._props_by_name
         c._name_trie = self._name_trie
+        c._key_tries = self._key_tries
+        c._value_depth = self._value_depth
         c.state = self.state
         c._name_chars = list(self._name_chars)
         c._name_node = self._name_node
@@ -432,6 +454,23 @@ class ToolCallAutomaton:
     @property
     def done(self) -> bool:
         return self.state[0] == "done"
+
+    def signature(self) -> Tuple:
+        """Hashable identity of this automaton state (the grammar->table
+        compiler's BFS dedup key).  Trie nodes are shared dicts (one node
+        per unique prefix), so their id() is a sound state component;
+        the PDAs contribute (stack, state, lit)."""
+        def pda_sig(p: Optional[JsonPDA]):
+            return None if p is None else (tuple(p.stack), p.state, p.lit)
+
+        return (
+            self.state,
+            id(self._name_node),
+            id(self._key_trie) if self._key_trie is not None else None,
+            id(self._key_node) if self._key_node is not None else None,
+            pda_sig(self._key_pda),
+            pda_sig(self._value_pda),
+        )
 
     @property
     def in_free_string(self) -> bool:
@@ -447,8 +486,7 @@ class ToolCallAutomaton:
 
     def _enter_params(self) -> None:
         name = "".join(self._name_chars)
-        props = self._props_by_name.get(name)
-        self._key_trie = _Trie(props) if props is not None else None
+        self._key_trie = self._key_tries.get(name)
         self.state = ("p_key_or_close", None)
 
     def _start_key(self) -> None:
@@ -527,7 +565,7 @@ class ToolCallAutomaton:
             if ch != lit[arg]:
                 return False
             if arg + 1 == len(lit):
-                self._value_pda = JsonPDA()
+                self._value_pda = JsonPDA(max_depth=self._value_depth)
                 self.state = ("p_value", None)
             else:
                 self.state = ("p_colon", arg + 1)
@@ -745,6 +783,49 @@ class TokenIndex:
         ).start()
 
 
+def _token_ok(auto: ToolCallAutomaton, text: str) -> bool:
+    """Does the whole decoded token validate from this automaton state?
+    (Runs PAST `done` are rejected — a token may end the call, never
+    overshoot it.)"""
+    c = auto.copy()
+    for ch in text:
+        if c.done:
+            return False
+        if not c.feed(ch):
+            return False
+    return True
+
+
+def allowed_ids_for(
+    auto: ToolCallAutomaton, index: TokenIndex, eot_id: int
+) -> List[int]:
+    """Token ids legal from `auto`'s state — THE mask semantics.
+
+    Shared verbatim by the host mask path (ToolCallMaskFn._allowed_ids)
+    and the grammar->table compiler (compile_tool_call_grammar), so the
+    on-device FSM admits exactly the host path's token sets and the two
+    paths emit bit-identical greedy streams.
+    """
+    if auto.done:
+        return [eot_id]
+    allowed: List[int]
+    if auto.in_free_string:
+        # fast path: precomputed safe set + trial-checked specials
+        allowed = [int(t) for t in index.string_safe]
+        for ch in ('"', "\\"):
+            for tid in index.buckets.get(ch, ()):
+                if _token_ok(auto, index.texts[tid]):
+                    allowed.append(tid)
+        return allowed
+    legal = [ch for ch in PROBE_CHARS if auto.copy().feed(ch)]
+    allowed = []
+    for ch in legal:
+        for tid in index.buckets.get(ch, ()):
+            if _token_ok(auto, index.texts[tid]):
+                allowed.append(tid)
+    return allowed
+
+
 class ToolCallMaskFn:
     """`logits_mask_fn` forcing canonical tool-call JSON (engine protocol:
     called with output_ids, returns allowed token ids or None)."""
@@ -763,6 +844,9 @@ class ToolCallMaskFn:
         self._tok = tokenizer
         self._index = TokenIndex.for_tokenizer(tokenizer)
         self._auto = ToolCallAutomaton(tools, force_name=force_name)
+        # kept for the on-device grammar compiler (compile_grammar_for_mask_fn)
+        self.tools = list(tools)
+        self.force_name = force_name
         self._consumed = 0  # output_ids already fed (incremental)
         self._fed_text_len = 0
         self._max_tokens = max_tokens
@@ -871,27 +955,11 @@ class ToolCallMaskFn:
         return best
 
     def _allowed_ids(self) -> List[int]:
-        auto, idx = self._auto, self._index
-        if auto.done:
-            return [self._tok.eot_id]
-        allowed: List[int]
-        if auto.in_free_string:
-            # fast path: precomputed safe set + trial-checked specials
-            allowed = list(idx.string_safe)
-            for ch in ('"', "\\"):
-                for tid in idx.buckets.get(ch, ()):
-                    if self._trial(tid):
-                        allowed.append(tid)
-            return allowed
-        legal = [ch for ch in PROBE_CHARS if auto.copy().feed(ch)]
-        allowed = []
-        for ch in legal:
-            for tid in idx.buckets.get(ch, ()):
-                if self._trial(tid):
-                    allowed.append(tid)
-        if auto.done:  # pragma: no cover (handled above)
-            allowed.append(self._tok.eot_id)
-        return allowed
+        return allowed_ids_for(self._auto, self._index, self._tok.eot_id)
+
+    def state_desc(self) -> str:
+        """Human-readable automaton state (over-tight-mask log lines)."""
+        return repr(self._auto.state)
 
     def _wrap_up_ids(self) -> List[int]:
         """Allowed ids in wrap-up mode: tokens starting with the shortest
@@ -907,14 +975,359 @@ class ToolCallMaskFn:
         return out
 
     def _trial(self, token_id: int) -> bool:
-        text = self._index.texts[token_id]
-        c = self._auto.copy()
-        for ch in text:
-            if c.done:
-                return False  # text runs past the end of the call
-            if not c.feed(ch):
-                return False
-        return True
+        # same semantics as the compiler's trial feed — the host/device
+        # mask-equality guarantee rests on sharing ONE implementation
+        return _token_ok(self._auto, self._index.texts[token_id])
+
+
+# ---------------------------------------------------------------------------
+# on-device grammar FSM (ISSUE 7): grammar -> token-level DFA tables
+# ---------------------------------------------------------------------------
+#
+# The host mask path above needs the previous token back on host before it
+# can build the next mask — on tunneled links that is ~RTT per constrained
+# token.  compile_tool_call_grammar() lowers the SAME automaton into three
+# dense arrays a jitted decode step can consume with zero host round trips:
+#
+#   token_class [V] int32 — tokens partitioned into behavior classes (two
+#       tokens share a class iff they behave identically from EVERY state;
+#       class 0 is "illegal everywhere").  This is classic lexer-table
+#       column compression: the full [S, V] transition matrix never
+#       materializes — at a 128k vocab it would be gigabytes, while the
+#       free-string bulk (the ~whole vocab, self-looping inside string
+#       content) collapses into a handful of classes.
+#   trans [S, C] int32 — state x class -> next state, -1 illegal.  The
+#       per-lane allowed mask is `trans[state][token_class] >= 0`, and the
+#       FSM advance after sampling is one [S, C] gather.
+#   dist [S] int32 — shortest token-count from each state to `done`
+#       (reverse BFS).  Near the token budget the device mask restricts to
+#       distance-DECREASING transitions, the on-device analogue of the
+#       host path's wrap-up mode: a bounded generation still parses.
+#
+# States are BFS-discovered automaton configurations, deduped by
+# ToolCallAutomaton.signature(); per-state allowed sets come from
+# allowed_ids_for() — the exact host-mask semantics — so the two paths
+# accept identical token sets by construction.  Free-string states
+# special-case the string_safe bulk as a self-loop (feeding quote-free
+# safe characters never changes `in_str`), keeping the compile
+# O(states x structural-tokens) instead of O(states x vocab).
+
+GRAMMAR_ONDEVICE_ENV = "KAFKA_TPU_GRAMMAR_ONDEVICE"
+GRAMMAR_TABLE_MB_ENV = "KAFKA_TPU_GRAMMAR_TABLE_MB"
+_GRAMMAR_TABLE_MB_DEFAULT = 64
+# BFS guard independent of the byte cap (a runaway grammar must fail the
+# compile, not stall the process)
+_GRAMMAR_MAX_STATES = 32768
+# wrap-up engages when the remaining token budget is within this many
+# tokens of the state's shortest close (mirrors ToolCallMaskFn's
+# WRAP_UP_SLACK semantics at token granularity)
+GRAMMAR_WRAP_SLACK = 4
+
+_GRAMMAR_COMPILE_LOCK = __import__("threading").Lock()
+
+
+def grammar_ondevice_enabled() -> bool:
+    import os
+
+    return os.environ.get(GRAMMAR_ONDEVICE_ENV, "1") not in (
+        "0", "false", "off"
+    )
+
+
+def _grammar_table_cap_bytes() -> int:
+    import os
+
+    try:
+        mb = float(os.environ.get(GRAMMAR_TABLE_MB_ENV, ""))
+    except ValueError:
+        mb = _GRAMMAR_TABLE_MB_DEFAULT
+    if not mb:
+        mb = _GRAMMAR_TABLE_MB_DEFAULT
+    return int(mb * (1 << 20))
+
+
+class CompiledGrammar:
+    """Device-loadable token-level DFA for one (tools, tokenizer) pair.
+
+    Immutable after compile; the engine registers it into its padded
+    device table set (runtime/engine._GrammarTables) and lanes carry an
+    int32 state advanced inside the jitted decode step.  State 0 is the
+    initial state; `-1` is the engine's "unconstrained" sentinel and never
+    appears in `trans`.
+    """
+
+    __slots__ = ("token_class", "trans", "dist", "num_states",
+                 "num_classes", "vocab_size", "eot_id", "max_close_tokens",
+                 "wrap_slack", "schema_key")
+
+    def __init__(self, token_class, trans, dist, vocab_size, eot_id,
+                 schema_key):
+        self.token_class = token_class  # np [V] int32
+        self.trans = trans              # np [S, C] int32, -1 illegal
+        self.dist = dist                # np [S] int32 tokens-to-done
+        self.num_states = trans.shape[0]
+        self.num_classes = trans.shape[1]
+        self.vocab_size = vocab_size
+        self.eot_id = eot_id
+        self.max_close_tokens = int(dist.max()) if dist.size else 0
+        # Wrap-up window: the mask flips to distance-decreasing-only when
+        # budget_left <= dist + wrap_slack.  For closure the window must
+        # survive the largest one-token dist INCREASE any legal
+        # transition can cause (a comma at a choice point commits the
+        # generation to a whole forced `, "key": v` run): while wrap is
+        # NOT engaged, budget > dist + slack, and after one token
+        # dist' <= dist + max_jump, budget' = budget - 1 — so
+        # slack >= max_jump + 1 guarantees budget' >= dist' at engagement
+        # and the restriction then closes within budget.  The host mask
+        # path keeps its fixed 4-char slack and CAN still strand a tight
+        # budget mid-JSON on jump-heavy schemas; the compiled path is
+        # strictly more robust here (wrap timing differs only in a regime
+        # where neither path claims bit-identity).
+        legal = self.trans >= 0
+        if legal.any():
+            nd = self.dist[np.clip(self.trans, 0, self.num_states - 1)]
+            jump = np.where(legal, nd - self.dist[:, None], 0)
+            self.wrap_slack = max(GRAMMAR_WRAP_SLACK, int(jump.max()) + 1)
+        else:  # pragma: no cover — compile refuses empty grammars
+            self.wrap_slack = GRAMMAR_WRAP_SLACK
+        self.schema_key = schema_key
+
+    @property
+    def table_bytes(self) -> int:
+        return int(
+            self.token_class.nbytes + self.trans.nbytes + self.dist.nbytes
+        )
+
+    def allowed_row(
+        self, state: int, budget_left: Optional[int] = None
+    ) -> np.ndarray:
+        """[V] bool mask for `state` (host-side: prefill masks, tests).
+
+        With `budget_left` (remaining token budget INCLUDING the token
+        this row masks) the device wrap-up rule applies: within
+        GRAMMAR_WRAP_SLACK tokens of the state's shortest close, only
+        distance-decreasing transitions stay allowed — the prefill-sampled
+        token then obeys the same wrap-up the decode step enforces
+        (ops/sampling.grammar_allowed_mask)."""
+        if state < 0:
+            return np.ones(self.vocab_size, bool)
+        row = self.trans[state]
+        keep = row >= 0
+        if budget_left is not None and (
+            budget_left <= int(self.dist[state]) + self.wrap_slack
+        ):
+            nd = self.dist[np.clip(row, 0, self.num_states - 1)]
+            wrap_keep = keep & (nd < self.dist[state])
+            if wrap_keep.any():
+                keep = wrap_keep
+        return keep[self.token_class]
+
+    def walk(self, tokens: Sequence[int], start: int = 0) -> int:
+        """Replay a token sequence host-side (resume after preemption).
+        Returns -1 (unconstrained sentinel) if the history stops
+        validating — the lane then degrades rather than crashing."""
+        s = start
+        for t in tokens:
+            if s < 0:
+                return -1
+            t = int(t)
+            if not (0 <= t < self.vocab_size):
+                return -1
+            s = int(self.trans[s, self.token_class[t]])
+        return s
+
+
+def compile_tool_call_grammar(
+    tokenizer,
+    tools: Sequence[Dict[str, Any]],
+    force_name: Optional[str] = None,
+    vocab_size: Optional[int] = None,
+    max_table_bytes: Optional[int] = None,
+) -> Optional[CompiledGrammar]:
+    """Lower the tool-call grammar to device tables; None = fall back to
+    the host mask path (table over the size cap, an over-tight state the
+    tokenizer cannot express, or an eot outside the model vocab)."""
+    index = TokenIndex.for_tokenizer(tokenizer)
+    eot = int(tokenizer.eot_id)
+    V = int(vocab_size if vocab_size is not None else tokenizer.vocab_size)
+    if not (0 <= eot < V):
+        return None
+    cap = (
+        max_table_bytes if max_table_bytes is not None
+        else _grammar_table_cap_bytes()
+    )
+    try:
+        auto0 = ToolCallAutomaton(tools, force_name=force_name)
+    except ValueError:
+        return None
+    safe_set = {int(t) for t in index.string_safe if int(t) < V}
+
+    states: List[ToolCallAutomaton] = [auto0]
+    sig2idx: Dict[Tuple, int] = {auto0.signature(): 0}
+    sparse: List[Dict[int, int]] = []   # per state: token id -> next state
+    is_string: List[bool] = []          # free-string bulk self-loop flag
+    i = 0
+    while i < len(states):
+        auto = states[i]
+        edges: Dict[int, int] = {}
+        sparse.append(edges)
+        is_string.append(bool(auto.in_free_string))
+        if auto.done:
+            edges[eot] = i  # terminal self-loop; emission stops at eot
+            i += 1
+            continue
+        allowed = allowed_ids_for(auto, index, eot)
+        explicit = (
+            [t for t in allowed if int(t) not in safe_set]
+            if is_string[i] else allowed
+        )
+        if not allowed:
+            # a reachable state the tokenizer cannot advance: the device
+            # path could only degrade silently — refuse to compile
+            return None
+        for tid in explicit:
+            tid = int(tid)
+            if not (0 <= tid < V):
+                continue
+            nxt = auto.copy()
+            ok = True
+            for ch in index.texts[tid]:
+                if not nxt.feed(ch):
+                    ok = False
+                    break
+            if not ok:  # pragma: no cover — allowed_ids_for vetted it
+                continue
+            sig = nxt.signature()
+            j = sig2idx.get(sig)
+            if j is None:
+                j = len(states)
+                if j >= _GRAMMAR_MAX_STATES:
+                    return None
+                sig2idx[sig] = j
+                states.append(nxt)
+            edges[tid] = j
+        i += 1
+
+    S = len(states)
+    # ---- column compression: token behavior classes -------------------
+    # key = (sorted explicit (state, next) pairs, rides-string-bulk flag);
+    # the [S, V] matrix is never materialized.
+    cols: Dict[int, List[Tuple[int, int]]] = {}
+    for s_idx, edges in enumerate(sparse):
+        for tid, nxt in edges.items():
+            cols.setdefault(tid, []).append((s_idx, nxt))
+    string_states = [s for s, f in enumerate(is_string) if f]
+    class_of: Dict[Tuple, int] = {}
+    token_class = np.zeros(V, np.int32)  # class 0 = illegal everywhere
+    class_cols: List[Tuple[Tuple[Tuple[int, int], ...], bool]] = [((), False)]
+    for tid in range(V):
+        in_bulk = tid in safe_set and string_states
+        pairs = tuple(sorted(cols.get(tid, ())))
+        if not pairs and not in_bulk:
+            continue  # class 0
+        key = (pairs, bool(in_bulk))
+        c = class_of.get(key)
+        if c is None:
+            c = len(class_cols)
+            class_of[key] = c
+            class_cols.append(key)
+        token_class[tid] = c
+    C = len(class_cols)
+    if (S * C + V + S) * 4 > cap:
+        return None
+    trans = np.full((S, C), -1, np.int32)
+    for c, (pairs, in_bulk) in enumerate(class_cols):
+        if in_bulk:
+            for s_idx in string_states:
+                trans[s_idx, c] = s_idx  # free-string self-loop
+        for s_idx, nxt in pairs:
+            trans[s_idx, c] = nxt
+    # ---- shortest token-distance to done (reverse BFS) ----------------
+    import collections as _c
+
+    INF = 1 << 30
+    dist = np.full(S, INF, np.int64)
+    done_states = [s for s, a in enumerate(states) if a.done]
+    rev: Dict[int, List[int]] = {}
+    for s_idx in range(S):
+        row = trans[s_idx]
+        for nxt in set(int(n) for n in row[row >= 0]):
+            if nxt != s_idx:
+                rev.setdefault(nxt, []).append(s_idx)
+    dq = _c.deque()
+    for d0 in done_states:
+        dist[d0] = 0
+        dq.append(d0)
+    while dq:
+        cur = dq.popleft()
+        for prev in rev.get(cur, ()):
+            if dist[prev] > dist[cur] + 1:
+                dist[prev] = dist[cur] + 1
+                dq.append(prev)
+    if (dist >= INF).any():
+        # a state that cannot reach `done` would make wrap-up mask to
+        # nothing; the grammar is malformed for on-device serving
+        return None
+    return CompiledGrammar(
+        token_class, trans, dist.astype(np.int32), V, eot,
+        schema_key=_grammar_schema_key(auto0, force_name, V),
+    )
+
+
+def _grammar_schema_key(auto: ToolCallAutomaton, force_name, V) -> Tuple:
+    return (
+        tuple(sorted(
+            (name, tuple(props) if props is not None else None)
+            for name, props in auto._props_by_name.items()
+        )),
+        force_name,
+        V,
+    )
+
+
+# Per-tokenizer compile-cache bound: a long-lived server whose requests
+# carry varying tool registries (MCP merges, per-request named
+# tool_choice) must not grow host RSS one multi-hundred-KB artifact per
+# distinct schema forever.  dict preserves insertion order; eviction
+# drops the oldest entries (in-flight requests keep their artifact alive
+# by reference — eviction only forgets the cache slot).
+_GRAMMAR_CACHE_MAX = 16
+
+
+def compile_grammar_for_mask_fn(
+    mask_fn, vocab_size: int
+) -> Optional[CompiledGrammar]:
+    """Engine/provider hook: the on-device artifact for a ToolCallMaskFn
+    request, or None (host fallback: disabled by env, a mask fn the
+    compiler can't lower, or a failed compile — all cached)."""
+    if not grammar_ondevice_enabled():
+        return None
+    if not isinstance(mask_fn, ToolCallMaskFn):
+        return None  # dynamic/custom mask fns keep the host micro-batch
+    tok = mask_fn._tok
+    key = _grammar_schema_key(mask_fn._auto, mask_fn.force_name, vocab_size)
+    cache = getattr(tok, "_grammar_cache", None)
+    if cache is not None and key in cache:
+        return cache[key]
+    with _GRAMMAR_COMPILE_LOCK:
+        cache = getattr(tok, "_grammar_cache", None)
+        if cache is None:
+            cache = {}
+            try:
+                tok._grammar_cache = cache
+            except Exception:
+                cache = None  # slotted tokenizer: compile per call
+        if cache is not None and key in cache:
+            return cache[key]
+        g = compile_tool_call_grammar(
+            tok, mask_fn.tools, force_name=mask_fn.force_name,
+            vocab_size=vocab_size,
+        )
+        if cache is not None:
+            while len(cache) >= _GRAMMAR_CACHE_MAX:
+                cache.pop(next(iter(cache)))
+            cache[key] = g  # negative results cached too
+    return g
 
 
 def build_tool_call_mask_fn(

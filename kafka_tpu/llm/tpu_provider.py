@@ -44,6 +44,7 @@ from ..models.tokenizer import BaseTokenizer, parse_tool_call_text
 from ..runtime.engine import GenRequest, InferenceEngine, TokenEvent
 from ..runtime.tracing import current as current_trace
 from .base import LLMProvider, MessageLike, to_message_dicts
+from .constrained import grammar_ondevice_enabled as _grammar_ondevice_enabled
 from .utils import count_images
 from .worker import EngineWorker
 
@@ -425,6 +426,9 @@ class TPULLMProvider(LLMProvider):
             # draft-free speculative decoding depth (0 = off): surfaced so
             # operators can confirm the serving shape without reading env
             "speculative_k": self.engine.ecfg.speculative_k,
+            # on-device grammar FSM for constrained tool-call decoding
+            # (KAFKA_TPU_GRAMMAR_ONDEVICE; llm/constrained.py)
+            "grammar_ondevice": _grammar_ondevice_enabled(),
         }
 
     def build_tool_call_mask_fn(
@@ -513,6 +517,22 @@ class TPULLMProvider(LLMProvider):
                 len(prompt_ids), self.max_prompt_tokens, self.provider_name
             )
 
+        # On-device grammar FSM (ISSUE 7, KAFKA_TPU_GRAMMAR_ONDEVICE):
+        # lower the tool-call mask into a device-resident token DFA so the
+        # constrained lane advances inside the jitted decode step with
+        # zero host round trips.  Cached per (tokenizer, schema, vocab);
+        # the first compile for a schema walks the automaton x vocab, so
+        # it runs off the event loop.  None (disabled, a custom mask fn,
+        # or an uncompilable grammar) keeps the host micro-batch path.
+        grammar = None
+        if logits_mask_fn is not None:
+            from .constrained import compile_grammar_for_mask_fn
+
+            grammar = await asyncio.to_thread(
+                compile_grammar_for_mask_fn, logits_mask_fn,
+                self.model_cfg.vocab_size,
+            )
+
         completion_id = new_completion_id()
         model_id = model or self.model_name
         req = GenRequest(
@@ -525,6 +545,7 @@ class TPULLMProvider(LLMProvider):
             seed=seed if seed is not None else 0,
             stop_token_ids=tuple(self.tokenizer.stop_ids),
             logits_mask_fn=logits_mask_fn,
+            grammar=grammar,
             prefix_key=prefix_key,
             override_pos=override_pos,
             override_rows=override_rows,

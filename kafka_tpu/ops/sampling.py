@@ -129,6 +129,64 @@ def _filtered_logits(
     return greedy_choice, scaled
 
 
+def grammar_allowed_mask(
+    fsm_state: jnp.ndarray,
+    fsm_g: jnp.ndarray,
+    budget_left: jnp.ndarray,
+    active: jnp.ndarray,
+    token_class: jnp.ndarray,
+    trans: jnp.ndarray,
+    dist: jnp.ndarray,
+    wrap_slack: jnp.ndarray,
+) -> jnp.ndarray:
+    """[B, V] bool allowed mask from per-lane device FSM states.
+
+    fsm_state [B] int32 (-1 = unconstrained lane), fsm_g [B] grammar index,
+    budget_left [B] remaining token budget, token_class [G, V], trans
+    [S, C] (-1 illegal), dist [S] shortest tokens-to-done.  Constrained
+    lanes within `wrap_slack` tokens of their shortest close restrict to
+    distance-decreasing transitions (on-device wrap-up) so a bounded
+    generation still parses; unconstrained/inactive lanes get all-True
+    rows, which leave the sampler's logits bit-identical to an unmasked
+    call.
+    """
+    S = trans.shape[0]
+    on = (fsm_state >= 0) & active
+    s = jnp.clip(fsm_state, 0, S - 1)
+    row = trans[s]                                   # [B, C]
+    legal = row >= 0
+    nd = dist[jnp.clip(row, 0, S - 1)]               # [B, C]
+    d = dist[s][:, None]                             # [B, 1]
+    wrap = budget_left[:, None] <= d + wrap_slack
+    keep = legal & (~wrap | (nd < d))
+    # a wrap window with no distance-decreasing option (deep jump past the
+    # budget) degrades to the plain legal set rather than an empty row
+    keep = jnp.where(keep.any(axis=-1, keepdims=True), keep, legal)
+    tc = token_class[fsm_g]                          # [B, V]
+    mask = jnp.take_along_axis(keep, tc, axis=1)     # [B, V]
+    return jnp.where(on[:, None], mask, True)
+
+
+def grammar_advance(
+    fsm_state: jnp.ndarray,
+    fsm_g: jnp.ndarray,
+    tokens: jnp.ndarray,
+    active: jnp.ndarray,
+    token_class: jnp.ndarray,
+    trans: jnp.ndarray,
+) -> jnp.ndarray:
+    """Advance each lane's FSM state by one sampled token ([B] int32).
+    Inactive/unconstrained lanes keep their state; an illegal token (only
+    reachable through the over-tight degrade path) parks the lane at the
+    -1 unconstrained sentinel instead of indexing garbage."""
+    S = trans.shape[0]
+    on = (fsm_state >= 0) & active
+    tc = token_class[fsm_g]                          # [B, V]
+    cls = jnp.take_along_axis(tc, tokens[:, None], axis=1)[:, 0]
+    nxt = trans[jnp.clip(fsm_state, 0, S - 1), cls]
+    return jnp.where(on, nxt, fsm_state)
+
+
 def sample_tokens(
     logits: jnp.ndarray,
     params: SamplingParams,

@@ -638,10 +638,13 @@ class _AggregateMetrics:
                 wasted / (gen + wasted), 4
             ) if (gen + wasted) else 0.0,
         }
-        # deprecated aliases (one release — see runtime/metrics.py)
-        agg["tokens"]["speculative_wasted"] = wasted
-        agg["tokens"]["speculative_waste_frac"] = \
-            agg["tokens"]["fetch_pipeline_waste_frac"]
+        # constrained decoding: every key is a summable counter
+        agg["constrained"] = {
+            k: sum(s["constrained"][k] for s in snaps)
+            for k in snaps[0]["constrained"]
+        }
+        agg["constrained_roundtrips"] = \
+            agg["constrained"]["constrained_roundtrips"]
         # speculative decoding: counters sum, rates recompute.  Summed
         # from the SAME snaps as the exported per-replica detail so the
         # aggregate always equals the sum of agg["replicas"] within one

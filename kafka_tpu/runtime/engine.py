@@ -63,7 +63,12 @@ import numpy as np
 
 from ..models.config import ModelConfig
 from ..models.llama import KVCache, PagedView, forward
-from ..ops.sampling import SamplingParams, sample_tokens_per_slot
+from ..ops.sampling import (
+    SamplingParams,
+    grammar_advance,
+    grammar_allowed_mask,
+    sample_tokens_per_slot,
+)
 from .failpoints import failpoint
 from .kv_cache import (
     OutOfPagesError,
@@ -149,7 +154,8 @@ class EngineConfig:
     cp_strategy: str = "ring"
     # Decode steps fused into one device dispatch (lax.scan) when the batch
     # is busy and stable — amortizes per-dispatch host/tunnel overhead.
-    # Engages with >=3 active streams, no constrained lanes, and no lane
+    # Engages with >=3 active streams, no HOST-masked constrained lanes
+    # (device-FSM grammar lanes fuse fine), and no lane
     # mid-prefill; a waiting queue with every slot busy keeps fusion ON
     # (admission waits at most k-1 steps — see _pick_multi_step).
     # Depth measurements on the tunneled v5e (scripts/sweep_multistep.py +
@@ -249,6 +255,17 @@ class GenRequest:
     prefill_ids: List[int] = dataclasses.field(default_factory=list)
     # constrained decoding: fn(output_ids) -> allowed token id list or None
     logits_mask_fn: Optional[Callable[[List[int]], Optional[List[int]]]] = None
+    # On-device grammar FSM (llm/constrained.CompiledGrammar): when set,
+    # the lane carries a device-side automaton state advanced INSIDE the
+    # jitted decode step — constrained sampling with zero host round
+    # trips, riding the same batched dispatch as free lanes (and the
+    # speculative verify step).  logits_mask_fn stays attached as the
+    # fallback: a lane whose grammar cannot register (table-set cap) or
+    # whose host replay stops validating degrades to the awaited
+    # micro-batch path.  None = host mask path (the pre-ISSUE-7 behavior).
+    grammar: Optional[Any] = None
+    # over-tight mask rows log once per request (the counter counts all)
+    overtight_logged: bool = False
     # Singleton-mask chaining: tokens already dispatched whose value is
     # grammar-FORCED (mask of exactly one id — masked sampling must return
     # it), not yet drained.  Masks for later positions build on
@@ -356,6 +373,114 @@ class _Fetch:
     # t_ready + rtt_est is when popping becomes non-blocking
     t_ready: Optional[float] = None
     spec: Optional[_SpecMeta] = None
+
+
+class _GrammarTables:
+    """Device residency for registered CompiledGrammar artifacts.
+
+    All live grammars share ONE padded table set so a mixed batch needs a
+    single compiled decode program: per-grammar transition blocks are
+    concatenated along the state axis (entries offset at registration, so
+    a lane's absolute int32 state addresses the combined [S, C] array) and
+    token-class rows stack into [G, V].  Registration is append-only —
+    offsets never move, so in-flight lanes' device states stay valid
+    across registrations; shapes grow geometrically so the decode program
+    retraces O(log S) times, not per grammar.  A full registry (MAX_LIVE)
+    returns None and the request degrades to the host mask path.
+    """
+
+    MAX_LIVE = 8
+    MIN_STATE_PAD = 256
+
+    def __init__(self, engine: "InferenceEngine"):
+        self._engine = engine
+        self.grammars: List[Any] = []
+        self.offsets: List[int] = []
+        self._total_states = 0
+        # device arrays (padded); None until the first registration
+        self.token_class = None   # [G_pad, V] int32
+        self.trans = None         # [S_pad, C_pad] int32
+        self.dist = None          # [S_pad] int32
+        self.slack = None         # [] int32 (wrap-up window)
+        self.shape_key: Tuple[int, int, int] = (0, 0, 0)
+
+    @property
+    def active(self) -> bool:
+        return bool(self.grammars)
+
+    def register(self, grammar) -> Optional[int]:
+        """Index of `grammar` in the table set (registering if new);
+        None when the registry is full, the vocab doesn't match, or the
+        COMBINED padded tables would exceed the KAFKA_TPU_GRAMMAR_TABLE_MB
+        budget (the same figure the memory planner charges — the cap is a
+        total device budget, not per-artifact)."""
+        for i, g in enumerate(self.grammars):
+            if g is grammar:
+                return i
+        if len(self.grammars) >= self.MAX_LIVE:
+            return None
+        if grammar.vocab_size != self._engine.cfg.vocab_size:
+            return None
+        from ..llm.constrained import _grammar_table_cap_bytes
+
+        if self._padded_bytes(
+            self._total_states + grammar.num_states,
+            max([grammar.num_classes] + [g.num_classes
+                                         for g in self.grammars]),
+            len(self.grammars) + 1,
+        ) > _grammar_table_cap_bytes():
+            return None
+        self.grammars.append(grammar)
+        self.offsets.append(self._total_states)
+        self._total_states += grammar.num_states
+        self._rebuild()
+        return len(self.grammars) - 1
+
+    def _padded_bytes(self, total_states: int, max_classes: int,
+                      n_grammars: int) -> int:
+        """Device bytes of the padded table set for a prospective shape."""
+        V = self._engine.cfg.vocab_size
+        S_pad = self._pad(total_states, self.MIN_STATE_PAD)
+        C_pad = self._pad(max_classes, 32)
+        G_pad = self._pad(n_grammars, 1)
+        return 4 * (G_pad * V + S_pad * C_pad + S_pad)
+
+    def _pad(self, n: int, lo: int) -> int:
+        p = lo
+        while p < n:
+            p *= 2
+        return p
+
+    def _rebuild(self) -> None:
+        V = self._engine.cfg.vocab_size
+        S_pad = self._pad(self._total_states, self.MIN_STATE_PAD)
+        C_pad = self._pad(max(g.num_classes for g in self.grammars), 32)
+        G_pad = self._pad(len(self.grammars), 1)
+        tc = np.zeros((G_pad, V), np.int32)
+        trans = np.full((S_pad, C_pad), -1, np.int32)
+        # padded/unreachable states read as "far from done" so wrap-up
+        # never engages on them
+        dist = np.full(S_pad, 1 << 20, np.int32)
+        for gi, (g, off) in enumerate(zip(self.grammars, self.offsets)):
+            tc[gi] = g.token_class
+            block = g.trans.copy()
+            block[block >= 0] += off
+            trans[off:off + g.num_states, : g.num_classes] = block
+            dist[off:off + g.num_states] = g.dist
+        dev = self._engine._dev
+        self.token_class = dev(tc)
+        self.trans = dev(trans)
+        self.dist = dev(dist)
+        # conservative across grammars: extra slack engages wrap earlier
+        # but never breaks closure
+        self.slack = dev(np.int32(
+            max(g.wrap_slack for g in self.grammars)
+        ))
+        self.shape_key = (S_pad, C_pad, G_pad)
+
+    def args(self) -> Tuple:
+        """The table argument tuple the fsm decode/verify programs take."""
+        return (self.token_class, self.trans, self.dist, self.slack)
 
 
 class InferenceEngine:
@@ -608,6 +733,15 @@ class InferenceEngine:
         # device-resident decode control state (see module docstring)
         self._d_last = self._dev(np.zeros(B, np.int32))
         self._d_seq_lens = self._dev(np.zeros(B, np.int32))
+        # On-device grammar FSM lanes (ISSUE 7): per-lane automaton state
+        # (-1 = unconstrained), grammar index into the shared table set,
+        # and the remaining token budget driving device-side wrap-up.
+        # Maintained like _d_last: seeded at activation, advanced by the
+        # fsm decode/verify programs, never rebuilt from host mid-flight.
+        self._grammars = _GrammarTables(self)
+        self._d_fsm = self._dev(np.full(B, -1, np.int32))
+        self._d_fsm_g = self._dev(np.zeros(B, np.int32))
+        self._d_budget = self._dev(np.zeros(B, np.int32))
         self._d_table = None
         self._d_active = None
         self._d_temps = self._d_top_ks = self._d_top_ps = self._d_seeds = None
@@ -808,7 +942,12 @@ class InferenceEngine:
 
         def body(params, k_pool, v_pool, page_table, last_tokens, seq_lens,
                  active, temps, top_ks, top_ps, seeds, allowed_mask,
-                 forced_tok=None, forced_on=None):
+                 forced_tok=None, forced_on=None, fsm=None):
+            # fsm = (state [B], gidx [B], budget [B], token_class [G, V],
+            # trans [S, C], dist [S], slack []): on-device grammar lanes —
+            # mask from the lane's FSM state, advance it by the sampled
+            # token, decrement the wrap-up budget.  None = the plain
+            # program (byte-identical dispatch paths when unused).
             positions = seq_lens[:, None]
             write_page = page_table[jnp.arange(B), seq_lens // ps]
             write_idx = (write_page * ps + seq_lens % ps)[:, None]
@@ -842,6 +981,14 @@ class InferenceEngine:
             keys = jax.vmap(
                 lambda s, p: jax.random.fold_in(jax.random.key(s), p)
             )(seeds, seq_lens)
+            if fsm is not None:
+                state, gidx, budget, tcs, trans, dists, slack = fsm
+                gmask = grammar_allowed_mask(
+                    state, gidx, budget, active, tcs, trans, dists, slack
+                )
+                allowed_mask = (
+                    gmask if allowed_mask is None else allowed_mask & gmask
+                )
             toks = sample_tokens_per_slot(
                 logits, SamplingParams(temps, top_ks, top_ps), keys, allowed_mask
             )
@@ -851,6 +998,12 @@ class InferenceEngine:
                 # [B, V] mask upload per chained dispatch with a [B] int32
                 toks = jnp.where(forced_on, forced_tok, toks)
             next_lens = seq_lens + active.astype(jnp.int32)
+            if fsm is not None:
+                new_state = grammar_advance(state, gidx, toks, active, tcs,
+                                            trans)
+                new_budget = budget - active.astype(jnp.int32)
+                return (cache.k, cache.v, toks, next_lens,
+                        new_state, new_budget)
             return cache.k, cache.v, toks, next_lens
 
         return body
@@ -861,6 +1014,31 @@ class InferenceEngine:
         if cache_key in _FN_CACHE:
             return _FN_CACHE[cache_key]
         jitted = jax.jit(self._decode_step_body(), donate_argnums=(1, 2))
+        _FN_CACHE[cache_key] = jitted
+        return jitted
+
+    def _get_decode_fsm_fn(self):
+        """Grammar-lane decode program: the plain step body plus FSM mask
+        /advance/budget, keyed on the grammar table shapes (tables grow
+        geometrically, so this retraces O(log states) times)."""
+        cache_key = ("decode_fsm", self.cfg, self.ecfg.page_size,
+                     self.ecfg.max_window, self.ecfg.max_batch, self.mesh,
+                     self._grammars.shape_key)
+        if cache_key in _FN_CACHE:
+            return _FN_CACHE[cache_key]
+        body = self._decode_step_body()
+
+        def fn(params, k_pool, v_pool, page_table, last_tokens, seq_lens,
+               active, temps, top_ks, top_ps, seeds, allowed_mask,
+               fsm_state, fsm_g, budget, g_tc, g_trans, g_dist, g_slack):
+            return body(
+                params, k_pool, v_pool, page_table, last_tokens, seq_lens,
+                active, temps, top_ks, top_ps, seeds, allowed_mask,
+                fsm=(fsm_state, fsm_g, budget, g_tc, g_trans, g_dist,
+                     g_slack),
+            )
+
+        jitted = jax.jit(fn, donate_argnums=(1, 2))
         _FN_CACHE[cache_key] = jitted
         return jitted
 
@@ -928,41 +1106,77 @@ class InferenceEngine:
         _FN_CACHE[cache_key] = jitted
         return jitted
 
-    def _get_multi_decode_fn(self, steps: int):
+    def _get_multi_decode_fn(self, steps: int, fsm: bool = False):
         """k fused decode steps in one dispatch (lax.scan over the step
         body).  Sampling stays per-(seed, position) via the in-carry
         seq_lens, so outputs are token-identical to k single dispatches.
-        Returns (k_pool', v_pool', toks [k, B], last [B], seq_lens [B])."""
+        Returns (k_pool', v_pool', toks [k, B], last [B], seq_lens [B]);
+        the fsm variant threads (fsm_state, budget) through the carry and
+        appends them to the return, so grammar lanes fuse too."""
         cache_key = ("multi_decode", self.cfg, self.ecfg.page_size,
                      self.ecfg.max_window, self.ecfg.max_batch, self.mesh,
-                     steps)
+                     steps,
+                     self._grammars.shape_key if fsm else None)
         if cache_key in _FN_CACHE:
             return _FN_CACHE[cache_key]
         body = self._decode_step_body()
 
-        def fn(params, k_pool, v_pool, page_table, last_tokens, seq_lens,
-               active, temps, top_ks, top_ps, seeds):
-            def one(carry, _):
-                kp, vp, last, lens = carry
-                kp, vp, toks, lens = body(
-                    params, kp, vp, page_table, last, lens,
-                    active, temps, top_ks, top_ps, seeds, None,
-                )
-                return (kp, vp, toks, lens), toks
+        if fsm:
+            def fn(params, k_pool, v_pool, page_table, last_tokens,
+                   seq_lens, active, temps, top_ks, top_ps, seeds,
+                   fsm_state, fsm_g, budget, g_tc, g_trans, g_dist,
+                   g_slack):
+                def one(carry, _):
+                    kp, vp, last, lens, st, bd = carry
+                    kp, vp, toks, lens, st, bd = body(
+                        params, kp, vp, page_table, last, lens,
+                        active, temps, top_ks, top_ps, seeds, None,
+                        fsm=(st, fsm_g, bd, g_tc, g_trans, g_dist,
+                             g_slack),
+                    )
+                    return (kp, vp, toks, lens, st, bd), toks
 
-            (kp, vp, last, lens), toks_seq = jax.lax.scan(
-                one, (k_pool, v_pool, last_tokens, seq_lens), None,
-                length=steps,
-            )
-            return kp, vp, toks_seq, last, lens
+                (kp, vp, last, lens, st, bd), toks_seq = jax.lax.scan(
+                    one,
+                    (k_pool, v_pool, last_tokens, seq_lens, fsm_state,
+                     budget),
+                    None, length=steps,
+                )
+                return kp, vp, toks_seq, last, lens, st, bd
+        else:
+            def fn(params, k_pool, v_pool, page_table, last_tokens,
+                   seq_lens, active, temps, top_ks, top_ps, seeds):
+                def one(carry, _):
+                    kp, vp, last, lens = carry
+                    kp, vp, toks, lens = body(
+                        params, kp, vp, page_table, last, lens,
+                        active, temps, top_ks, top_ps, seeds, None,
+                    )
+                    return (kp, vp, toks, lens), toks
+
+                (kp, vp, last, lens), toks_seq = jax.lax.scan(
+                    one, (k_pool, v_pool, last_tokens, seq_lens), None,
+                    length=steps,
+                )
+                return kp, vp, toks_seq, last, lens
 
         jitted = jax.jit(fn, donate_argnums=(1, 2))
         _FN_CACHE[cache_key] = jitted
         return jitted
 
-    def _get_verify_fn(self):
+    def _get_verify_fn(self, fsm: bool = False):
         """The speculative verify program: advance every lane 1..K+1 tokens
         in ONE dispatch (EngineConfig.speculative_k).
+
+        The fsm variant (built only once a grammar lane exists) lets
+        CONSTRAINED lanes speculate: every position samples under the mask
+        of the FSM state reached through the candidate prefix (a host-side
+        sequential decode would compute exactly these states), the
+        accepted count selects the state the lane actually reached, and
+        the bonus token advances it once more — rejected-tail FSM rollback
+        mirrors the seq_lens clamp below.  Free lanes riding the fsm
+        variant see all-True mask rows, which leave the sampler
+        bit-identical to the plain program.
 
         A [B, K+1]-query forward over the paged pool — the batched-prefill
         attention formulation with per-query causal masking (on pallas
@@ -983,19 +1197,25 @@ class InferenceEngine:
         masked by kv_valid in later steps and overwritten when those
         positions are next written.
         """
-        if self._verify_fn is not None:
+        if not fsm and self._verify_fn is not None:
             return self._verify_fn
         cfg, ecfg, mesh = self.cfg, self.ecfg, self.mesh
         ps, C, B = ecfg.page_size, ecfg.max_window, ecfg.max_batch
         K = ecfg.speculative_k
         S = K + 1
-        cache_key = ("verify", cfg, ps, C, B, self.mesh, K)
+        cache_key = ("verify", cfg, ps, C, B, self.mesh, K,
+                     self._grammars.shape_key if fsm else None)
         if cache_key in _FN_CACHE:
-            self._verify_fn = _FN_CACHE[cache_key]
-            return self._verify_fn
+            if not fsm:
+                self._verify_fn = _FN_CACHE[cache_key]
+            return _FN_CACHE[cache_key]
 
         def fn(params, k_pool, v_pool, page_table, last_tokens, seq_lens,
-               active, temps, top_ks, top_ps, seeds, cands, cand_lens):
+               active, temps, top_ks, top_ps, seeds, cands, cand_lens,
+               *gargs):
+            # gargs (fsm variant only) = (fsm_state [B], fsm_g [B],
+            # budget [B], token_class [G, V], trans [S, C], dist [S],
+            # slack [])
             # inputs per lane: [last_token, c_1..c_K] at positions
             # seq_len..seq_len+K; positions past cand_len are garbage
             # lanes' padding and write the trash page
@@ -1036,11 +1256,34 @@ class InferenceEngine:
             )(seeds, pos)
             V = logits.shape[-1]
             rep = lambda x: jnp.repeat(x, S)
+            allowed_flat = None
+            states_arr = None
+            if gargs:
+                fsm_state, fsm_g, budget, g_tc, g_trans, g_dist, g_slack \
+                    = gargs
+                # FSM state BEFORE each sample position: state_j is the
+                # automaton after the first j candidate tokens (exactly
+                # the states sequential decode would thread); positions
+                # past cand_len walk garbage that acceptance never reads.
+                sts = [fsm_state]
+                for j in range(K):
+                    sts.append(grammar_advance(
+                        sts[-1], fsm_g, cands[:, j], active, g_tc, g_trans
+                    ))
+                states_arr = jnp.stack(sts, axis=1)  # [B, S]
+                masks = [
+                    grammar_allowed_mask(
+                        sts[j], fsm_g, budget - j, active, g_tc, g_trans,
+                        g_dist, g_slack,
+                    )
+                    for j in range(S)
+                ]
+                allowed_flat = jnp.stack(masks, axis=1).reshape(B * S, V)
             samples = sample_tokens_per_slot(
                 logits.reshape(B * S, V),
                 SamplingParams(rep(temps), rep(top_ks), rep(top_ps)),
                 keys.reshape(B * S),
-                None,
+                allowed_flat,
             ).reshape(B, S)
             # longest exactly-matching candidate prefix, then the bonus
             # token (the sample after the last accepted candidate)
@@ -1053,11 +1296,26 @@ class InferenceEngine:
             bonus = jnp.take_along_axis(samples, m[:, None], axis=1)[:, 0]
             new_last = jnp.where(active, bonus, last_tokens)
             out = jnp.concatenate([samples, m[:, None]], axis=1)  # [B, S+1]
+            if gargs:
+                # rejected-tail FSM rollback: the state the lane keeps is
+                # the one reached through the ACCEPTED prefix (states_arr
+                # at m), advanced once by the bonus token — the exact
+                # mirror of the seq_lens clamp above
+                s_m = jnp.take_along_axis(
+                    states_arr, m[:, None], axis=1
+                )[:, 0]
+                new_fsm = grammar_advance(
+                    s_m, fsm_g, bonus, active, g_tc, g_trans
+                )
+                new_budget = budget - adv
+                return (cache.k, cache.v, out, new_last, new_lens,
+                        new_fsm, new_budget)
             return cache.k, cache.v, out, new_last, new_lens
 
         jitted = jax.jit(fn, donate_argnums=(1, 2))
         _FN_CACHE[cache_key] = jitted
-        self._verify_fn = jitted
+        if not fsm:
+            self._verify_fn = jitted
         return jitted
 
     def _get_prefill_fn(self, bucket: int):
@@ -1148,6 +1406,12 @@ class InferenceEngine:
             req.max_new_tokens = self.ecfg.max_new_tokens_default
         if len(req.prompt_ids) + req.max_new_tokens > limit:
             req.max_new_tokens = max(1, limit - len(req.prompt_ids))
+        if req.grammar is not None and (
+            getattr(req.grammar, "vocab_size", None) != self.cfg.vocab_size
+        ):
+            # an artifact compiled for another model's vocab cannot index
+            # this engine's tables: host mask path
+            req.grammar = None
         if req.logits_mask_fn is not None and hasattr(
             req.logits_mask_fn, "set_budget"
         ):
@@ -1157,11 +1421,15 @@ class InferenceEngine:
         req.prefill_ids = list(req.prompt_ids)
         if (
             self.ecfg.speculative_k > 0
-            and req.logits_mask_fn is None
+            and (req.logits_mask_fn is None or req.grammar is not None)
             and req.spec is None
         ):
-            # constrained lanes never speculate: their masks need per-token
-            # host turnaround, the opposite of a K-token device run
+            # Free lanes and DEVICE-FSM constrained lanes speculate;
+            # grammar text is the most predictable output the server
+            # emits (the verify step masks every position with the FSM
+            # state reached through the candidate prefix).  Only
+            # HOST-masked lanes are excluded — their masks need per-token
+            # host turnaround, the opposite of a K-token device run.
             req.spec = LaneSpeculator(req.prompt_ids)
         req.submit_time = time.monotonic()
         self.metrics.record_submit(len(req.prompt_ids))
@@ -1193,6 +1461,54 @@ class InferenceEngine:
             self._arg(np.zeros(B, np.int32)),
         )
         np.asarray(out)  # block until the compile + dispatch complete
+
+    def warmup_grammar(self, grammar) -> None:
+        """Compile the on-device grammar FSM programs outside serving.
+
+        Mirrors warmup_verify: registers `grammar` and runs the fsm
+        decode variant (and the fsm verify variant when speculative_k>0)
+        with an all-inactive dispatch — KV writes hit the trash page,
+        seq_lens and FSM lanes don't advance, no scheduler state changes.
+        Without this the first tool_choice-constrained request compiles
+        the fsm decode program on the scheduler thread, stalling every
+        in-flight stream.  The fused multi-step fsm variant still
+        compiles on its first >=3-lane engagement, and a LATER schema
+        registering at a larger padded shape retraces once — both noted
+        costs, not warmed here.  No-op when the grammar cannot register
+        (those requests use the host mask path anyway)."""
+        g_idx = self._grammars.register(grammar)
+        if g_idx is None:
+            return
+        B = self.ecfg.max_batch
+        if self._d_table is None or self._ctl_dirty:
+            self._refresh_ctl()
+        inactive = self._dev(np.zeros(B, bool))
+        fn = self._get_decode_fsm_fn()
+        (self.k_pool, self.v_pool, toks, self._d_seq_lens,
+         self._d_fsm, self._d_budget) = fn(
+            self.params, self.k_pool, self.v_pool,
+            self._d_table, self._d_last, self._d_seq_lens, inactive,
+            self._d_temps, self._d_top_ks, self._d_top_ps, self._d_seeds,
+            None,
+            self._d_fsm, self._d_fsm_g, self._d_budget,
+            *self._grammars.args(),
+        )
+        np.asarray(toks)  # block until the compile + dispatch complete
+        if self.ecfg.speculative_k > 0:
+            K = self.ecfg.speculative_k
+            fnv = self._get_verify_fn(fsm=True)
+            (self.k_pool, self.v_pool, out, self._d_last,
+             self._d_seq_lens, self._d_fsm, self._d_budget) = fnv(
+                self.params, self.k_pool, self.v_pool,
+                self._d_table, self._d_last, self._d_seq_lens, inactive,
+                self._d_temps, self._d_top_ks, self._d_top_ps,
+                self._d_seeds,
+                self._arg(np.zeros((B, K), np.int32)),
+                self._arg(np.zeros(B, np.int32)),
+                self._d_fsm, self._d_fsm_g, self._d_budget,
+                *self._grammars.args(),
+            )
+            np.asarray(out)
 
     def take_waiting(self) -> List[GenRequest]:
         """Remove and return every WAITING request (they own no device
@@ -1488,6 +1804,9 @@ class InferenceEngine:
         B = self.ecfg.max_batch
         self._d_last = self._dev(np.zeros(B, np.int32))
         self._d_seq_lens = self._dev(np.zeros(B, np.int32))
+        self._d_fsm = self._dev(np.full(B, -1, np.int32))
+        self._d_fsm_g = self._dev(np.zeros(B, np.int32))
+        self._d_budget = self._dev(np.zeros(B, np.int32))
         self._ctl_dirty = True
         self._park_cooldown = 0
         problems = self.self_check(repair=True)
@@ -1739,6 +2058,8 @@ class InferenceEngine:
                 f"constrained prediction diverged: {expected} != {token}"
             )
         req.output_ids.append(token)
+        if req.grammar is not None:
+            self.metrics.constrained_ondevice_tokens += 1
         if req.spec is not None:
             req.spec.push(token)  # keep the n-gram index tail-accurate
         if req.first_token_time is None:
@@ -1930,6 +2251,7 @@ class InferenceEngine:
             )
             self._d_last = self._d_last.at[slot].set(pending)
             req.pending_tok = None
+            self._set_fsm_lane(req, slot)
 
     def _admit_offslot(self) -> None:
         """Start off-slot prefills for waiting requests when slots are full.
@@ -1995,13 +2317,36 @@ class InferenceEngine:
             # blocked head's repeated lookups never did — see commit_hit)
             self.prefix_cache.commit_hit(req.cached_tokens, req.cache_source)
         # constrained decoding: the mask depends only on output_ids, which
-        # is constant across prefill chunks — build it once
+        # is constant across prefill chunks — build it once.  Grammar
+        # lanes derive the row from the compiled table (identical to the
+        # mask fn's by construction, and no automaton walk).
         req.prefill_allowed = None
-        if req.logits_mask_fn is not None:
+        if req.grammar is not None:
+            state = req.grammar.walk(req.output_ids)
+            if state >= 0:
+                # budget-aware: the prefill-sampled token obeys the same
+                # wrap-up rule the decode step enforces (a resume near the
+                # budget must not waste its token on a dist-neutral step)
+                row = req.grammar.allowed_row(
+                    state,
+                    budget_left=req.max_new_tokens - req.dispatched,
+                )[None, :]
+                req.prefill_allowed = self._dev(row)
+            else:
+                logger.warning(
+                    "grammar replay for %s stopped validating at prefill; "
+                    "degrading to the host mask path", req.request_id,
+                )
+                req.grammar = None
+        if req.logits_mask_fn is not None and req.prefill_allowed is None \
+                and req.grammar is None:
             allowed_ids = req.logits_mask_fn(req.output_ids)
             if allowed_ids is not None:
+                ids = self._in_vocab(allowed_ids)
+                if len(ids) == 0:
+                    self._record_overtight(req)
                 row = np.zeros((1, self.cfg.vocab_size), bool)
-                row[0, self._in_vocab(allowed_ids)] = True
+                row[0, ids] = True
                 req.prefill_allowed = self._dev(row)
         req.state = PREFILLING
         req.slot = slot
@@ -2046,10 +2391,12 @@ class InferenceEngine:
             bucket = self._prefill_bucket_for(req)
             if (
                 W >= 2
-                # constrained lanes need the single path end to end: its
-                # final chunk pops the sampled token synchronously so the
-                # first decode mask sees complete output_ids
+                # constrained lanes need the single path end to end: the
+                # batched program samples unmasked, and the first token
+                # must come through the masked prefill (host-masked lanes
+                # additionally pop it synchronously at the final chunk)
                 and req.logits_mask_fn is None
+                and req.grammar is None
                 and self._sp == 1
                 and self._pp == 1
                 # on pallas backends the single-sequence path runs the
@@ -2156,9 +2503,12 @@ class InferenceEngine:
                     self._d_last = self._d_last.at[req.slot].set(
                         req.output_ids[-1]
                     )
+                    self._set_fsm_lane(req, req.slot)
                     continue
                 self._d_last = self._d_last.at[req.slot].set(toks[i])
             req.dispatched += 1
+            if req.slot >= 0:
+                self._set_fsm_lane(req, req.slot)
             fin = self._limit_reason_after_dispatch(req)
             items[i] = req
             finals_row[i] = fin
@@ -2277,11 +2627,14 @@ class InferenceEngine:
                 # host-known value.
                 req.resumed = False
                 self._d_last = self._d_last.at[slot].set(req.output_ids[-1])
+                self._set_fsm_lane(req, slot)
                 return
             # Seed the device last-token lane directly from the device
             # scalar — the token value itself is fetched asynchronously.
             self._d_last = self._d_last.at[slot].set(tok)
         req.dispatched += 1
+        if slot >= 0:
+            self._set_fsm_lane(req, slot)
         final = self._limit_reason_after_dispatch(req)
         tok.copy_to_host_async()
         entry = _Fetch(arr=tok, items=[req], final=[[final]],
@@ -2289,10 +2642,13 @@ class InferenceEngine:
         self._push_entry(entry)
         if final is not None:
             self._to_draining(req)
-        if req.logits_mask_fn is not None:
-            # Constrained: the first decode mask needs this token in
+        if self._host_constrained(req):
+            # Host-masked: the first decode mask needs this token in
             # output_ids.  Only this request's scalar fetch blocks; the
-            # rest of the batch pipeline is untouched.
+            # rest of the batch pipeline is untouched.  Device-FSM lanes
+            # skip the synchronous pop — their state was advanced by the
+            # device scalar above, so the first decode mask needs nothing
+            # from the host.
             self._pop_entry_now(entry)
 
     def _limit_reason_after_dispatch(self, req: GenRequest) -> Optional[str]:
@@ -2373,40 +2729,51 @@ class InferenceEngine:
                   and s.spec_ahead == 0) else None
             for s in self.slots
         ]
-        if all(s.logits_mask_fn is None for s in active_slots):
-            # common case: every decodable lane is unconstrained + pipelined
+        # Device-FSM grammar lanes are PIPELINED lanes: their masks live
+        # on device, so they ride the common dispatch (and fused
+        # multi-step / verify) exactly like free lanes — the fsm program
+        # variant is selected whenever any rides.
+        fsm_any = any(s.grammar is not None for s in active_slots)
+        if not any(self._host_constrained(s) for s in active_slots):
+            # common case: every decodable lane is pipelined
             if spec_wait:
                 # _d_active marks spec-waiting lanes active; mask them out
                 # with an explicit group mask for this dispatch
                 d_act = self._dev(
                     np.array([m is not None for m in full_batch])
                 )
-                self._dispatch_group(full_batch, d_act, None, full=False)
+                self._dispatch_group(full_batch, d_act, None, full=False,
+                                     fsm=fsm_any)
             else:
                 self._dispatch_group(full_batch, self._d_active, None,
-                                     full=True)
+                                     full=True, fsm=fsm_any)
             self.metrics.record_decode_step(len(active_slots))
             return
-        # Mixed/constrained batch.  A constrained lane's next mask depends on
-        # every token it has emitted so far, so its decode cannot be
-        # pipelined — but that is no reason to stall anyone else (one agent
-        # doing a forced tool call must not degrade co-scheduled streams).
-        # The unconstrained lanes dispatch every scheduler step exactly as in
-        # the common case; the constrained lanes run as their own micro-batch
-        # at fetch cadence: dispatch once, wait for the token fetch to mature
-        # through the normal aging rules, then build the next mask from the
+        # Mixed/host-constrained batch.  A host-masked lane's next mask
+        # depends on every token it has emitted so far, so its decode
+        # cannot be pipelined — but that is no reason to stall anyone else
+        # (one agent doing a forced tool call must not degrade
+        # co-scheduled streams).  The pipelined lanes (free + device-FSM)
+        # dispatch every scheduler step exactly as in the common case; the
+        # host-masked lanes run as their own micro-batch at fetch cadence:
+        # dispatch once, wait for the token fetch to mature through the
+        # normal aging rules, then build the next mask from the
         # now-complete output_ids and redispatch.
         uncon = [
             s if (s is not None and s.state == ACTIVE
                   and s.spec_ahead == 0
-                  and s.logits_mask_fn is None) else None
+                  and not self._host_constrained(s)) else None
             for s in self.slots
         ]
         n_uncon = sum(1 for m in uncon if m is not None)
         if n_uncon:
             # device copy (not _arg): the where-merge of _d_last reuses it
             d_act = self._dev(np.array([m is not None for m in uncon]))
-            self._dispatch_group(uncon, d_act, None, full=False)
+            self._dispatch_group(
+                uncon, d_act, None, full=False,
+                fsm=any(m is not None and m.grammar is not None
+                        for m in uncon),
+            )
         if self._constrained_inflight():
             # The constrained fetch matures at ~RTT age (the transfer has
             # landed; popping is then effectively free), NOT at the general
@@ -2459,8 +2826,13 @@ class InferenceEngine:
             c_req = a_req = None
             if (
                 s is not None and s.state == ACTIVE
-                and s.logits_mask_fn is not None
+                and self._host_constrained(s)
                 and id(s) not in awaiting
+                # a lane that just degraded off the device-FSM path may
+                # still have undrained pipelined tokens; the host mask
+                # needs complete output_ids (+ the predicted chain), so it
+                # sits out until the pipeline catches up
+                and s.dispatched - s.drained == len(s.predicted)
                 # a forced stop token means the lane is logically finished
                 # and retires when its fetch drains: stop dispatching, and
                 # never call the mask fn past the grammar's end
@@ -2509,6 +2881,10 @@ class InferenceEngine:
                     if ids is None:
                         rows.append(np.ones(V, bool))
                     else:
+                        if len(ids) == 0 and amb_m[i] is not None:
+                            # fully clipped allow-list: the sampler will
+                            # degrade this all-False row to unconstrained
+                            self._record_overtight(amb_m[i])
                         row = np.zeros(V, bool)
                         row[ids] = True
                         rows.append(row)
@@ -2574,7 +2950,7 @@ class InferenceEngine:
         for s in lanes:
             if (
                 s.spec is None
-                or s.logits_mask_fn is not None
+                or self._host_constrained(s)
                 or s.dispatched != s.drained
             ):
                 continue
@@ -2630,14 +3006,16 @@ class InferenceEngine:
         B = ecfg.max_batch
         members: List[Optional[GenRequest]] = [None] * B
         for s in lanes:
-            # Constrained lanes NEVER ride a verify dispatch: the verify fn
-            # samples every position with allowed_mask=None, so a riding
-            # constrained lane would emit grammar-violating tokens (and a
-            # lane awaiting its constrained micro-batch fetch would be
-            # double-advanced).  They sit this iteration out and dispatch
-            # through the mixed path next iteration, exactly at the fetch
-            # cadence they already run at.
-            if s.logits_mask_fn is None:
+            # HOST-masked lanes never ride a verify dispatch: their masks
+            # need per-token host turnaround, so a riding lane would emit
+            # grammar-violating tokens (and a lane awaiting its
+            # constrained micro-batch fetch would be double-advanced).
+            # They sit this iteration out and dispatch through the mixed
+            # path next iteration, exactly at the fetch cadence they
+            # already run at.  Device-FSM grammar lanes DO ride — and
+            # propose: the fsm verify variant masks every position with
+            # the state reached through the candidate prefix.
+            if not self._host_constrained(s):
                 members[s.slot] = s
         cand_arr = np.zeros((B, K), np.int32)
         cand_lens = [0] * B
@@ -2653,16 +3031,30 @@ class InferenceEngine:
             self._assert_private_tail(s, cl)
             s.spec_ahead = cl + 1
         d_act = self._dev(np.array([m is not None for m in members]))
-        fn = self._get_verify_fn()
+        fsm = any(m is not None and m.grammar is not None for m in members)
+        fn = self._get_verify_fn(fsm=fsm)
         with self._dispatch_scope(members):
-            (self.k_pool, self.v_pool, out, new_last, new_lens) = fn(
-                self.params, self.k_pool, self.v_pool,
-                self._d_table, self._d_last, self._d_seq_lens, d_act,
-                self._d_temps, self._d_top_ks, self._d_top_ps,
-                self._d_seeds,
-                self._arg(cand_arr),
-                self._arg(np.asarray(cand_lens, np.int32)),
-            )
+            if fsm:
+                (self.k_pool, self.v_pool, out, new_last, new_lens,
+                 self._d_fsm, self._d_budget) = fn(
+                    self.params, self.k_pool, self.v_pool,
+                    self._d_table, self._d_last, self._d_seq_lens, d_act,
+                    self._d_temps, self._d_top_ks, self._d_top_ps,
+                    self._d_seeds,
+                    self._arg(cand_arr),
+                    self._arg(np.asarray(cand_lens, np.int32)),
+                    self._d_fsm, self._d_fsm_g, self._d_budget,
+                    *self._grammars.args(),
+                )
+            else:
+                (self.k_pool, self.v_pool, out, new_last, new_lens) = fn(
+                    self.params, self.k_pool, self.v_pool,
+                    self._d_table, self._d_last, self._d_seq_lens, d_act,
+                    self._d_temps, self._d_top_ks, self._d_top_ps,
+                    self._d_seeds,
+                    self._arg(cand_arr),
+                    self._arg(np.asarray(cand_lens, np.int32)),
+                )
         # device-resident truth: the fn already clamped per-lane advances
         # to the accepted length and kept inactive lanes' values
         self._d_last = new_last
@@ -2709,7 +3101,9 @@ class InferenceEngine:
 
         Multi-step trades scheduling granularity for amortized dispatch
         overhead, so it engages only when granularity is cheap: no
-        constrained lanes (masks need per-token host turnaround), no lane
+        HOST-masked lanes (their masks need per-token host turnaround;
+        device-FSM grammar lanes thread their state through the scan
+        carry and fuse), no lane
         mid-prefill (chunks advance once per iteration; bursts would slow
         TTFT by k), and enough active streams that per-token emission
         cadence is burst-dominated anyway.  A non-empty waiting queue does
@@ -2727,7 +3121,9 @@ class InferenceEngine:
         if (
             ecfg.multi_step <= 1
             or len(active_slots) < 3
-            or any(s.logits_mask_fn is not None for s in active_slots)
+            # host-masked lanes need per-token host turnaround; device-FSM
+            # grammar lanes fuse fine (their state threads the scan carry)
+            or any(self._host_constrained(s) for s in active_slots)
             or any(s is not None and s.state == PREFILLING
                    for s in self.slots)
             # off-slot prefills advance one chunk per iteration; fusing
@@ -2765,17 +3161,33 @@ class InferenceEngine:
         return k
 
     def _dispatch_multi(self, k: int) -> None:
-        """One fused k-step decode dispatch (all lanes, no mask)."""
+        """One fused k-step decode dispatch (all lanes; grammar lanes take
+        the fsm scan variant so their masks apply inside the burst)."""
         if self._ctl_dirty:
             self._refresh_ctl()
-        fn = self._get_multi_decode_fn(k)
+        fsm = any(
+            s is not None and s.state == ACTIVE and s.grammar is not None
+            for s in self.slots
+        )
+        fn = self._get_multi_decode_fn(k, fsm=fsm)
         with self._dispatch_scope(self.slots):
-            (self.k_pool, self.v_pool, toks_seq, last, lens) = fn(
-                self.params, self.k_pool, self.v_pool,
-                self._d_table, self._d_last, self._d_seq_lens,
-                self._d_active, self._d_temps, self._d_top_ks,
-                self._d_top_ps, self._d_seeds,
-            )
+            if fsm:
+                (self.k_pool, self.v_pool, toks_seq, last, lens,
+                 self._d_fsm, self._d_budget) = fn(
+                    self.params, self.k_pool, self.v_pool,
+                    self._d_table, self._d_last, self._d_seq_lens,
+                    self._d_active, self._d_temps, self._d_top_ks,
+                    self._d_top_ps, self._d_seeds,
+                    self._d_fsm, self._d_fsm_g, self._d_budget,
+                    *self._grammars.args(),
+                )
+            else:
+                (self.k_pool, self.v_pool, toks_seq, last, lens) = fn(
+                    self.params, self.k_pool, self.v_pool,
+                    self._d_table, self._d_last, self._d_seq_lens,
+                    self._d_active, self._d_temps, self._d_top_ks,
+                    self._d_top_ps, self._d_seeds,
+                )
         self._d_last = last
         self._d_seq_lens = lens
         entry = self._book_dispatch(toks_seq, list(self.slots), steps=k)
@@ -2800,6 +3212,7 @@ class InferenceEngine:
         allowed: Optional[np.ndarray],
         full: bool,
         forced: Optional[Tuple[np.ndarray, np.ndarray]] = None,
+        fsm: bool = False,
     ) -> _Fetch:
         """Dispatch one decode for the lanes in `members` (slot-aligned;
         None = not in this group).  Lanes outside the group are masked
@@ -2807,10 +3220,25 @@ class InferenceEngine:
         seq_lens don't advance, and their device last-token lanes keep their
         previous value via the where-merge below.  `forced` = ([B] int32
         tokens, [B] bool on-mask): grammar-forced lanes whose sampled token
-        is overridden device-side (no [B, V] mask upload).
+        is overridden device-side (no [B, V] mask upload).  `fsm` selects
+        the grammar-FSM program variant (some member carries a device
+        automaton state); the fn itself gates state/budget updates on the
+        group's active mask, so out-of-group lanes keep theirs.
         """
         with self._dispatch_scope(members):
-            if forced is None:
+            if fsm:
+                (self.k_pool, self.v_pool, toks, self._d_seq_lens,
+                 self._d_fsm, self._d_budget) = \
+                    self._get_decode_fsm_fn()(
+                        self.params, self.k_pool, self.v_pool,
+                        self._d_table, self._d_last, self._d_seq_lens,
+                        d_active, self._d_temps, self._d_top_ks,
+                        self._d_top_ps, self._d_seeds,
+                        None if allowed is None else self._arg(allowed),
+                        self._d_fsm, self._d_fsm_g, self._d_budget,
+                        *self._grammars.args(),
+                    )
+            elif forced is None:
                 self.k_pool, self.v_pool, toks, self._d_seq_lens = \
                     self._decode_fn(
                         self.params, self.k_pool, self.v_pool,
@@ -2982,6 +3410,85 @@ class InferenceEngine:
         self._d_seeds = self._dev(np.array(
             [s.seed if s else 0 for s in slots], np.uint32))
         self._ctl_dirty = False
+
+    @staticmethod
+    def _host_constrained(s: GenRequest) -> bool:
+        """Does this lane take the HOST mask path (awaited micro-batch /
+        forced-token chaining)?  Grammar lanes advance their FSM inside
+        the jitted step instead and ride the pipelined dispatch."""
+        return s.logits_mask_fn is not None and s.grammar is None
+
+    def _set_fsm_lane(self, req: GenRequest, slot: int) -> None:
+        """Seed the lane's device FSM state/budget at activation.
+
+        Called whenever a lane takes a decode slot (prefill finish, parked
+        seat, resume): non-grammar lanes park the slot at the -1
+        unconstrained sentinel (a previous occupant's state must never
+        leak); grammar lanes replay their host-known output prefix through
+        the host copy of the table, then — if their latest token is still
+        an in-flight device scalar — advance by it lazily on device (no
+        round trip).  A grammar that cannot register (table-set cap,
+        vocab mismatch) or a replay that stops validating degrades the
+        lane to the host mask path.
+        """
+        if req.grammar is None:
+            self._d_fsm = self._d_fsm.at[slot].set(-1)
+            return
+        g_idx = self._grammars.register(req.grammar)
+        if g_idx is None:
+            logger.warning(
+                "grammar for %s cannot register (table set full or vocab "
+                "mismatch); degrading to the host mask path",
+                req.request_id,
+            )
+            req.grammar = None
+            self._d_fsm = self._d_fsm.at[slot].set(-1)
+            return
+        off = self._grammars.offsets[g_idx]
+        # at activation at most ONE token (the prefill's sample, still a
+        # device scalar in _d_last) can be in flight beyond output_ids
+        drained_all = req.drained == req.dispatched
+        state = req.grammar.walk(req.output_ids)
+        if state < 0:
+            logger.warning(
+                "grammar replay for %s stopped validating; degrading to "
+                "the host mask path", req.request_id,
+            )
+            req.grammar = None
+            self._d_fsm = self._d_fsm.at[slot].set(-1)
+            return
+        if drained_all:
+            self._d_fsm = self._d_fsm.at[slot].set(off + state)
+        else:
+            # exactly the prefill's sampled token is in flight: advance
+            # the replayed state by the device scalar without fetching it
+            tc = self._grammars.token_class[g_idx]
+            nxt = self._grammars.trans[off + state, tc[self._d_last[slot]]]
+            self._d_fsm = self._d_fsm.at[slot].set(nxt)
+        self._d_fsm_g = self._d_fsm_g.at[slot].set(g_idx)
+        self._d_budget = self._d_budget.at[slot].set(
+            req.max_new_tokens - req.dispatched
+        )
+
+    def _record_overtight(self, req: GenRequest) -> None:
+        """An over-tight constrained mask row (no token satisfies the
+        grammar here): ops/sampling degrades the row to unconstrained —
+        count it, and log once per request with the mask's state."""
+        self.metrics.constrained_mask_overtight += 1
+        if req.overtight_logged:
+            return
+        req.overtight_logged = True
+        desc = "?"
+        fn = req.logits_mask_fn
+        if fn is not None and hasattr(fn, "state_desc"):
+            try:
+                desc = fn.state_desc()
+            except Exception:
+                pass
+        logger.warning(
+            "over-tight constrained mask for %s (fsm state %s): sampler "
+            "degrades this row to unconstrained", req.request_id, desc,
+        )
 
     def _next_constraint(self, s: GenRequest):
         """Classify the next constrained step for a lane.

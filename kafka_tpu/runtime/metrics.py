@@ -32,6 +32,23 @@ SPECULATION_METRIC_KEYS = (
     "speculation_accepted_per_step",
 )
 
+# Constrained-decoding metric keys (ISSUE 7).  Same registry discipline:
+# every key must appear in BOTH this snapshot and server/prometheus.py,
+# and neither file may invent constrained_* metrics outside the tuple
+# (static check in tests/test_grammar_fsm.py).
+CONSTRAINED_METRIC_KEYS = (
+    # genuine constrained choice points that awaited a device->host round
+    # trip (the host mask-fn micro-batch; ~0 in on-device grammar mode)
+    "constrained_roundtrips",
+    # over-tight mask rows (no token can satisfy the grammar here): the
+    # sampler degrades the row to unconstrained — silently, before this
+    # counter existed
+    "constrained_mask_overtight",
+    # tokens emitted by lanes advancing through the device-resident
+    # grammar FSM (zero-roundtrip constrained decoding)
+    "constrained_ondevice_tokens",
+)
+
 
 def _copy_samples(dq) -> List[float]:
     """Snapshot a histogram deque that another thread may be appending to.
@@ -125,9 +142,11 @@ class EngineMetrics:
     speculation_accepted_tokens: int = 0
     speculation_rejected_tokens: int = 0
     speculation_verify_steps: int = 0  # verify dispatches (1 per step)
-    # genuine constrained choice points that awaited a device->host round
-    # trip (engine._dispatch_decode awaited micro-batch)
+    # constrained decoding (CONSTRAINED_METRIC_KEYS): awaited host
+    # round trips, over-tight mask degrades, and device-FSM tokens
     constrained_roundtrips: int = 0
+    constrained_mask_overtight: int = 0
+    constrained_ondevice_tokens: int = 0
 
     def __post_init__(self) -> None:
         self.ttft_ms: Deque[float] = collections.deque(maxlen=self.window)
@@ -248,6 +267,14 @@ class EngineMetrics:
 
     # -- cross-thread export --------------------------------------------
 
+    def constrained_snapshot(self) -> Dict[str, int]:
+        """The constrained-decoding section (CONSTRAINED_METRIC_KEYS)."""
+        return {
+            "constrained_roundtrips": self.constrained_roundtrips,
+            "constrained_mask_overtight": self.constrained_mask_overtight,
+            "constrained_ondevice_tokens": self.constrained_ondevice_tokens,
+        }
+
     def speculation_snapshot(self) -> Dict[str, object]:
         """The speculative-decoding section (SPECULATION_METRIC_KEYS):
         raw monotone counters plus the two derived rates dashboards want
@@ -311,7 +338,10 @@ class EngineMetrics:
                     ("first_fetch", self.ttft_fetch_ms),
                 )
             },
+            # legacy top-level key kept for dashboards; the full family
+            # lives in the "constrained" section
             "constrained_roundtrips": self.constrained_roundtrips,
+            "constrained": self.constrained_snapshot(),
             "speculation": self.speculation_snapshot(),
             "tpot_ms": {k: round(v, 2) for k, v in
                         _percentiles(_copy_samples(self.tpot_ms)).items()},
@@ -332,13 +362,9 @@ class EngineMetrics:
                 },
             },
         }
-        # DEPRECATED aliases (one release, PR 5): the fetch-pipeline waste
-        # counters used to be exported as speculative_* — before real
-        # speculative decoding existed.  Dashboards keyed on the old names
-        # keep working while they migrate; README documents the rename.
-        tok = snap["tokens"]
-        tok["speculative_wasted"] = tok["fetch_pipeline_wasted"]
-        tok["speculative_waste_frac"] = tok["fetch_pipeline_waste_frac"]
+        # (the speculative_wasted_* aliases the PR 5 rename kept for one
+        # release are gone — fetch_pipeline_wasted_* is the only spelling;
+        # README "Metrics rename" documents the removal)
         if engine is not None:
             snap["engine"] = {
                 "active": engine.num_active,

@@ -41,6 +41,7 @@ from typing import Dict, Optional
 from ..models.config import ModelConfig
 
 GiB = 1024**3
+MiB = 1024**2
 
 # chip generation -> HBM bytes per chip
 HBM_BYTES = {
@@ -120,11 +121,19 @@ class MemoryPlan:
     # fields, not parse the free-text notes.
     kv_shard: int = 1
     tq: int = 1
+    # On-device constrained-decoding grammar tables (ISSUE 7): the
+    # KAFKA_TPU_GRAMMAR_TABLE_MB reservation, replicated per device.  The
+    # engine's _GrammarTables.register enforces the same figure as a
+    # COMBINED budget over all live grammars' padded tables (over-budget
+    # registrations degrade to the host mask path), so this charge is the
+    # true worst case.  0 when on-device grammar is disabled.
+    grammar_table_bytes: int = 0
     notes: str = ""
 
     @property
     def total_bytes(self) -> int:
-        return self.weight_bytes + self.kv_pool_bytes + self.activation_bytes
+        return (self.weight_bytes + self.kv_pool_bytes
+                + self.activation_bytes + self.grammar_table_bytes)
 
     @property
     def usable_bytes(self) -> int:
@@ -162,6 +171,7 @@ class MemoryPlan:
             "kv_replicated": self.kv_replicated,
             "kv_shard": self.kv_shard,
             "tq": self.tq,
+            "grammar_table_mib": round(self.grammar_table_bytes / MiB, 2),
             "window_tokens": self.window_tokens,
             "max_concurrent_windows": self.max_concurrent_windows,
             "notes": self.notes,
@@ -299,9 +309,22 @@ def plan_memory(
     chip: str = "v5e",
     reserve_frac: float = 0.08,
     kv_shard: Optional[int] = None,
+    grammar_table_bytes: Optional[int] = None,
 ) -> MemoryPlan:
     if hbm_bytes is None:
         hbm_bytes = HBM_BYTES[chip]
+    if grammar_table_bytes is None:
+        # charge the on-device constrained-decoding table reservation
+        # (the compiler caps artifacts at this size; tables replicate
+        # per device) unless the feature is disabled
+        from ..llm.constrained import (
+            _grammar_table_cap_bytes,
+            grammar_ondevice_enabled,
+        )
+
+        grammar_table_bytes = (
+            _grammar_table_cap_bytes() if grammar_ondevice_enabled() else 0
+        )
     kv_shard = _kv_shard(cfg, tp, kv_shard)
     kv_replicated = tp > 1 and kv_shard < tp
     window = max_pages_per_seq * page_size
@@ -330,6 +353,7 @@ def plan_memory(
         # with tp=8 reports tq=8 (full 8-way replication), not tq=1
         kv_shard=kv_shard,
         tq=tp // kv_shard,
+        grammar_table_bytes=grammar_table_bytes,
         notes=(
             (
                 f"grouped GQA layout: tensor degree {tp} factorizes "

@@ -266,6 +266,28 @@ def build_tpu_provider(cfg: ServingConfig) -> LLMProvider:
         per_engine = (
             3 if engine_cfg.multi_step > 1 and cfg.max_batch >= 3 else 1
         )
+        # grammar artifact for the fsm-program warmup below (None =
+        # feature disabled, uncompilable, or no tokenizer eot in vocab)
+        _warmup_grammar = None
+        from ..llm.constrained import (
+            build_tool_call_mask_fn,
+            compile_grammar_for_mask_fn,
+            grammar_ondevice_enabled,
+        )
+
+        if grammar_ondevice_enabled():
+            from ..agents.base import IDLE_TOOL
+
+            _warm_tools = [
+                t.to_openai() for t in default_builtin_tools(cfg)
+            ] + [IDLE_TOOL]
+            _warm_mask = build_tool_call_mask_fn(
+                tokenizer, _warm_tools, "required"
+            )
+            if _warm_mask is not None:
+                _warmup_grammar = compile_grammar_for_mask_fn(
+                    _warm_mask, model_cfg.vocab_size
+                )
         for n, e in enumerate(engines):
             for j, blen in enumerate(bucket_lens):
                 e.submit(GenRequest(
@@ -298,6 +320,16 @@ def build_tpu_provider(cfg: ServingConfig) -> LLMProvider:
             # organic engagement depends on generated repetition, so the
             # engine compiles it via an all-masked dispatch (no-op at K=0)
             e.warmup_verify()
+            # on-device grammar FSM programs (KAFKA_TPU_GRAMMAR_ONDEVICE):
+            # compile the fsm decode/verify variants against the
+            # builtin-tools + idle grammar — the schema the agent path
+            # constrains to in the common (no-MCP) deployment, so the
+            # first forced tool call pays serving latency, not an XLA
+            # compile on the scheduler thread.  A deployment whose merged
+            # MCP registry differs registers its grammar at request time
+            # (one retrace if the padded table shape grows).
+            if _warmup_grammar is not None:
+                e.warmup_grammar(_warmup_grammar)
         engine.run_to_completion()
         engine_cfg.max_waiting = _admission_bound
         for e in engines:

@@ -108,7 +108,8 @@ def render_prometheus(snap: Dict[str, Any]) -> str:
                  "Token counters by kind.")
         # fetch_pipeline_wasted was exported as kind="speculative_wasted"
         # before real speculative decoding existed (renamed PR 5; the
-        # JSON endpoint keeps the old keys as deprecated aliases)
+        # JSON endpoint's deprecated aliases were removed one release
+        # later — README "Metrics rename")
         for kind in ("prompt", "generated", "fetch_pipeline_wasted"):
             if kind in tokens:
                 w.sample("kafka_tpu_tokens_total", tokens[kind],
@@ -148,11 +149,28 @@ def render_prometheus(snap: Dict[str, Any]) -> str:
                   emission["burst_gap_ms"],
                   "Gap between emission bursts.")
 
-    if "constrained_roundtrips" in snap:
+    # constrained decoding (runtime/metrics.CONSTRAINED_METRIC_KEYS — the
+    # registry a static test enforces in both files)
+    con = dict(snap.get("constrained") or {})
+    if "constrained_roundtrips" not in con and "constrained_roundtrips" in snap:
+        con["constrained_roundtrips"] = snap["constrained_roundtrips"]
+    if "constrained_roundtrips" in con:
         w.family("kafka_tpu_constrained_roundtrips_total", "counter",
                  "Constrained choice points that awaited a device fetch.")
         w.sample("kafka_tpu_constrained_roundtrips_total",
-                 snap["constrained_roundtrips"])
+                 con["constrained_roundtrips"])
+    if "constrained_mask_overtight" in con:
+        w.family("kafka_tpu_constrained_overtight_total", "counter",
+                 "Over-tight constrained mask rows degraded to "
+                 "unconstrained sampling.")
+        w.sample("kafka_tpu_constrained_overtight_total",
+                 con["constrained_mask_overtight"])
+    if "constrained_ondevice_tokens" in con:
+        w.family("kafka_tpu_constrained_ondevice_tokens_total", "counter",
+                 "Tokens emitted through the device-resident grammar FSM "
+                 "(zero-roundtrip constrained decoding).")
+        w.sample("kafka_tpu_constrained_ondevice_tokens_total",
+                 con["constrained_ondevice_tokens"])
 
     spec = snap.get("speculation") or {}
     if spec:

@@ -1,0 +1,571 @@
+"""On-device grammar FSM constrained decoding (ISSUE 7).
+
+The load-bearing property: the device-FSM path is a pure latency
+optimization — per-state allowed token sets are compiled from the exact
+host-mask semantics (llm/constrained.allowed_ids_for), so the FSM path
+and the host mask-fn path emit BIT-IDENTICAL token streams (greedy and
+sampled) across random tool schemas, every tool_choice form, mixed
+batches, and preemption churn, while the FSM path awaits ZERO device→host
+round trips.  Constrained lanes may also speculate: the verify step masks
+every position with the FSM state reached through the candidate prefix,
+and rejected-tail FSM rollback mirrors the KV seq_len clamp.
+"""
+
+import json
+import logging
+import random
+import re
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from kafka_tpu.llm.constrained import (
+    ToolCallMaskFn,
+    allowed_ids_for,
+    compile_grammar_for_mask_fn,
+    compile_tool_call_grammar,
+    validate_tool_call_json,
+)
+from kafka_tpu.models import ModelConfig, init_params
+from kafka_tpu.models.tokenizer import ByteTokenizer
+from kafka_tpu.runtime import EngineConfig, GenRequest, InferenceEngine
+
+TOOLS = [
+    {
+        "type": "function",
+        "function": {
+            "name": "get_weather",
+            "parameters": {
+                "type": "object",
+                "properties": {
+                    "city": {"type": "string"},
+                    "units": {"type": "string"},
+                },
+            },
+        },
+    },
+    {
+        "type": "function",
+        "function": {
+            "name": "get_time",
+            "parameters": {"type": "object", "properties": {}},
+        },
+    },
+]
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = ModelConfig(name="gfsm-test", vocab_size=262, hidden_size=64,
+                      intermediate_size=128, num_layers=2, num_heads=4,
+                      num_kv_heads=2, head_dim=16, dtype="float32")
+    params = init_params(cfg, jax.random.PRNGKey(3))
+    return cfg, params
+
+
+@pytest.fixture(scope="module")
+def tok():
+    return ByteTokenizer()
+
+
+@pytest.fixture(scope="module")
+def grammar(tok):
+    g = compile_tool_call_grammar(tok, TOOLS, vocab_size=262)
+    assert g is not None
+    return g
+
+
+def make_engine(cfg, params, **kw):
+    defaults = dict(max_batch=2, page_size=16, num_pages=64,
+                    max_pages_per_seq=16, prefill_buckets=(16, 32, 64))
+    defaults.update(kw)
+    return InferenceEngine(cfg, params, EngineConfig(**defaults),
+                           kv_dtype=jnp.float32)
+
+
+def run_constrained(cfg, params, tok, grammar_or_none, tools=TOOLS,
+                    prompt="call a tool", force_name=None, max_new=120,
+                    temperature=0.0, seed=0, engine=None, **ecfg_kw):
+    eng = engine or make_engine(cfg, params, **ecfg_kw)
+    mask = ToolCallMaskFn(tok, tools, force_name=force_name)
+    req = GenRequest(
+        request_id=f"r-{id(mask)}", prompt_ids=tok.encode(prompt),
+        max_new_tokens=max_new, temperature=temperature, seed=seed,
+        stop_token_ids=tuple(tok.stop_ids), logits_mask_fn=mask,
+        grammar=grammar_or_none,
+    )
+    eng.submit(req)
+    eng.run_to_completion()
+    return req, eng
+
+
+def random_tools(rng: random.Random):
+    """A random small tool schema (names/props from a safe alphabet)."""
+    def word():
+        return "".join(rng.choice("abcdefgh_") for _ in range(rng.randint(2, 8)))
+
+    tools = []
+    for _ in range(rng.randint(1, 3)):
+        props = {word(): {"type": "string"}
+                 for _ in range(rng.randint(0, 3))}
+        params = {"type": "object", "properties": props}
+        if rng.random() < 0.2:
+            params["additionalProperties"] = True
+        tools.append({"type": "function",
+                      "function": {"name": word(), "parameters": params}})
+    return tools
+
+
+class TestCompiler:
+    def test_rows_match_host_mask_along_trajectory(self, tok, grammar):
+        """The compiled table's per-state allowed sets must equal the host
+        mask fn's, position by position, along a random legal walk."""
+        rng = random.Random(1)
+        fn = ToolCallMaskFn(tok, TOOLS)
+        out, state = [], 0
+        for _ in range(150):
+            host = {int(x) for x in fn(out)}
+            dev = set(np.nonzero(grammar.allowed_row(state))[0].tolist())
+            assert host == dev, sorted(host ^ dev)[:10]
+            if host == {tok.eot_id}:
+                return
+            t = rng.choice(sorted(host))
+            out.append(t)
+            state = grammar.walk([t], start=state)
+            assert state >= 0
+        pytest.fail("walk never reached done")
+
+    def test_walk_rejects_illegal_history(self, tok, grammar):
+        bad = tok.encode("not json at all")
+        assert grammar.walk(bad) == -1
+
+    def test_dist_decreases_to_done(self, tok, grammar):
+        """Every state has a distance-decreasing successor (the wrap-up
+        guarantee), and done states sit at distance 0."""
+        for s in range(grammar.num_states):
+            d = int(grammar.dist[s])
+            if d == 0:
+                continue
+            row = grammar.trans[s]
+            succ = row[row >= 0]
+            assert (grammar.dist[succ] < d).any(), s
+
+    def test_table_cap_falls_back(self, tok):
+        g = compile_tool_call_grammar(tok, TOOLS, vocab_size=262,
+                                      max_table_bytes=1024)
+        assert g is None
+
+    def test_eot_outside_vocab_falls_back(self, tok):
+        g = compile_tool_call_grammar(tok, TOOLS, vocab_size=16)
+        assert g is None
+
+    def test_env_gate_and_cache(self, tok, monkeypatch):
+        mask = ToolCallMaskFn(tok, TOOLS)
+        monkeypatch.setenv("KAFKA_TPU_GRAMMAR_ONDEVICE", "0")
+        assert compile_grammar_for_mask_fn(mask, 262) is None
+        monkeypatch.delenv("KAFKA_TPU_GRAMMAR_ONDEVICE")
+        g1 = compile_grammar_for_mask_fn(mask, 262)
+        g2 = compile_grammar_for_mask_fn(ToolCallMaskFn(tok, TOOLS), 262)
+        assert g1 is not None and g1 is g2  # cached per (schema, vocab)
+
+    def test_custom_mask_fn_not_lowered(self):
+        assert compile_grammar_for_mask_fn(lambda out: None, 262) is None
+
+
+class TestDifferentialEquivalence:
+    """On-device FSM vs host mask-fn path: bit-identical token streams."""
+
+    @pytest.mark.parametrize("temperature,seed", [
+        (0.0, 0), (1.0, 1), (1.5, 2),
+    ])
+    def test_single_lane_bit_identical(self, model, tok, grammar,
+                                       temperature, seed):
+        cfg, params = model
+        host, eh = run_constrained(cfg, params, tok, None,
+                                   temperature=temperature, seed=seed)
+        fsm, ef = run_constrained(cfg, params, tok, grammar,
+                                  temperature=temperature, seed=seed)
+        assert fsm.output_ids == host.output_ids
+        assert fsm.constrained_roundtrips == 0
+        assert host.constrained_roundtrips >= 0
+        assert ef.metrics.constrained_ondevice_tokens == len(fsm.output_ids)
+        text = tok.decode(
+            [t for t in fsm.output_ids if t not in tok.stop_ids])
+        assert validate_tool_call_json(text, TOOLS), text
+
+    def test_random_schema_matrix(self, model, tok):
+        """Random schemas x tool_choice forms, greedy: both paths emit the
+        same stream while neither is in its wrap-up window (wrap TIMING
+        legitimately differs — the FSM's jump-aware slack engages earlier
+        than the host's fixed 4 chars on jump-heavy schemas), and the FSM
+        path never awaits a host round trip."""
+        cfg, params = model
+        rng = random.Random(42)
+        for case in range(3):
+            tools = random_tools(rng)
+            names = [t["function"]["name"] for t in tools]
+            force = rng.choice(names) if rng.random() < 0.5 else None
+            g = compile_tool_call_grammar(tok, tools, force_name=force,
+                                          vocab_size=262)
+            assert g is not None, tools
+            host, _ = run_constrained(cfg, params, tok, None, tools=tools,
+                                      force_name=force, seed=case)
+            fsm, _ = run_constrained(cfg, params, tok, g, tools=tools,
+                                     force_name=force, seed=case)
+            # positions with budget_left > dist + wrap_slack are outside
+            # BOTH wrap windows (the FSM's slack >= the host's 4): there
+            # the masks are provably equal, so the streams must match
+            state, wrap_free = 0, 0
+            for i, t in enumerate(host.output_ids):
+                if 120 - i <= int(g.dist[state]) + g.wrap_slack:
+                    break
+                wrap_free = i + 1
+                state = g.walk([t], start=state)
+                if state < 0:
+                    break  # host sampled a stop token (not in the DFA)
+            assert wrap_free >= 10, (case, wrap_free)  # non-vacuous
+            assert (fsm.output_ids[:wrap_free]
+                    == host.output_ids[:wrap_free]), (case, tools)
+            assert fsm.constrained_roundtrips == 0
+            text = tok.decode(
+                [t for t in fsm.output_ids if t not in tok.stop_ids])
+            assert validate_tool_call_json(text, tools), (text, tools)
+
+    def test_mixed_batch_free_lane_unperturbed(self, model, tok, grammar):
+        """A free lane co-scheduled with an FSM lane produces exactly its
+        solo-run tokens (the all-True mask rows leave the sampler
+        bit-identical), and the FSM lane matches its own solo run."""
+        cfg, params = model
+        eng = make_engine(cfg, params)
+        solo_free = eng.generate(tok.encode("stream me a story"),
+                                 max_new_tokens=48)
+        solo_con, _ = run_constrained(cfg, params, tok, grammar)
+
+        eng2 = make_engine(cfg, params)
+        free = GenRequest(request_id="free",
+                          prompt_ids=tok.encode("stream me a story"),
+                          max_new_tokens=48)
+        mask = ToolCallMaskFn(tok, TOOLS)
+        con = GenRequest(request_id="con",
+                         prompt_ids=tok.encode("call a tool"),
+                         max_new_tokens=120,
+                         stop_token_ids=tuple(tok.stop_ids),
+                         logits_mask_fn=mask, grammar=grammar)
+        eng2.submit(free)
+        eng2.submit(con)
+        eng2.run_to_completion()
+        assert free.output_ids == solo_free.output_ids
+        assert con.output_ids == solo_con.output_ids
+        assert eng2.metrics.constrained_roundtrips == 0
+
+    def test_preemption_churn_bit_identical(self, model, tok, grammar):
+        """The FSM lane survives preemption (host replay reseeds the
+        device state at re-prefill) and still reproduces its solo run."""
+        cfg, params = model
+        solo, _ = run_constrained(cfg, params, tok, grammar)
+        # pool sized so the free lane (180-token prompt -> 12 pages at
+        # prefill, growing toward 16) collides with the constrained lane
+        # (~4 pages) while BOTH are mid-flight: 17 allocatable pages run
+        # out and the youngest lane (con) gets preempted
+        eng = make_engine(cfg, params, num_pages=18)
+        free = GenRequest(request_id="free", prompt_ids=[5] * 180,
+                          max_new_tokens=60)
+        mask = ToolCallMaskFn(tok, TOOLS)
+        con = GenRequest(request_id="con",
+                         prompt_ids=tok.encode("call a tool"),
+                         max_new_tokens=120,
+                         stop_token_ids=tuple(tok.stop_ids),
+                         logits_mask_fn=mask, grammar=grammar)
+        eng.submit(free)
+        eng.submit(con)  # youngest: the preemption victim
+        eng.run_to_completion()
+        assert eng.metrics.requests_preempted >= 1
+        assert con.output_ids == solo.output_ids
+        assert eng.metrics.constrained_roundtrips == 0
+
+    def test_slot_reuse_after_cancel_resets_fsm_lane(self, model, tok,
+                                                     grammar):
+        """A free lane seated in a slot a cancelled FSM lane used must not
+        inherit its automaton state."""
+        cfg, params = model
+        eng = make_engine(cfg, params, max_batch=1)
+        mask = ToolCallMaskFn(tok, TOOLS)
+        con = GenRequest(request_id="con",
+                         prompt_ids=tok.encode("call a tool"),
+                         max_new_tokens=120,
+                         stop_token_ids=tuple(tok.stop_ids),
+                         logits_mask_fn=mask, grammar=grammar)
+        eng.submit(con)
+        for _ in range(6):
+            eng.step()
+        eng.cancel("con")
+        solo = make_engine(cfg, params, max_batch=1).generate(
+            tok.encode("plain text"), max_new_tokens=24)
+        free = GenRequest(request_id="free",
+                          prompt_ids=tok.encode("plain text"),
+                          max_new_tokens=24)
+        eng.submit(free)
+        eng.run_to_completion()
+        assert free.output_ids == solo.output_ids
+
+
+class TestWrapUp:
+    @pytest.mark.parametrize("budget,seed", [(48, 11), (64, 12), (56, 13)])
+    def test_tight_budget_still_parses(self, model, tok, grammar, budget,
+                                       seed):
+        """Device-side wrap-up (distance-decreasing transitions near the
+        budget) closes the JSON before tokens run out, like the host
+        path's wrap-up mode."""
+        cfg, params = model
+        req, _ = run_constrained(cfg, params, tok, grammar, prompt="go",
+                                 max_new=budget, temperature=2.0, seed=seed)
+        text = tok.decode(
+            [t for t in req.output_ids if t not in tok.stop_ids])
+        assert validate_tool_call_json(text, TOOLS), text
+
+    def test_jump_aware_slack_closes_repetitive_greedy(self, model, tok):
+        """A single-tool schema where greedy repeats `, "city": false`
+        forever: each comma JUMPS the shortest-close distance by the whole
+        forced key run, which strands a fixed-4 slack window (the host
+        path demonstrably emits unparseable JSON here).  The compiled
+        grammar's jump-aware wrap_slack must still close in budget."""
+        cfg, params = model
+        tools = [{"type": "function", "function": {
+            "name": "get_weather",
+            "parameters": {"type": "object",
+                           "properties": {"city": {"type": "string"}}},
+        }}]
+        g = compile_tool_call_grammar(tok, tools, vocab_size=262)
+        assert g is not None and g.wrap_slack > 4
+        req, _ = run_constrained(cfg, params, tok, g, tools=tools,
+                                 max_new=120)
+        text = tok.decode(
+            [t for t in req.output_ids if t not in tok.stop_ids])
+        assert validate_tool_call_json(text, tools), text
+        assert req.finish_reason == "stop"
+
+
+class ForcedSpeculator:
+    """Scripted proposal fn (deterministic engagement)."""
+
+    def __init__(self, fn):
+        self._fn = fn
+        self.hist = []
+        self.accept_ewma = 1.0
+        self.observed = []
+
+    def push(self, token):
+        self.hist.append(token)
+
+    def propose(self, k_max):
+        return list(self._fn(self.hist, k_max))[:max(0, k_max)]
+
+    def observe(self, accepted, proposed):
+        self.observed.append((accepted, proposed))
+
+
+class TestSpeculationOnConstrained:
+    """Constrained lanes speculate (ISSUE 7 lifts the PR 5 exclusion):
+    FSM rollback mirrors KV rollback, greedy output bit-identical to
+    speculation off."""
+
+    def _spec_engine(self, cfg, params, k=4):
+        return make_engine(cfg, params, max_batch=2, page_size=8,
+                           num_pages=64, max_pages_per_seq=8,
+                           prefill_buckets=(8, 16, 32, 64),
+                           speculative_k=k)
+
+    def test_grammar_lane_gets_speculator(self, model, tok, grammar):
+        cfg, params = model
+        eng = self._spec_engine(cfg, params)
+        mask = ToolCallMaskFn(tok, TOOLS)
+        fsm_req = GenRequest(request_id="g", prompt_ids=tok.encode("x"),
+                             stop_token_ids=tuple(tok.stop_ids),
+                             logits_mask_fn=mask, grammar=grammar)
+        host_req = GenRequest(request_id="h", prompt_ids=tok.encode("x"),
+                              stop_token_ids=tuple(tok.stop_ids),
+                              logits_mask_fn=ToolCallMaskFn(tok, TOOLS))
+        eng.submit(fsm_req)
+        eng.submit(host_req)
+        assert fsm_req.spec is not None   # device-FSM lanes speculate
+        assert host_req.spec is None      # host-masked lanes still don't
+        eng.run_to_completion()
+
+    def test_greedy_bit_identical_spec_on_off(self, model, tok, grammar):
+        cfg, params = model
+        base_req = None
+        outs = {}
+        for k in (0, 4):
+            eng = self._spec_engine(cfg, params, k=k)
+            mask = ToolCallMaskFn(tok, TOOLS)
+            req = GenRequest(request_id=f"s{k}",
+                             prompt_ids=tok.encode("call a tool"),
+                             max_new_tokens=120,
+                             stop_token_ids=tuple(tok.stop_ids),
+                             logits_mask_fn=mask, grammar=grammar)
+            eng.submit(req)
+            eng.run_to_completion()
+            outs[k] = list(req.output_ids)
+            base_req = req
+        assert outs[0] == outs[4]
+        text = tok.decode(
+            [t for t in base_req.output_ids if t not in tok.stop_ids])
+        assert validate_tool_call_json(text, TOOLS), text
+
+    def test_fsm_rollback_matches_kv_rollback(self, model, tok, grammar):
+        """Corrupt-tail proposals force partial acceptance every round;
+        the continuation must still be the non-speculative stream —
+        possible only if the FSM state rolled back exactly with seq_len
+        (a stale FSM state would shift every later mask)."""
+        cfg, params = model
+        base, _ = run_constrained(cfg, params, tok, grammar)
+        eng = self._spec_engine(cfg, params, k=4)
+        mask = ToolCallMaskFn(tok, TOOLS)
+        req = GenRequest(request_id="cr",
+                         prompt_ids=tok.encode("call a tool"),
+                         max_new_tokens=120,
+                         stop_token_ids=tuple(tok.stop_ids),
+                         logits_mask_fn=mask, grammar=grammar)
+        eng.submit(req)
+        plen = len(req.prompt_ids)
+
+        def cands(hist, k):
+            n = len(hist) - plen
+            out = list(base.output_ids[n:n + k])
+            if len(out) >= 2:
+                out[-1] = (out[-1] + 1) % 260  # corrupt the tail
+            return out
+
+        req.spec = ForcedSpeculator(cands)
+        eng.run_to_completion()
+        assert req.output_ids == base.output_ids
+        snap = eng.metrics.speculation_snapshot()
+        assert snap["speculation_accepted_tokens"] > 0
+        assert snap["speculation_rejected_tokens"] > 0  # rollback happened
+
+    def test_sampled_stream_matches_sequential(self, model, tok, grammar):
+        """Temperature sampling through the fsm verify path still equals
+        the sequential path (per-(seed, position) keys + exact-match
+        acceptance compose with the per-position FSM masks)."""
+        cfg, params = model
+        base, _ = run_constrained(cfg, params, tok, grammar,
+                                  temperature=1.2, seed=9)
+        eng = self._spec_engine(cfg, params, k=3)
+        mask = ToolCallMaskFn(tok, TOOLS)
+        req = GenRequest(request_id="ts",
+                         prompt_ids=tok.encode("call a tool"),
+                         max_new_tokens=120, temperature=1.2, seed=9,
+                         stop_token_ids=tuple(tok.stop_ids),
+                         logits_mask_fn=mask, grammar=grammar)
+        eng.submit(req)
+        plen = len(req.prompt_ids)
+        req.spec = ForcedSpeculator(
+            lambda hist, k: list(base.output_ids[len(hist) - plen:
+                                                 len(hist) - plen + k]))
+        eng.run_to_completion()
+        assert req.output_ids == base.output_ids
+
+
+class TestOvertightCounter:
+    def test_overtight_mask_counted_and_logged_once(self, model, caplog):
+        """A mask fn returning an empty allow-list degrades the row to
+        unconstrained (pre-existing sampler semantics) — now counted in
+        constrained_mask_overtight and logged once per request."""
+        cfg, params = model
+        eng = make_engine(cfg, params)
+
+        def tight(out):
+            return [] if 1 <= len(out) <= 3 else None
+
+        req = GenRequest(request_id="ot", prompt_ids=[3] * 4,
+                         max_new_tokens=8, logits_mask_fn=tight)
+        with caplog.at_level(logging.WARNING, logger="kafka_tpu.engine"):
+            eng.submit(req)
+            eng.run_to_completion()
+        assert req.finish_reason == "length"
+        assert len(req.output_ids) == 8  # generation continued
+        assert eng.metrics.constrained_mask_overtight >= 2
+        hits = [r for r in caplog.records
+                if "over-tight constrained mask" in r.getMessage()]
+        assert len(hits) == 1  # once per request
+        snap = eng.metrics.snapshot()
+        assert snap["constrained"]["constrained_mask_overtight"] >= 2
+
+
+class TestConstrainedMetricRegistry:
+    """CONSTRAINED_METRIC_KEYS must appear in BOTH runtime/metrics.py and
+    server/prometheus.py, and neither file may invent constrained_*
+    metrics outside the registry (the SITES/SPANS pattern)."""
+
+    def _source(self, relpath):
+        import os
+
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        with open(os.path.join(root, relpath)) as f:
+            return f.read()
+
+    def test_registry_both_directions(self):
+        from kafka_tpu.runtime.metrics import CONSTRAINED_METRIC_KEYS
+
+        metrics_src = self._source("kafka_tpu/runtime/metrics.py")
+        prom_src = self._source("kafka_tpu/server/prometheus.py")
+        for key in CONSTRAINED_METRIC_KEYS:
+            assert f'"{key}"' in metrics_src, (
+                f"{key} missing from runtime/metrics.py"
+            )
+            assert f'"{key}"' in prom_src, (
+                f"{key} missing from server/prometheus.py"
+            )
+        wired = set()
+        for src in (metrics_src, prom_src):
+            wired |= set(re.findall(r'"(constrained_[a-z_]+)"', src))
+        undocumented = wired - set(CONSTRAINED_METRIC_KEYS)
+        assert not undocumented, (
+            f"constrained metrics outside the registry: {undocumented}"
+        )
+
+    def test_snapshot_carries_registry_keys(self):
+        from kafka_tpu.runtime.metrics import (
+            CONSTRAINED_METRIC_KEYS,
+            EngineMetrics,
+        )
+
+        snap = EngineMetrics().snapshot()
+        for key in CONSTRAINED_METRIC_KEYS:
+            assert key in snap["constrained"]
+
+    def test_prometheus_renders_constrained_families(self):
+        from kafka_tpu.runtime.metrics import EngineMetrics
+        from kafka_tpu.server.prometheus import render_prometheus
+
+        m = EngineMetrics()
+        m.constrained_roundtrips = 3
+        m.constrained_mask_overtight = 1
+        m.constrained_ondevice_tokens = 42
+        text = render_prometheus(m.snapshot())
+        assert "kafka_tpu_constrained_roundtrips_total 3" in text
+        assert "kafka_tpu_constrained_overtight_total 1" in text
+        assert "kafka_tpu_constrained_ondevice_tokens_total 42" in text
+
+
+class TestBenchConstrainedSmoke:
+    def test_bench_constrained_cpu_smoke(self, model):
+        """bench.py constrained, tier-1 shape: on-device mode must report
+        ~0 constrained round trips per call with bit-identical outputs —
+        the ISSUE 7 acceptance criterion, runnable on any backend."""
+        import sys
+        from pathlib import Path
+
+        sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+        from bench import constrained_phase
+
+        cfg, params = model
+        out = constrained_phase(cfg, params, n_lanes=3, gen_len=40,
+                                page_size=8)
+        assert out["outputs_match"], "FSM path changed token streams"
+        assert out["roundtrips_per_call"]["ondevice"] == 0
+        assert out["roundtrips_per_call"]["host"] > 0
+        assert out["ondevice_tokens"] > 0

@@ -278,10 +278,12 @@ class TestDisconnectCancel:
                     await asyncio.sleep(0.02)
                 assert engine.metrics.requests_cancelled >= 1
                 assert engine.num_active == 0 and not engine.waiting
-                # speculative tokens dispatched after the cancel are counted
-                # as waste, not generation (runtime/metrics.py)
+                # tokens dispatched after the cancel are counted as
+                # fetch-pipeline waste, not generation (runtime/metrics.py;
+                # the deprecated speculative_wasted alias is gone)
                 snap = engine.metrics.snapshot(engine)
-                assert "speculative_wasted" in snap["tokens"]
+                assert "fetch_pipeline_wasted" in snap["tokens"]
+                assert "speculative_wasted" not in snap["tokens"]
             finally:
                 await client.close()
 

@@ -599,17 +599,18 @@ class TestSpeculationMetricRegistry:
         for key in SPECULATION_METRIC_KEYS:
             assert key in snap["speculation"]
 
-    def test_waste_rename_keeps_deprecated_aliases(self, model):
+    def test_waste_rename_aliases_removed(self, model):
+        """The speculative_wasted_* JSON aliases PR 5 kept 'one release'
+        are gone — fetch_pipeline_wasted_* is the only spelling (README
+        "Metrics rename")."""
         from kafka_tpu.runtime.metrics import EngineMetrics
 
         m = EngineMetrics()
         m.record_wasted_token(3)
         snap = m.snapshot()
         assert snap["tokens"]["fetch_pipeline_wasted"] == 3
-        # one-release deprecated aliases (README "Metrics rename")
-        assert snap["tokens"]["speculative_wasted"] == 3
-        assert (snap["tokens"]["speculative_waste_frac"]
-                == snap["tokens"]["fetch_pipeline_waste_frac"])
+        assert "speculative_wasted" not in snap["tokens"]
+        assert "speculative_waste_frac" not in snap["tokens"]
 
 
 class TestBenchSpeculativeSmoke:
