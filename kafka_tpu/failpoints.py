@@ -61,6 +61,14 @@ SITES = (
     "engine.step",
     "engine.prefill",
     "kv.alloc",
+    # Tiered-KV copies (runtime/kv_tier.py): fired once per shipped chunk
+    # of a demote (D2H) / promote (H2D), so `error` with nth=2 on a
+    # multi-chunk run produces a genuinely TORN copy — a torn demote is
+    # discarded before anything is stored, a torn promote frees its
+    # destination pages and degrades to re-prefill; `delay` simulates a
+    # slow link.
+    "kv.demote",
+    "kv.promote",
     "worker.dispatch",
     "sandbox.exec",
     "sandbox.boot",

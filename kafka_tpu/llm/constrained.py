@@ -1293,22 +1293,43 @@ def _grammar_schema_key(auto: ToolCallAutomaton, force_name, V) -> Tuple:
 # by reference — eviction only forgets the cache slot).
 _GRAMMAR_CACHE_MAX = 16
 
+# Deferred background compiles (ISSUE 9 satellite, PR 7 follow-up): the
+# grammar->table BFS walks automaton x vocab, which on a real 128k-token
+# vocab takes tens of seconds.  Blocking the FIRST agent call on an
+# uncached large schema for that long (even off the event loop — the
+# request itself stalls) is worse than serving it through the host mask
+# path, so compiles for vocabs above KAFKA_TPU_GRAMMAR_SYNC_VOCAB run on
+# a single background worker thread instead: the first call returns None
+# (host path) immediately and later calls flip to on-device once the
+# table lands in the cache.  Small vocabs (tests, the byte tokenizer)
+# keep the synchronous path — their compiles are milliseconds.
+GRAMMAR_SYNC_VOCAB_ENV = "KAFKA_TPU_GRAMMAR_SYNC_VOCAB"
+_GRAMMAR_SYNC_VOCAB_DEFAULT = 32768
 
-def compile_grammar_for_mask_fn(
-    mask_fn, vocab_size: int
-) -> Optional[CompiledGrammar]:
-    """Engine/provider hook: the on-device artifact for a ToolCallMaskFn
-    request, or None (host fallback: disabled by env, a mask fn the
-    compiler can't lower, or a failed compile — all cached)."""
-    if not grammar_ondevice_enabled():
-        return None
-    if not isinstance(mask_fn, ToolCallMaskFn):
-        return None  # dynamic/custom mask fns keep the host micro-batch
-    tok = mask_fn._tok
-    key = _grammar_schema_key(mask_fn._auto, mask_fn.force_name, vocab_size)
-    cache = getattr(tok, "_grammar_cache", None)
-    if cache is not None and key in cache:
-        return cache[key]
+_DEFER_LOCK = __import__("threading").Lock()
+_DEFER_PENDING: set = set()  # (id(tokenizer), schema key) being compiled
+_DEFER_QUEUE: Optional[Any] = None  # queue.Queue, created with the worker
+
+
+def compile_pending() -> int:
+    """Gauge: grammar compiles queued/running on the background worker
+    (exported as constrained_compile_pending in /metrics)."""
+    return len(_DEFER_PENDING)
+
+
+def _grammar_sync_vocab() -> int:
+    import os
+
+    try:
+        return int(os.environ.get(GRAMMAR_SYNC_VOCAB_ENV, "") or
+                   _GRAMMAR_SYNC_VOCAB_DEFAULT)
+    except ValueError:
+        return _GRAMMAR_SYNC_VOCAB_DEFAULT
+
+
+def _compile_into_cache(tok, mask_fn, vocab_size: int, key) -> Optional[CompiledGrammar]:
+    """The locked compile-and-cache step shared by the synchronous path
+    and the background worker."""
     with _GRAMMAR_COMPILE_LOCK:
         cache = getattr(tok, "_grammar_cache", None)
         if cache is None:
@@ -1328,6 +1349,68 @@ def compile_grammar_for_mask_fn(
                 cache.pop(next(iter(cache)))
             cache[key] = g  # negative results cached too
     return g
+
+
+def _defer_worker() -> None:
+    import logging
+
+    log = logging.getLogger("kafka_tpu.constrained")
+    while True:
+        tok, mask_fn, vocab_size, key = _DEFER_QUEUE.get()
+        try:
+            _compile_into_cache(tok, mask_fn, vocab_size, key)
+        except Exception as e:
+            log.warning("deferred grammar compile failed: %s", e)
+        finally:
+            with _DEFER_LOCK:
+                _DEFER_PENDING.discard((id(tok), key))
+
+
+def _enqueue_deferred(tok, mask_fn, vocab_size: int, key) -> None:
+    global _DEFER_QUEUE
+    import queue as _queue
+    import threading as _threading
+
+    with _DEFER_LOCK:
+        pkey = (id(tok), key)
+        if pkey in _DEFER_PENDING:
+            return  # one compile per schema, however many callers race
+        _DEFER_PENDING.add(pkey)
+        if _DEFER_QUEUE is None:
+            _DEFER_QUEUE = _queue.Queue()
+            _threading.Thread(
+                target=_defer_worker, name="grammar-compile", daemon=True
+            ).start()
+    # the queue item holds a strong ref to tok, keeping id(tok) stable
+    _DEFER_QUEUE.put((tok, mask_fn, vocab_size, key))
+
+
+def compile_grammar_for_mask_fn(
+    mask_fn, vocab_size: int, defer: Optional[bool] = None
+) -> Optional[CompiledGrammar]:
+    """Engine/provider hook: the on-device artifact for a ToolCallMaskFn
+    request, or None (host fallback: disabled by env, a mask fn the
+    compiler can't lower, a failed compile — all cached — or a large-
+    vocab compile still in flight on the background worker).
+
+    `defer` overrides the vocab-threshold policy (tests); None applies
+    it: vocabs above KAFKA_TPU_GRAMMAR_SYNC_VOCAB compile in the
+    background and this call returns None until the table lands."""
+    if not grammar_ondevice_enabled():
+        return None
+    if not isinstance(mask_fn, ToolCallMaskFn):
+        return None  # dynamic/custom mask fns keep the host micro-batch
+    tok = mask_fn._tok
+    key = _grammar_schema_key(mask_fn._auto, mask_fn.force_name, vocab_size)
+    cache = getattr(tok, "_grammar_cache", None)
+    if cache is not None and key in cache:
+        return cache[key]
+    if defer is None:
+        defer = vocab_size > _grammar_sync_vocab()
+    if defer:
+        _enqueue_deferred(tok, mask_fn, vocab_size, key)
+        return None  # host-mask path now; on-device once the table lands
+    return _compile_into_cache(tok, mask_fn, vocab_size, key)
 
 
 def build_tool_call_mask_fn(

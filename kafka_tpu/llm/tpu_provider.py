@@ -521,9 +521,13 @@ class TPULLMProvider(LLMProvider):
         # lower the tool-call mask into a device-resident token DFA so the
         # constrained lane advances inside the jitted decode step with
         # zero host round trips.  Cached per (tokenizer, schema, vocab);
-        # the first compile for a schema walks the automaton x vocab, so
-        # it runs off the event loop.  None (disabled, a custom mask fn,
-        # or an uncompilable grammar) keeps the host micro-batch path.
+        # small-vocab compiles run synchronously off the event loop, while
+        # LARGE-vocab schemas (> KAFKA_TPU_GRAMMAR_SYNC_VOCAB) compile on
+        # a background worker — the first call returns None immediately
+        # (host-mask path, no multi-second stall) and later calls flip to
+        # on-device once the table lands (constrained_compile_pending
+        # gauge).  None (disabled, a custom mask fn, or an uncompilable
+        # grammar) keeps the host micro-batch path.
         grammar = None
         if logits_mask_fn is not None:
             from .constrained import compile_grammar_for_mask_fn

@@ -10,8 +10,12 @@ from .engine import (
 )
 from .failpoints import FailpointError
 from .kv_cache import OutOfPagesError, PagePool, SequencePages, TRASH_PAGE
+from .kv_tier import KVTierManager, LocalPageShipper, PageShipper
 
 __all__ = [
+    "KVTierManager",
+    "LocalPageShipper",
+    "PageShipper",
     "AdmissionError",
     "DataParallelEngines",
     "EngineConfig",

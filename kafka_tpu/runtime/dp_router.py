@@ -328,7 +328,13 @@ class DataParallelEngines:
         prefix gravity may build: when the best-match replica is more
         than a full batch deeper than the least-loaded routable one, load
         wins — the colder replica prefills the prefix once and becomes a
-        second warm home."""
+        second warm home.
+
+        With the KV tier enabled, match_tokens counts HOST-RESIDENT runs
+        too — a replica holding a thread's demoted KV is routable
+        affinity (promotion is cheaper than re-prefill), so an idle
+        thread's return still steers to the replica that can re-
+        materialize it."""
         routable = self._routable_indices()
         pin: Optional[int] = None
         if req.prefix_key is not None:
@@ -638,10 +644,14 @@ class _AggregateMetrics:
                 wasted / (gen + wasted), 4
             ) if (gen + wasted) else 0.0,
         }
-        # constrained decoding: every key is a summable counter
+        # constrained decoding: every key is a summable counter EXCEPT
+        # compile_pending, a process-wide gauge every replica reports
+        # identically (the deferred-compile queue is shared) — summing it
+        # would multiply by dp
         agg["constrained"] = {
-            k: sum(s["constrained"][k] for s in snaps)
-            for k in snaps[0]["constrained"]
+            k: (s0_v if k == "constrained_compile_pending"
+                else sum(s["constrained"][k] for s in snaps))
+            for k, s0_v in snaps[0]["constrained"].items()
         }
         agg["constrained_roundtrips"] = \
             agg["constrained"]["constrained_roundtrips"]
@@ -706,6 +716,14 @@ class _AggregateMetrics:
             agg["prefix_cache"] = {
                 k: sum(s["prefix_cache"][k] for s in snaps)
                 for k in snaps[0]["prefix_cache"]
+            }
+        # KV tier (ISSUE 9): every key is a summable counter or a gauge
+        # whose per-replica values add up (bytes/runs per replica tier)
+        tier_snaps = [s["kv_tier"] for s in snaps if "kv_tier" in s]
+        if tier_snaps:
+            agg["kv_tier"] = {
+                k: sum(t[k] for t in tier_snaps)
+                for k in tier_snaps[0]
             }
         # replica-lifecycle observability: per-replica health gauges +
         # the supervisor counter family (quarantine/re-admit/migration)

@@ -148,6 +148,21 @@ class EngineConfig:
     # retain (None = bounded only by pool pressure via reclaim).
     prefix_cache_entries: int = 64
     prefix_cache_pages: Optional[int] = None
+    # Tiered KV cache (runtime/kv_tier.py, README "KV tiering"): a
+    # host-RAM page tier under the pool.  When > 0, prefix-cache eviction
+    # DEMOTES page runs into a pinned host pool of this many MiB (async
+    # D2H) instead of dropping them, and a lookup hit against a demoted
+    # run PROMOTES it back (async H2D overlapped with the suffix prefill)
+    # — a returning thread re-materializes its conversation KV instead of
+    # re-prefilling it.  0 (default) disables the tier entirely: no
+    # manager is built and every dispatch/eviction path is byte-identical
+    # to before.  KAFKA_TPU_KV_HOST_TIER_MB via the serving config.
+    kv_host_tier_mb: int = 0
+    # Spill directory below the host tier (KAFKA_TPU_KV_DISK_TIER_DIR):
+    # host-budget overflow spills page runs to disk (second-chance LRU)
+    # instead of dropping them; the tracing span ring persists alongside.
+    # None/"" = drop on host-tier overflow.
+    kv_disk_tier_dir: Optional[str] = None
     # Context-parallel strategy for sp>1 chunked prefill: "ring" (KV shards
     # rotate over ICI — bandwidth-optimal, any head count) or "ulysses"
     # (all_to_all to head-sharded layout — needs heads/tp % sp == 0).
@@ -289,7 +304,11 @@ class GenRequest:
     # prior turn or another thread's shared prefix.  Rides out on the
     # engine.prefill span and usage.prompt_tokens_details.cached_tokens.
     cached_tokens: int = 0
-    cache_source: Optional[str] = None  # "own" | "cross"
+    cache_source: Optional[str] = None  # "own" | "cross" | "host_tier"
+    # Tokens of the hit re-materialized from the host/disk KV tier
+    # (runtime/kv_tier.py) rather than found in HBM — rides out on the
+    # engine.prefill span so a resume-without-re-prefill is provable.
+    promoted_tokens: int = 0
     # Off-slot (parked) admission: the prefill's sampled token as a device
     # scalar, held until a decode slot frees and seeds _d_last at seating.
     # None for resumed parked lanes — their pending token is host-known
@@ -764,12 +783,31 @@ class InferenceEngine:
                 "prefix_cache_pages must be >= 0 (0 disables; None = "
                 "bounded only by pool pressure)"
             )
+        if self.ecfg.kv_host_tier_mb < 0:
+            raise ValueError(
+                "kv_host_tier_mb must be >= 0 (0 disables the host tier)"
+            )
         self.prefix_cache: Optional[PrefixCache] = (
             PrefixCache(self.pool, max_pages=self.ecfg.prefix_cache_pages)
             if self.ecfg.prefix_cache_entries > 0
             and self.ecfg.prefix_cache_pages != 0
             else None
         )
+        # Tiered KV cache (ISSUE 9): host-RAM (+ optional disk) page tier
+        # under the pool.  Built only when enabled AND the prefix cache
+        # exists (the radix tree is what names demotable runs); with the
+        # knob unset every eviction/dispatch path is byte-identical.
+        self.kv_tier = None
+        if self.prefix_cache is not None and self.ecfg.kv_host_tier_mb > 0:
+            from .kv_tier import KVTierManager, LocalPageShipper
+
+            self.kv_tier = KVTierManager(
+                LocalPageShipper(self, ps),
+                host_budget_bytes=self.ecfg.kv_host_tier_mb * 1024 * 1024,
+                disk_dir=self.ecfg.kv_disk_tier_dir or None,
+                page_size=ps,
+            )
+            self.prefix_cache.tier = self.kv_tier
         self.metrics = EngineMetrics()
         # DP replica index (set by runtime/dp_router.py): traced requests'
         # engine spans carry it so a timeline names the replica it ran on
@@ -894,6 +932,8 @@ class InferenceEngine:
         if req.cached_tokens:
             kw["cached_tokens"] = req.cached_tokens
             kw["cache_source"] = req.cache_source
+            if req.promoted_tokens:
+                kw["promoted_tokens"] = req.promoted_tokens
         return self._tattrs(**kw)
 
     def _dispatch_scope(self, members: Sequence[Optional["GenRequest"]]):
@@ -1510,6 +1550,24 @@ class InferenceEngine:
             )
             np.asarray(out)
 
+    def warmup_kv_tier(self) -> None:
+        """Compile the tier's ship (gather/scatter) programs outside
+        serving.  Page runs ship in fixed bucket sizes (kv_tier.
+        SHIP_BUCKETS); without this the first demotion under pressure —
+        or worse, the first returning thread's promotion — pays an XLA
+        compile on the scheduler thread.  Warmed against the trash page:
+        gathers read garbage, scatters write garbage INTO the trash page
+        (its contract), no pool state changes.  No-op without a tier."""
+        if self.kv_tier is None:
+            return
+        from .kv_tier import SHIP_BUCKETS
+
+        ship = self.kv_tier.shipper
+        for b in SHIP_BUCKETS:
+            pending = ship.export_run([TRASH_PAGE] * b)
+            k_leaves, v_leaves = ship.resolve(pending)
+            ship.import_run(k_leaves, v_leaves, b, [TRASH_PAGE] * b)
+
     def take_waiting(self) -> List[GenRequest]:
         """Remove and return every WAITING request (they own no device
         state).  Replica supervision seam: the DP router migrates a
@@ -1651,6 +1709,10 @@ class InferenceEngine:
         chunk's compute.
         """
         failpoint("engine.step")
+        if self.kv_tier is not None:
+            # resolve completed D2H demotions so their gather buffers
+            # leave HBM promptly (cheap: a list scan, usually empty)
+            self.kv_tier.drain()
         if self._park_cooldown > 0:
             self._park_cooldown -= 1
         self._check_deadlines()
@@ -2153,12 +2215,36 @@ class InferenceEngine:
             return
         req.cached_tokens = 0
         req.cache_source = None
-        hit = self.prefix_cache.lookup(req.prefix_key, req.prefill_ids)
+        req.promoted_tokens = 0
+        if self.kv_tier is not None:
+            # kv.promote spans inside the lookup attach to this request
+            self.kv_tier.trace_ctx = req.trace
+        try:
+            hit = self.prefix_cache.lookup(req.prefix_key, req.prefill_ids)
+        finally:
+            if self.kv_tier is not None:
+                self.kv_tier.trace_ctx = None
         if hit is not None:
             req.seq = SequencePages(seq_id=req.request_id)
             req.seq.pages, req.seq.length = hit.pages, hit.tokens
             req.cached_tokens = hit.tokens
             req.cache_source = hit.source
+            req.promoted_tokens = hit.promoted_tokens
+
+    def _reclaim_cache(self, pages_needed: int,
+                       req: Optional[GenRequest] = None) -> bool:
+        """prefix_cache.reclaim with kv.demote spans attached to the
+        request whose page pressure drives the eviction (None = untraced;
+        the span site is then one branch inside the tier manager)."""
+        if self.prefix_cache is None:
+            return False
+        if self.kv_tier is not None:
+            self.kv_tier.trace_ctx = req.trace if req is not None else None
+        try:
+            return self.prefix_cache.reclaim(pages_needed)
+        finally:
+            if self.kv_tier is not None:
+                self.kv_tier.trace_ctx = None
 
     def _detach_prefix(self, req: GenRequest) -> None:
         """Roll back a page-blocked _attach_prefix: free the retains and
@@ -2171,6 +2257,7 @@ class InferenceEngine:
             req.seq = None
         req.cached_tokens = 0
         req.cache_source = None
+        req.promoted_tokens = 0
 
     def _admit(self) -> None:
         # Strict submit-order FIFO across BOTH queues: each free slot goes
@@ -2218,9 +2305,8 @@ class InferenceEngine:
         req = self.waiting[0]
         self._attach_prefix(req)
         needed = self._pages_needed(req)
-        if needed > self.pool.free_pages and not (
-            self.prefix_cache is not None
-            and self.prefix_cache.reclaim(needed)
+        if needed > self.pool.free_pages and not self._reclaim_cache(
+            needed, req
         ):
             self._detach_prefix(req)
             return False
@@ -2986,10 +3072,7 @@ class InferenceEngine:
                     -(-(s.seq.length + len(cands) + 1) // ecfg.page_size)
                     - len(s.seq.pages)
                 )
-                if not (
-                    self.prefix_cache is not None
-                    and self.prefix_cache.reclaim(max(1, pages_short))
-                ):
+                if not self._reclaim_cache(max(1, pages_short), s):
                     proposals.pop(id(s))
                     continue
                 try:
@@ -3325,7 +3408,7 @@ class InferenceEngine:
         # Remedies in order of cost: evict cache entries (rebuild = one
         # prefill, no victim), then drain the pipeline (stop tokens hiding
         # in flight may retire slots), then preempt.
-        if self.prefix_cache is not None and self.prefix_cache.reclaim(1):
+        if self._reclaim_cache(1, req):
             try:
                 self.pool.ensure_capacity(req.seq, req.seq.length + 1)
                 self._ctl_dirty = True

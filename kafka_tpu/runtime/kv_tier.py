@@ -1,0 +1,807 @@
+"""Tiered KV cache: a host-RAM page tier (with optional disk spill) under
+the PagePool, and the page-shipping substrate between tiers.
+
+At millions-of-threads scale almost every thread is idle, and an idle
+thread's conversation KV must not occupy HBM — yet a thread resuming after
+hours should not re-prefill its whole 32k-token history either (ROADMAP
+"KV tiering", BASELINE config 5).  Pages are the natural unit of demotion
+(vLLM's PagedAttention), and a serialize/ship-a-page-run substrate between
+memory tiers is the standard production architecture for KV-centric
+serving (Mooncake; cf. DistServe's disaggregated prefill/decode):
+
+* **Demotion** — when the radix prefix cache's leaf-LRU eviction or
+  page-pressure ``reclaim()`` would free a node's pages, the engine instead
+  copies them device->host (async D2H: the gather is enqueued on the device
+  stream *before* the pages are released, so in-order execution reads them
+  pre-overwrite; the host-side transfer completes in the background) and
+  the radix node is retained as a *host-resident* run.
+* **Promotion** — a ``lookup()`` hit against a host-resident run allocates
+  fresh pool pages and enqueues the H2D scatter *before* the suffix
+  prefill, so the copy overlaps the dispatch pipeline and the returning
+  thread re-materializes its KV instead of recomputing it
+  (``cache_source="host_tier"``).
+* **Second-chance LRU + disk** — the host pool lives under a byte budget
+  (``KAFKA_TPU_KV_HOST_TIER_MB``, charged by the MemoryPlan planner as
+  host RAM, not HBM).  Overflow gives each run one second chance (the
+  radix walk touching a host node sets its reference bit), then spills it
+  to ``KAFKA_TPU_KV_DISK_TIER_DIR`` (background writer thread) or drops it
+  when no disk tier is configured.
+* **Failure semantics** — a failed or torn promote frees the destination
+  pages and removes the radix node: the request degrades to re-prefill,
+  never to corrupt KV.  A failed demote falls back to plain eviction.
+  Both copies are chaos-testable via the ``kv.demote`` / ``kv.promote``
+  failpoints (fired once per shipped chunk, so an ``nth=2`` error rule
+  produces a genuinely torn multi-chunk copy).
+
+:class:`PageShipper` is deliberately transport-agnostic: today's only
+implementation copies between local tiers of one engine, but the same
+export/import seam is what a prefill-specialized replica will use to ship
+computed pages to a decode replica (disaggregated serving — the next step
+named in ROADMAP).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import queue
+import threading
+import time
+import uuid
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .failpoints import failpoint
+from .tracing import record_span
+
+logger = logging.getLogger("kafka_tpu.kv_tier")
+
+ENV_HOST_MB = "KAFKA_TPU_KV_HOST_TIER_MB"
+ENV_DISK_DIR = "KAFKA_TPU_KV_DISK_TIER_DIR"
+
+MiB = 1024 * 1024
+
+# Pages per gather/scatter dispatch.  Shipping in fixed buckets (padded
+# with trash-page slots) keeps the number of compiled transfer programs
+# O(len(buckets)) instead of one per distinct run length; runs longer than
+# the largest bucket ship as a chunk sequence.  Padding round-trips
+# harmlessly: padded gathers read trash rows that resolution trims, padded
+# scatters write their rows INTO the trash page, which is garbage by
+# contract (kv_cache.TRASH_PAGE).
+SHIP_BUCKETS = (1, 2, 4, 8, 16, 32, 64)
+
+_TRASH_PAGE = 0  # mirrors kv_cache.TRASH_PAGE (import cycle avoidance)
+
+
+class ShipError(RuntimeError):
+    """A page-run transfer failed (torn copy, missing payload).  The tier
+    manager converts this into degrade-to-re-prefill, never corruption."""
+
+
+def host_tier_mb_from_env() -> int:
+    """The host-tier byte budget knob, clamped (negatives = disabled)."""
+    try:
+        return max(0, int(os.environ.get(ENV_HOST_MB, "0") or 0))
+    except ValueError:
+        return 0
+
+
+def disk_tier_dir_from_env() -> Optional[str]:
+    return os.environ.get(ENV_DISK_DIR) or None
+
+
+def _bucketize(n_pages: int) -> List[int]:
+    """Split a run of n pages into SHIP_BUCKET-sized chunk lengths."""
+    out: List[int] = []
+    biggest = SHIP_BUCKETS[-1]
+    while n_pages > biggest:
+        out.append(biggest)
+        n_pages -= biggest
+    if n_pages > 0:
+        out.append(next(b for b in SHIP_BUCKETS if b >= n_pages))
+    return out  # each entry is the PADDED chunk length
+
+
+def _flat_slots(pages: Sequence[int], page_size: int, pad_to: int) -> np.ndarray:
+    """Flat pool-slot indices for `pages`, padded to `pad_to` pages with
+    trash-page slots."""
+    padded = list(pages) + [_TRASH_PAGE] * (pad_to - len(pages))
+    idx = np.empty(pad_to * page_size, np.int32)
+    for i, p in enumerate(padded):
+        idx[i * page_size:(i + 1) * page_size] = np.arange(
+            p * page_size, (p + 1) * page_size, dtype=np.int32
+        )
+    return idx
+
+
+@jax.jit
+def _gather_rows(k_pool, v_pool, idx):
+    """Read the page rows at flat slot indices `idx` out of both pools.
+
+    NOT donating: the result is a fresh buffer whose D2H copy can complete
+    while later (donating) dispatches keep updating the pool in place —
+    in-order device execution guarantees the gather reads pre-overwrite
+    values even though the host only resolves the bytes later.
+    """
+    take = lambda a: jnp.take(a, idx, axis=1)
+    return jax.tree.map(take, k_pool), jax.tree.map(take, v_pool)
+
+
+def _scatter_rows(k_pool, v_pool, idx, k_rows, v_rows):
+    """Write page rows back into both pools at flat slot indices.  The
+    pools are DONATED (updated in place), same as every decode/prefill
+    dispatch — callers must reassign their pool references."""
+
+    def put(a, rows):
+        return a.at[:, idx].set(rows.astype(a.dtype))
+
+    return jax.tree.map(put, k_pool, k_rows), jax.tree.map(
+        put, v_pool, v_rows
+    )
+
+
+_scatter_jit = jax.jit(_scatter_rows, donate_argnums=(0, 1))
+
+
+def _np_dtype(name: str) -> np.dtype:
+    """Resolve a dtype name, including ml_dtypes extras (bfloat16 &c.)."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _storable(arr: np.ndarray) -> Tuple[np.ndarray, str]:
+    """An npz-serializable view + the original dtype name (ml_dtypes
+    types are not npz-portable; view them as same-width unsigned ints)."""
+    name = arr.dtype.name
+    try:
+        np.dtype(name)  # numpy-native? store as-is
+        return arr, name
+    except TypeError:
+        width = {1: np.uint8, 2: np.uint16, 4: np.uint32}[arr.dtype.itemsize]
+        return arr.view(width), name
+
+
+class PageShipper:
+    """Transport seam for page runs: export to a portable payload, import
+    a payload into destination pages.  Local tier copies implement it with
+    device gathers/scatters; a cross-replica transport implements the same
+    two calls over the wire (the payload is plain numpy leaves)."""
+
+    def export_run(self, pages: Sequence[int]) -> "_PendingExport":
+        raise NotImplementedError
+
+    def resolve(self, pending: "_PendingExport") -> Tuple[List[np.ndarray], List[np.ndarray]]:
+        raise NotImplementedError
+
+    def import_run(
+        self,
+        k_leaves: List[np.ndarray],
+        v_leaves: List[np.ndarray],
+        n_pages: int,
+        dest_pages: Sequence[int],
+    ) -> None:
+        raise NotImplementedError
+
+    def bytes_per_page(self) -> int:
+        raise NotImplementedError
+
+
+class _PendingExport:
+    """An in-flight D2H export: per-chunk device arrays whose host copy
+    was started asynchronously.  `ready()` is advisory; `resolve` blocks."""
+
+    __slots__ = ("n_pages", "chunk_pages", "chunks")
+
+    def __init__(self, n_pages: int, chunk_pages: List[int],
+                 chunks: List[Tuple[List[Any], List[Any]]]):
+        self.n_pages = n_pages
+        self.chunk_pages = chunk_pages  # REAL pages per chunk (unpadded)
+        self.chunks = chunks  # [(k_leaf_arrays, v_leaf_arrays), ...]
+
+    def ready(self) -> bool:
+        for k_leaves, v_leaves in self.chunks:
+            for a in (*k_leaves, *v_leaves):
+                is_ready = getattr(a, "is_ready", None)
+                if is_ready is not None and not is_ready():
+                    return False
+        return True
+
+    @property
+    def nbytes(self) -> int:
+        return sum(
+            a.nbytes for k, v in self.chunks for a in (*k, *v)
+        )
+
+
+class LocalPageShipper(PageShipper):
+    """Ship page runs between one engine's HBM pool and host memory.
+
+    `owner` exposes mutable ``k_pool`` / ``v_pool`` attributes (the engine;
+    tests use a stub).  Scatters donate and REASSIGN the owner's pools, so
+    imports must run on the thread that owns dispatch (the engine thread —
+    the same single-writer contract every jitted step obeys).
+    """
+
+    def __init__(self, owner: Any, page_size: int):
+        self.owner = owner
+        self.page_size = page_size
+
+    # -- export (demotion: D2H) -----------------------------------------
+
+    def export_run(self, pages: Sequence[int]) -> _PendingExport:
+        ps = self.page_size
+        chunks: List[Tuple[List[Any], List[Any]]] = []
+        chunk_pages: List[int] = []
+        off = 0
+        for padded in _bucketize(len(pages)):
+            failpoint("kv.demote")
+            real = min(padded, len(pages) - off)
+            idx = _flat_slots(pages[off:off + real], ps, padded)
+            k_rows, v_rows = _gather_rows(
+                self.owner.k_pool, self.owner.v_pool, jnp.asarray(idx)
+            )
+            k_leaves = jax.tree.leaves(k_rows)
+            v_leaves = jax.tree.leaves(v_rows)
+            for a in (*k_leaves, *v_leaves):
+                start = getattr(a, "copy_to_host_async", None)
+                if start is not None:
+                    start()
+            chunks.append((k_leaves, v_leaves))
+            chunk_pages.append(real)
+            off += real
+        return _PendingExport(len(pages), chunk_pages, chunks)
+
+    def resolve(
+        self, pending: _PendingExport
+    ) -> Tuple[List[np.ndarray], List[np.ndarray]]:
+        """Materialize an export on host: trim chunk padding, concatenate
+        chunks — one numpy array per pool leaf, [L, n_pages*ps, ...]."""
+        ps = self.page_size
+        k_parts: List[List[np.ndarray]] = []
+        v_parts: List[List[np.ndarray]] = []
+        for (k_leaves, v_leaves), real in zip(
+            pending.chunks, pending.chunk_pages
+        ):
+            k_parts.append([np.asarray(a)[:, : real * ps] for a in k_leaves])
+            v_parts.append([np.asarray(a)[:, : real * ps] for a in v_leaves])
+        n_leaves = len(k_parts[0])
+        k_out = [
+            np.concatenate([part[i] for part in k_parts], axis=1)
+            if len(k_parts) > 1 else np.ascontiguousarray(k_parts[0][i])
+            for i in range(n_leaves)
+        ]
+        v_out = [
+            np.concatenate([part[i] for part in v_parts], axis=1)
+            if len(v_parts) > 1 else np.ascontiguousarray(v_parts[0][i])
+            for i in range(n_leaves)
+        ]
+        return k_out, v_out
+
+    # -- import (promotion: H2D) ----------------------------------------
+
+    def import_run(
+        self,
+        k_leaves: List[np.ndarray],
+        v_leaves: List[np.ndarray],
+        n_pages: int,
+        dest_pages: Sequence[int],
+    ) -> None:
+        if len(dest_pages) != n_pages:
+            raise ShipError(
+                f"import of {n_pages}-page run into {len(dest_pages)} pages"
+            )
+        ps = self.page_size
+        treedef_k = jax.tree.structure(self.owner.k_pool)
+        treedef_v = jax.tree.structure(self.owner.v_pool)
+        off = 0
+        for padded in _bucketize(n_pages):
+            failpoint("kv.promote")
+            real = min(padded, n_pages - off)
+            idx = _flat_slots(dest_pages[off:off + real], ps, padded)
+            lo, hi = off * ps, (off + real) * ps
+            pad_rows = (padded - real) * ps
+
+            def chunk_of(a: np.ndarray) -> np.ndarray:
+                rows = a[:, lo:hi]
+                if pad_rows:
+                    pad = np.zeros(
+                        (rows.shape[0], pad_rows) + rows.shape[2:],
+                        rows.dtype,
+                    )
+                    rows = np.concatenate([rows, pad], axis=1)
+                return rows
+
+            k_rows = jax.tree.unflatten(
+                treedef_k, [chunk_of(a) for a in k_leaves]
+            )
+            v_rows = jax.tree.unflatten(
+                treedef_v, [chunk_of(a) for a in v_leaves]
+            )
+            self.owner.k_pool, self.owner.v_pool = _scatter_jit(
+                self.owner.k_pool, self.owner.v_pool, jnp.asarray(idx),
+                k_rows, v_rows,
+            )
+            off += real
+
+    def bytes_per_page(self) -> int:
+        ps = self.page_size
+        total = 0
+        for pool in (self.owner.k_pool, self.owner.v_pool):
+            for a in jax.tree.leaves(pool):
+                per_slot = int(np.prod(a.shape[2:])) if a.ndim > 2 else 1
+                total += a.shape[0] * ps * per_slot * a.dtype.itemsize
+        return total
+
+
+# ---------------------------------------------------------------------------
+# host + disk tiers
+# ---------------------------------------------------------------------------
+
+
+class HostRun:
+    """One demoted page run resident in the host tier (or below)."""
+
+    __slots__ = (
+        "run_id", "n_pages", "nbytes", "location", "pending",
+        "k_leaves", "v_leaves", "ref_bit", "discarded",
+    )
+
+    def __init__(self, run_id: str, n_pages: int, nbytes: int,
+                 pending: Optional[_PendingExport]):
+        self.run_id = run_id
+        self.n_pages = n_pages
+        self.nbytes = nbytes
+        # "pending" (D2H still materializing) -> "host" -> "spilling"
+        # -> "disk"
+        self.location = "pending"
+        self.pending = pending
+        self.k_leaves: Optional[List[np.ndarray]] = None
+        self.v_leaves: Optional[List[np.ndarray]] = None
+        self.ref_bit = False  # second-chance LRU
+        self.discarded = False
+
+
+class KVTierManager:
+    """The host-RAM (+ optional disk) KV tier and its shipping policy.
+
+    Single-writer like the engine: demote/promote/split/discard run on the
+    engine thread (they mutate pool arrays through the shipper); only the
+    background spill writer touches disk state, under ``_lock``.
+    ``snapshot()`` is read from serving threads and is torn-tolerant.
+    """
+
+    def __init__(
+        self,
+        shipper: PageShipper,
+        host_budget_bytes: int,
+        disk_dir: Optional[str] = None,
+        page_size: int = 16,
+    ):
+        self.shipper = shipper
+        self.host_budget_bytes = int(host_budget_bytes)
+        self.disk_dir = disk_dir
+        self.page_size = page_size
+        if disk_dir:
+            os.makedirs(disk_dir, exist_ok=True)
+        self._runs: "OrderedDict[str, HostRun]" = OrderedDict()
+        self._lock = threading.Lock()
+        # run ids are namespaced per manager so DP replicas (or restarts)
+        # sharing one disk dir never collide on file names
+        self._uid = uuid.uuid4().hex[:8]
+        self._next_id = 0
+        self.host_bytes = 0
+        self.disk_bytes = 0
+        self.disk_runs = 0
+        # engine plumbing: the trace context of the request whose pressure
+        # (or prefix hit) drives the current demote/promote — spans attach
+        # to it; None = untraced (record_span is then a no-op)
+        self.trace_ctx = None
+        # counters (KV_TIER_METRIC_KEYS; exported via /metrics + Prometheus)
+        self.demotions = 0
+        self.pages_demoted = 0
+        self.bytes_demoted = 0
+        self.demote_failures = 0
+        self.promotions = 0
+        self.pages_promoted = 0
+        self.bytes_promoted = 0
+        self.promote_failures = 0
+        self.host_evictions = 0  # runs dropped (no disk tier / lost)
+        self.disk_spills = 0
+        self.disk_loads = 0
+        self._spill_q: "queue.Queue[Optional[HostRun]]" = queue.Queue()
+        self._spill_thread: Optional[threading.Thread] = None
+
+    # -- sizing ----------------------------------------------------------
+
+    def bytes_for_pages(self, n_pages: int) -> int:
+        return n_pages * self.shipper.bytes_per_page()
+
+    # -- demote ----------------------------------------------------------
+
+    def demote(self, pages: Sequence[int]) -> Optional[str]:
+        """Copy `pages` D2H and admit them as a host run.  Returns the run
+        id, or None when the copy failed or the run cannot fit — the
+        caller then falls back to plain eviction (pages are simply freed).
+        The gather is enqueued before the caller releases the pages, so
+        in-order device execution reads them pre-overwrite; only the host
+        materialization is deferred (see drain())."""
+        est = self.bytes_for_pages(len(pages))
+        if est > self.host_budget_bytes:
+            return None  # a run larger than the whole tier never fits
+        t0 = time.monotonic()
+        try:
+            pending = self.shipper.export_run(pages)
+        except Exception as e:  # injected fault / transfer error
+            self.demote_failures += 1
+            logger.warning("kv demote of %d pages failed: %s", len(pages), e)
+            return None
+        nbytes = pending.nbytes
+        self._evict_for(nbytes)
+        with self._lock:
+            self._next_id += 1
+            run = HostRun(f"{self._uid}.r{self._next_id}", len(pages),
+                          nbytes, pending)
+            self._runs[run.run_id] = run
+            self.host_bytes += nbytes
+        dur = time.monotonic() - t0
+        self.demotions += 1
+        self.pages_demoted += len(pages)
+        self.bytes_demoted += nbytes
+        record_span(
+            self.trace_ctx, "kv.demote", dur,
+            attrs={"pages": len(pages), "bytes": nbytes, "overlap": "async"},
+        )
+        return run.run_id
+
+    # -- promote ---------------------------------------------------------
+
+    def promote(self, run_id: str, dest_pages: Sequence[int]) -> bool:
+        """Ship a host run back into freshly-allocated pool pages.
+
+        The scatter is enqueued ahead of the caller's suffix prefill, so
+        the H2D copy overlaps the dispatch pipeline.  Returns False on any
+        failure (missing run, torn copy): the destination pages are the
+        caller's to free and the run is gone — degrade to re-prefill,
+        never serve partial KV.  A torn scatter only ever wrote pages the
+        caller just allocated (shared with nobody), so freeing them is
+        complete cleanup."""
+        t0 = time.monotonic()
+        run = self._take(run_id)
+        if run is None:
+            self.promote_failures += 1
+            return False
+        src = "disk" if run.location == "disk" else "host"
+        try:
+            k_leaves, v_leaves = self._materialize(run)
+            self.shipper.import_run(
+                k_leaves, v_leaves, run.n_pages, dest_pages
+            )
+        except Exception as e:
+            self.promote_failures += 1
+            logger.warning(
+                "kv promote of run %s (%d pages) failed: %s — degrading "
+                "to re-prefill", run_id, run.n_pages, e,
+            )
+            return False
+        dur = time.monotonic() - t0
+        self.promotions += 1
+        self.pages_promoted += run.n_pages
+        self.bytes_promoted += run.nbytes
+        record_span(
+            self.trace_ctx, "kv.promote", dur,
+            attrs={
+                "pages": run.n_pages, "bytes": run.nbytes, "source": src,
+                "overlap": "prefill",
+            },
+        )
+        return True
+
+    # -- structure ops (radix-tree splits / invalidation) ----------------
+
+    def split(self, run_id: str, front_pages: int) -> Optional[Tuple[str, str]]:
+        """Split a run at a page boundary into (front, back) runs — the
+        host-side mirror of a radix-node split.  None when the run is gone
+        (the caller removes the node instead)."""
+        run = self._take(run_id)
+        if run is None or not (0 < front_pages < run.n_pages):
+            if run is not None:
+                self._readmit(run)
+            return None
+        try:
+            k_leaves, v_leaves = self._materialize(run)
+        except Exception as e:
+            logger.warning("kv split of run %s failed: %s", run_id, e)
+            return None
+        cut = front_pages * self.page_size
+        ids: List[str] = []
+        for lo, hi, n in (
+            (0, cut, front_pages),
+            (cut, None, run.n_pages - front_pages),
+        ):
+            k_part = [np.ascontiguousarray(a[:, lo:hi]) for a in k_leaves]
+            v_part = [np.ascontiguousarray(a[:, lo:hi]) for a in v_leaves]
+            nbytes = sum(a.nbytes for a in (*k_part, *v_part))
+            with self._lock:
+                self._next_id += 1
+                piece = HostRun(f"{self._uid}.r{self._next_id}", n,
+                                nbytes, None)
+                piece.location = "host"
+                piece.k_leaves, piece.v_leaves = k_part, v_part
+                self._runs[piece.run_id] = piece
+                self.host_bytes += nbytes
+            ids.append(piece.run_id)
+        self._evict_for(0)  # splitting resolved/copied: re-check budget
+        return ids[0], ids[1]
+
+    def touch(self, run_id: str) -> None:
+        """Second-chance reference bit: the radix walk crossed this run."""
+        with self._lock:
+            run = self._runs.get(run_id)
+            if run is not None:
+                run.ref_bit = True
+
+    def discard(self, run_id: str) -> None:
+        """Drop a run (node invalidated, or its pages were re-adopted)."""
+        run = self._take(run_id, load=False)
+        if run is not None:
+            run.discarded = True
+
+    # -- background resolution & spill -----------------------------------
+
+    def drain(self, force: bool = False) -> None:
+        """Materialize pending D2H exports whose transfer completed.
+
+        Called at scheduler cadence (engine.step) so pending runs release
+        their device buffers promptly — an unresolved export pins its
+        gather result in HBM, which is exactly what demotion exists to
+        free.  `force` resolves everything (tests, spill pressure)."""
+        if not self._runs:  # hot-path fast exit (torn-tolerant read)
+            return
+        with self._lock:
+            todo = [
+                r for r in self._runs.values() if r.location == "pending"
+            ]
+        for run in todo:
+            if force or run.pending is None or run.pending.ready():
+                try:
+                    self._materialize(run)
+                except Exception as e:
+                    logger.warning(
+                        "kv demote resolution of %s failed: %s",
+                        run.run_id, e,
+                    )
+                    self.discard(run.run_id)
+                    self.host_evictions += 1
+        if todo:
+            # a pressure moment with every run still in flight may have
+            # overshot the budget (_evict_for tolerates it rather than
+            # block the scheduler); now that transfers resolved, re-
+            # enforce it
+            self._evict_for(0)
+
+    def flush(self, timeout: float = 5.0) -> None:
+        """Test/shutdown helper: resolve all pending exports and wait for
+        the spill queue to empty."""
+        self.drain(force=True)
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                busy = any(
+                    r.location == "spilling" for r in self._runs.values()
+                )
+            if not busy and self._spill_q.empty():
+                return
+            time.sleep(0.005)
+
+    def _materialize(self, run: HostRun) -> Tuple[List[np.ndarray], List[np.ndarray]]:
+        """Resolve a run to host numpy leaves wherever it currently lives."""
+        if run.k_leaves is not None:
+            return run.k_leaves, run.v_leaves
+        if run.location == "disk":
+            k_leaves, v_leaves = self._disk_load(run)
+            self.disk_loads += 1
+            return k_leaves, v_leaves
+        if run.pending is None:
+            raise ShipError(f"run {run.run_id} has no payload")
+        k_leaves, v_leaves = self.shipper.resolve(run.pending)
+        run.k_leaves, run.v_leaves = k_leaves, v_leaves
+        run.pending = None
+        if run.location == "pending":
+            run.location = "host"
+        return k_leaves, v_leaves
+
+    def _take(self, run_id: str, load: bool = True) -> Optional[HostRun]:
+        """Remove a run from the tier (promote/split/discard paths).  Its
+        bytes are uncharged immediately.  Disk-resident runs are loaded
+        into memory BEFORE their file is unlinked (`load=False` skips the
+        read for discards); a failed load leaves the run payload-less and
+        the caller's _materialize raises ShipError."""
+        with self._lock:
+            run = self._runs.pop(run_id, None)
+            if run is None:
+                return None
+            if run.location == "disk":
+                self.disk_bytes -= run.nbytes
+                self.disk_runs -= 1
+            else:
+                self.host_bytes -= run.nbytes
+        if run.location == "disk":
+            if load:
+                try:
+                    run.k_leaves, run.v_leaves = self._disk_load(run)
+                    self.disk_loads += 1
+                except ShipError as e:
+                    logger.warning("%s", e)
+            try:
+                os.unlink(self._disk_path(run.run_id))
+            except OSError:
+                pass
+        return run
+
+    def _readmit(self, run: HostRun) -> None:
+        # a taken disk run's file is already unlinked and its payload (if
+        # any) loaded — it re-enters as a host-resident run
+        if run.location == "disk":
+            run.location = "host"
+        with self._lock:
+            self._runs[run.run_id] = run
+            self.host_bytes += run.nbytes
+
+    def _evict_for(self, incoming_bytes: int) -> None:
+        """Second-chance LRU over host-resident runs: referenced runs get
+        one more cycle; unreferenced ones spill to disk (when configured)
+        or drop.  Dropped runs are discovered lazily — the radix node
+        still references the run id, and the promote that misses removes
+        the node (degrade to re-prefill).
+
+        Runs whose D2H transfer is still in flight are never victims:
+        this runs on the ENGINE THREAD inside the reclaim path, and
+        resolving an unfinished export would block the scheduler on the
+        copy — the opposite of the overlap model.  If every host-side run
+        is still in flight the budget transiently overshoots instead;
+        drain() (step cadence) resolves them and the next demote re-
+        enforces the budget."""
+        scanned = 0
+        while True:
+            with self._lock:
+                if self.host_bytes + incoming_bytes <= self.host_budget_bytes:
+                    return
+                ready = [
+                    r for r in self._runs.values()
+                    if r.location == "host" or (
+                        r.location == "pending"
+                        and (r.pending is None or r.pending.ready())
+                    )
+                ]
+                if not ready:
+                    return  # in-flight/spilling only: tolerate overshoot
+                victim = ready[0]
+                if victim.ref_bit and scanned < len(ready):
+                    victim.ref_bit = False
+                    self._runs.move_to_end(victim.run_id)
+                    scanned += 1
+                    continue
+            scanned = 0
+            # materialize outside the lock — the transfer already
+            # completed (ready()), so this is a copy-free numpy view fixup
+            if victim.location == "pending":
+                try:
+                    self._materialize(victim)
+                except Exception:
+                    victim.location = "host"  # fall through to drop
+                    victim.k_leaves = victim.v_leaves = None
+            if self.disk_dir and victim.k_leaves is not None:
+                with self._lock:
+                    victim.location = "spilling"
+                self._spill(victim)
+            else:
+                self._take(victim.run_id)
+                self.host_evictions += 1
+
+    # -- disk tier -------------------------------------------------------
+
+    def _disk_path(self, run_id: str) -> str:
+        return os.path.join(self.disk_dir or "", f"{run_id}.kvrun.npz")
+
+    def _spill(self, run: HostRun) -> None:
+        if self._spill_thread is None:
+            self._spill_thread = threading.Thread(
+                target=self._spill_loop, name="kv-tier-spill", daemon=True
+            )
+            self._spill_thread.start()
+        self._spill_q.put(run)
+
+    def _spill_loop(self) -> None:
+        while True:
+            run = self._spill_q.get()
+            if run is None:
+                return
+            try:
+                self._spill_one(run)
+            except Exception as e:
+                logger.warning("kv disk spill of %s failed: %s",
+                               run.run_id, e)
+                self._take(run.run_id)
+                self.host_evictions += 1
+
+    def _spill_one(self, run: HostRun) -> None:
+        if run.discarded:
+            return
+        arrays: Dict[str, np.ndarray] = {}
+        meta = {"n_pages": run.n_pages, "k": [], "v": []}
+        for side, leaves in (("k", run.k_leaves), ("v", run.v_leaves)):
+            for i, a in enumerate(leaves):
+                stored, dtype_name = _storable(a)
+                arrays[f"{side}{i}"] = stored
+                meta[side].append(dtype_name)
+        path = self._disk_path(run.run_id)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            np.savez(f, meta=json.dumps(meta), **arrays)
+        os.replace(tmp, path)
+        with self._lock:
+            if run.discarded or run.run_id not in self._runs:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+                return
+            run.location = "disk"
+            run.k_leaves = run.v_leaves = None
+            self.host_bytes -= run.nbytes
+            self.disk_bytes += run.nbytes
+            self.disk_runs += 1
+            self.disk_spills += 1
+
+    def _disk_load(self, run: HostRun) -> Tuple[List[np.ndarray], List[np.ndarray]]:
+        path = self._disk_path(run.run_id)
+        try:
+            with np.load(path, allow_pickle=False) as z:
+                meta = json.loads(str(z["meta"]))
+                k_leaves = [
+                    z[f"k{i}"].view(_np_dtype(name))
+                    for i, name in enumerate(meta["k"])
+                ]
+                v_leaves = [
+                    z[f"v{i}"].view(_np_dtype(name))
+                    for i, name in enumerate(meta["v"])
+                ]
+        except (OSError, KeyError, ValueError) as e:
+            raise ShipError(f"disk tier lost run {run.run_id}: {e}")
+        return k_leaves, v_leaves
+
+    # -- export ----------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The /metrics "kv_tier" section (KV_TIER_METRIC_KEYS)."""
+        with self._lock:
+            host_runs = sum(
+                1 for r in self._runs.values() if r.location != "disk"
+            )
+        return {
+            "host_budget_bytes": self.host_budget_bytes,
+            "host_bytes": self.host_bytes,
+            "host_runs": host_runs,
+            "disk_bytes": self.disk_bytes,
+            "disk_runs": self.disk_runs,
+            "demotions": self.demotions,
+            "pages_demoted": self.pages_demoted,
+            "bytes_demoted": self.bytes_demoted,
+            "demote_failures": self.demote_failures,
+            "promotions": self.promotions,
+            "pages_promoted": self.pages_promoted,
+            "bytes_promoted": self.bytes_promoted,
+            "promote_failures": self.promote_failures,
+            "host_evictions": self.host_evictions,
+            "disk_spills": self.disk_spills,
+            "disk_loads": self.disk_loads,
+        }

@@ -47,6 +47,36 @@ CONSTRAINED_METRIC_KEYS = (
     # tokens emitted by lanes advancing through the device-resident
     # grammar FSM (zero-roundtrip constrained decoding)
     "constrained_ondevice_tokens",
+    # grammar compiles queued/running on the background deferred-compile
+    # worker (llm/constrained.py): requests on those schemas take the
+    # host-mask path until the table lands.  A PROCESS-WIDE gauge, not a
+    # per-engine counter — the DP aggregate reports it once, unsummed.
+    "constrained_compile_pending",
+)
+
+# Tiered-KV-cache metric keys (ISSUE 9, runtime/kv_tier.py snapshot()).
+# Same registry discipline as the families above: every key appears in
+# BOTH this module's snapshot section and server/prometheus.py, and
+# neither file invents kv-tier metrics outside the tuple (static check in
+# tests/test_kv_tier.py).  Gauges (host/disk occupancy) sum meaningfully
+# across DP replicas — each replica owns an independent tier.
+KV_TIER_METRIC_KEYS = (
+    "host_budget_bytes",
+    "host_bytes",
+    "host_runs",
+    "disk_bytes",
+    "disk_runs",
+    "demotions",
+    "pages_demoted",
+    "bytes_demoted",
+    "demote_failures",
+    "promotions",
+    "pages_promoted",
+    "bytes_promoted",
+    "promote_failures",
+    "host_evictions",
+    "disk_spills",
+    "disk_loads",
 )
 
 
@@ -269,10 +299,16 @@ class EngineMetrics:
 
     def constrained_snapshot(self) -> Dict[str, int]:
         """The constrained-decoding section (CONSTRAINED_METRIC_KEYS)."""
+        try:
+            from ..llm.constrained import compile_pending
+            pending = compile_pending()
+        except Exception:
+            pending = 0  # import-light contexts (no llm tier loaded)
         return {
             "constrained_roundtrips": self.constrained_roundtrips,
             "constrained_mask_overtight": self.constrained_mask_overtight,
             "constrained_ondevice_tokens": self.constrained_ondevice_tokens,
+            "constrained_compile_pending": pending,
         }
 
     def speculation_snapshot(self) -> Dict[str, object]:
@@ -391,7 +427,14 @@ class EngineMetrics:
                     "misses": pc.misses,
                     "tokens_reused": pc.tokens_reused,
                     "cross_thread_hits": pc.cross_thread_hits,
+                    "host_tier_hits": pc.host_tier_hits,
+                    "host_nodes": pc.host_nodes,
+                    "host_pages": pc.host_pages,
                     "evictions": pc.evictions,
                     "pages_evicted": pc.pages_evicted,
                 }
+            tier = getattr(engine, "kv_tier", None)
+            if tier is not None:
+                # tiered KV cache (KV_TIER_METRIC_KEYS)
+                snap["kv_tier"] = tier.snapshot()
         return snap

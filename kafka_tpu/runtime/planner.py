@@ -128,6 +128,11 @@ class MemoryPlan:
     # registrations degrade to the host mask path), so this charge is the
     # true worst case.  0 when on-device grammar is disabled.
     grammar_table_bytes: int = 0
+    # Tiered KV cache host-pool budget (ISSUE 9): HOST RAM per engine
+    # replica (KAFKA_TPU_KV_HOST_TIER_MB), charged here so a deployment
+    # plan states the full memory footprint — but deliberately NOT part
+    # of total_bytes, which is the per-chip HBM budget.  0 = tier off.
+    kv_host_tier_bytes: int = 0
     notes: str = ""
 
     @property
@@ -172,6 +177,7 @@ class MemoryPlan:
             "kv_shard": self.kv_shard,
             "tq": self.tq,
             "grammar_table_mib": round(self.grammar_table_bytes / MiB, 2),
+            "kv_host_tier_mib": round(self.kv_host_tier_bytes / MiB, 2),
             "window_tokens": self.window_tokens,
             "max_concurrent_windows": self.max_concurrent_windows,
             "notes": self.notes,
@@ -310,6 +316,7 @@ def plan_memory(
     reserve_frac: float = 0.08,
     kv_shard: Optional[int] = None,
     grammar_table_bytes: Optional[int] = None,
+    kv_host_tier_bytes: int = 0,
 ) -> MemoryPlan:
     if hbm_bytes is None:
         hbm_bytes = HBM_BYTES[chip]
@@ -354,6 +361,7 @@ def plan_memory(
         kv_shard=kv_shard,
         tq=tp // kv_shard,
         grammar_table_bytes=grammar_table_bytes,
+        kv_host_tier_bytes=kv_host_tier_bytes,
         notes=(
             (
                 f"grouped GQA layout: tensor degree {tp} factorizes "
@@ -404,4 +412,7 @@ def plan_for_serving(scfg, hbm_bytes: Optional[int] = None,
         quantize=scfg.quantize,
         kv_dtype=getattr(scfg, "kv_quantize", "") or "bfloat16",
         hbm_bytes=hbm_bytes, chip=chip, kv_shard=kv_shard,
+        # host-RAM tier budget (not HBM): stated in the plan so capacity
+        # reviews see the full footprint of a tiered deployment
+        kv_host_tier_bytes=getattr(scfg, "kv_host_tier_mb", 0) * MiB,
     )

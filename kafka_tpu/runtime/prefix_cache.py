@@ -37,6 +37,18 @@ Mechanics:
   before it ever preempts.
 * `invalidate(thread_id)` drops only the nodes no *other* thread's store
   path claims, so deleting one thread never cold-starts its siblings.
+* With a KV tier attached (runtime/kv_tier.py, ISSUE 9), eviction
+  **demotes** instead of dropping: the node's pages are copied to the
+  host tier and the node stays in the tree as a *host-resident* run
+  (``pages == []``, ``host_run`` set).  A later ``lookup()`` crossing it
+  allocates fresh pool pages and promotes the run back
+  (``source="host_tier"``) — a returning thread re-materializes its KV
+  instead of re-prefilling it.  ``store()`` descending a host-resident
+  run with matching tokens *adopts* the incoming sequence's pages — a
+  free promotion.  A failed promote removes the node subtree and the hit
+  truncates at that boundary: degrade to re-prefill, never partial KV.
+  ``match_tokens`` counts host-resident runs as matchable, so the DP
+  router treats a host-tier prefix as routable affinity.
 
 Sharing is safe with the engine's async pipeline: a retiring request's
 in-flight decode steps only write KV at positions >= the stored token
@@ -49,7 +61,7 @@ import dataclasses
 from collections import OrderedDict
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
-from .kv_cache import PagePool
+from .kv_cache import OutOfPagesError, PagePool
 
 
 @dataclasses.dataclass
@@ -58,7 +70,11 @@ class PrefixHit:
 
     pages: List[int]
     tokens: int  # cached token count (= len(pages) * page_size)
-    source: str  # "own" (this thread stored through here) | "cross"
+    # "own" (this thread stored through here) | "cross" (another thread's
+    # shared prefix) | "host_tier" (any part was promoted from the tier)
+    source: str
+    # tokens of the hit that were re-materialized from the host/disk tier
+    promoted_tokens: int = 0
 
 
 # Per-node claim cap: a fan-out shared-prefix node is stored through by
@@ -71,9 +87,13 @@ _KEYS_CAP = 512
 
 
 class _Node:
-    """One page-aligned token run.  len(tokens) == len(pages) * page_size."""
+    """One page-aligned token run.  Device-resident: len(tokens) ==
+    len(pages) * page_size.  Host-resident (KV tier): pages is empty and
+    `host_run` names the demoted payload — tokens are kept so the radix
+    walk still matches through it."""
 
-    __slots__ = ("tokens", "pages", "children", "parent", "keys")
+    __slots__ = ("tokens", "pages", "children", "parent", "keys",
+                 "host_run")
 
     def __init__(
         self,
@@ -91,17 +111,29 @@ class _Node:
         # ordered and capped (invalidate removes only nodes nobody else
         # claims; `in` answers own/cross classification)
         self.keys: "OrderedDict[str, None]" = OrderedDict()
+        # KV-tier run id when demoted (host/disk resident), else None
+        self.host_run: Optional[str] = None
+
+    def n_pages(self, page_size: int) -> int:
+        """Run length in pages regardless of residency."""
+        return len(self.tokens) // page_size
 
 
 class PrefixCache:
     """Radix tree: token path -> retained pages, shared across threads."""
 
-    def __init__(self, pool: PagePool, max_pages: Optional[int] = None):
+    def __init__(self, pool: PagePool, max_pages: Optional[int] = None,
+                 tier=None):
         self.pool = pool
         # Page budget for retained pages (None = bounded only by pool
         # pressure via reclaim()).  Replaces the old entry-count cap: pages
         # are what the pool actually runs out of.
         self.max_pages = max_pages
+        # Optional KV tier manager (runtime/kv_tier.KVTierManager): when
+        # set, eviction demotes page runs host-side instead of dropping
+        # them, and lookups promote them back.  None = the pre-tier
+        # behavior, byte-identical.
+        self.tier = tier
         self._root = _Node([], [], None)
         # running shape counters (store() at budget must not re-walk the
         # tree per evicted leaf — that is O(nodes^2) on the engine thread)
@@ -130,11 +162,16 @@ class PrefixCache:
         # match_tokens results on this — an unchanged generation means an
         # identical radix walk result for an identical prompt head.
         self.generation = 0
+        # KV-tier shape counters (gauges; the tier manager owns the
+        # demote/promote traffic counters)
+        self._host_nodes = 0
+        self._host_pages = 0
         # counters (observability + tests)
         self.hits = 0
         self.misses = 0
         self.tokens_reused = 0
         self.cross_thread_hits = 0  # hits whose deepest node another thread wrote
+        self.host_tier_hits = 0  # hits that promoted at least one tier run
         self.evictions = 0  # nodes evicted under pressure (leaf-LRU + budget)
         self.pages_evicted = 0
         self.probes = 0  # read-only match_tokens walks (router memo tests)
@@ -154,8 +191,19 @@ class PrefixCache:
 
     @property
     def total_pages(self) -> int:
-        """Pages the cache currently retains (gauge for /metrics)."""
+        """HBM pool pages the cache currently retains (gauge for
+        /metrics; host-resident runs are counted by host_pages)."""
         return self._n_pages
+
+    @property
+    def host_nodes(self) -> int:
+        """Radix nodes currently demoted to the KV tier (gauge)."""
+        return self._host_nodes
+
+    @property
+    def host_pages(self) -> int:
+        """Page-equivalents currently demoted to the KV tier (gauge)."""
+        return self._host_pages
 
     def page_owners(self) -> Dict[int, int]:
         """Per-page retain counts held by the tree (engine self_check:
@@ -201,24 +249,26 @@ class PrefixCache:
 
     def _walk(
         self, prompt_ids: Sequence[int]
-    ) -> Tuple[List[int], int, _Node]:
+    ) -> Tuple[List[Tuple[_Node, int]], int, _Node]:
         """Longest whole-page cached match for `prompt_ids` (read-only).
 
-        Returns (pages, matched_pages, deepest_node).  At least one prompt
+        Returns (segments, matched_pages, deepest_node) where segments is
+        the matched (node, pages_taken) chain — nodes may be device- or
+        host-resident (lookup() promotes the latter).  At least one prompt
         token is always left to prefill, so at most (len-1)//page_size
         pages are matchable.
         """
         ps = self.pool.page_size
         limit = (len(prompt_ids) - 1) // ps
         node = self._root
-        pages: List[int] = []
+        segments: List[Tuple[_Node, int]] = []
         matched = 0
         while matched < limit:
             key = tuple(prompt_ids[matched * ps:(matched + 1) * ps])
             child = node.children.get(key)
             if child is None:
                 break
-            n = len(child.pages)
+            n = child.n_pages(ps)
             take = 1  # the child key IS its first page: already matched
             while (
                 take < n
@@ -227,12 +277,12 @@ class PrefixCache:
                 == list(prompt_ids[(matched + take) * ps:(matched + take + 1) * ps])
             ):
                 take += 1
-            pages.extend(child.pages[:take])
+            segments.append((child, take))
             matched += take
             node = child
             if take < n:
                 break
-        return pages, matched, node
+        return segments, matched, node
 
     def match_tokens(self, prompt_ids: Sequence[int]) -> int:
         """Longest cached prefix in TOKENS — a read-only probe (no retains,
@@ -251,21 +301,94 @@ class PrefixCache:
 
         The caller owns one retain on each returned page (released through
         the sequence's normal free path).  `key` only classifies the hit:
-        "own" when this thread's own store path covers the match,
-        "cross" when another thread's prefix is being reused.
+        "own" when this thread's own store path covers the match, "cross"
+        when another thread's prefix is being reused, "host_tier" when any
+        part of the match was promoted back from the KV tier.
+
+        Host-resident runs along the match are promoted here: fresh pool
+        pages are allocated and the H2D copy is enqueued (ahead of the
+        caller's suffix prefill, so it overlaps).  A promotion that cannot
+        get pages — or whose run the tier lost — truncates the hit at that
+        boundary; a torn promote additionally removes the node subtree
+        (its pages were freed, nothing is shared yet: re-prefill, never
+        partial KV).
         """
-        pages, matched, deepest = self._walk(prompt_ids)
+        segments, matched, _ = self._walk(prompt_ids)
         if matched == 0:
+            self.misses += 1
+            return None
+        ps = self.pool.page_size
+        pages: List[int] = []
+        promoted = 0
+        last_node: Optional[_Node] = None
+        # nodes of this walk must not be evicted by promotion's reclaim —
+        # their pages are in `pages` but not yet retained by the caller
+        protect = {node for node, _ in segments}
+        for node, take in segments:
+            if node.host_run is not None:
+                if self.tier is None:
+                    break  # unreachable by construction; fail soft
+                self.tier.touch(node.host_run)
+                if not self._promote_node(node, protect):
+                    break
+                promoted += take * ps
+            pages.extend(node.pages[:take])
+            last_node = node
+        if last_node is None:
             self.misses += 1
             return None
         # refresh recency: only the deepest matched node can be a leaf
         # (its ancestors have children by construction), so one touch
         # keeps hot prefixes off the eviction front
-        self._touch(deepest)
+        self._touch(last_node)
         self.pool.retain(pages)
-        cached = matched * self.pool.page_size
-        source = "own" if key is not None and key in deepest.keys else "cross"
-        return PrefixHit(pages=pages, tokens=cached, source=source)
+        cached = len(pages) * ps
+        if promoted:
+            source = "host_tier"
+        elif key is not None and key in last_node.keys:
+            source = "own"
+        else:
+            source = "cross"
+        return PrefixHit(pages=pages, tokens=cached, source=source,
+                         promoted_tokens=promoted)
+
+    def _promote_node(self, node: _Node, protect) -> bool:
+        """Re-materialize a host-resident run into fresh pool pages.
+
+        Under page pressure, promotion reclaims OTHER leaves first —
+        demoting a cold run to re-materialize the returning hot one is
+        the tier's whole policy — but never a node of the current walk
+        (`protect`): those pages are in the hit being assembled and not
+        yet retained by the caller, so evicting one would free pages out
+        from under the hit.  On tier failure the node subtree is removed
+        (the run is gone; deeper nodes are unreachable KV) and the caller
+        degrades to re-prefill.
+        """
+        assert self.tier is not None and node.host_run is not None
+        n = node.n_pages(self.pool.page_size)
+        if self.pool.free_pages < n:
+            self._reclaim_protected(n, protect)
+        try:
+            new_pages = self.pool.alloc(n)
+        except OutOfPagesError:
+            return False  # hit truncates; the node stays host-resident
+        if not self.tier.promote(node.host_run, new_pages):
+            self.pool.release(new_pages)
+            self._remove_subtree(node)
+            return False
+        node.host_run = None
+        node.pages = new_pages
+        for p in new_pages:
+            # alloc's refcount 1 IS the cache's retain — index it without
+            # a second pool.retain
+            self._page_retains[p] = self._page_retains.get(p, 0) + 1
+        self._n_pages += n
+        self._host_pages -= n
+        self._host_nodes -= 1
+        if not node.children:
+            self._leaves[node] = None
+            self._leaves.move_to_end(node)
+        return True
 
     def commit_hit(self, tokens: int, source: Optional[str]) -> None:
         """Count one hit.  Deliberately NOT done inside lookup(): these
@@ -280,6 +403,8 @@ class PrefixCache:
         self.tokens_reused += tokens
         if source == "cross":
             self.cross_thread_hits += 1
+        elif source == "host_tier":
+            self.host_tier_hits += 1
 
     # -- store -----------------------------------------------------------
 
@@ -312,7 +437,7 @@ class PrefixCache:
                 self._leaves.pop(node, None)  # parent is no longer a leaf
                 self._touch(new)
                 break
-            n = len(child.pages)
+            n = child.n_pages(ps)
             take = 1
             while (
                 take < n
@@ -328,18 +453,49 @@ class PrefixCache:
                 # the pages this thread's path actually walked, or a short
                 # store would extend its ownership over another thread's
                 # tail (mislabelling own/cross hits and pinning the tail
-                # against invalidate()).
-                self._split(child, take)
+                # against invalidate()).  A host-resident run whose tier
+                # payload is gone cannot split — drop the subtree and
+                # retry this page index (the fresh-insert branch takes it).
+                if not self._split(child, take):
+                    self._remove_subtree(child)
+                    continue
+            if child.host_run is not None:
+                # Adoption: the incoming sequence carries freshly-computed
+                # pages for exactly this run's tokens — a free promotion.
+                # The tier copy is dropped; the node is device-resident
+                # again without any H2D traffic.
+                adopt = list(pages[idx:idx + take])
+                self._retain_pages(adopt)
+                child.pages = adopt
+                if self.tier is not None:
+                    self.tier.discard(child.host_run)
+                child.host_run = None
+                self._n_pages += take
+                self._host_pages -= take
+                self._host_nodes -= 1
+                if not child.children:
+                    self._leaves[child] = None
             self._claim(child, key)
             self._touch(child)
             node = child
             idx += take
         self._evict_to_budget()
 
-    def _split(self, node: _Node, take: int) -> None:
+    def _split(self, node: _Node, take: int) -> bool:
         """Split `node` at `take` pages; the suffix becomes its child.
-        No refcount changes — the pages just move between nodes."""
+        Device runs move pages between the nodes (no refcount changes);
+        host-resident runs split their tier payload at the same boundary.
+        Returns False when the tier payload is gone — the caller must
+        remove the node (its KV no longer exists anywhere)."""
         ps = self.pool.page_size
+        front_run = back_run = None
+        if node.host_run is not None:
+            if self.tier is None:
+                return False
+            parts = self.tier.split(node.host_run, take)
+            if parts is None:
+                return False
+            front_run, back_run = parts
         suffix = _Node(node.tokens[take * ps:], node.pages[take:], node)
         suffix.children = node.children
         for c in suffix.children.values():
@@ -348,44 +504,113 @@ class PrefixCache:
         node.tokens = node.tokens[: take * ps]
         node.pages = node.pages[:take]
         node.children = {tuple(suffix.tokens[:ps]): suffix}
+        if front_run is not None:
+            node.host_run, suffix.host_run = front_run, back_run
+            self._host_nodes += 1  # one host node became two
         self._n_nodes += 1  # pages just moved between the two nodes
         # leaf status transfers: the prefix now has a child; the suffix is
         # a leaf iff the original node was one (it inherited the children)
+        # — host-resident suffixes are never pool-eviction candidates
         self._leaves.pop(node, None)
-        if not suffix.children:
+        if not suffix.children and suffix.host_run is None:
             self._leaves[suffix] = None
+        return True
 
     # -- eviction --------------------------------------------------------
 
     def _remove(self, node: _Node) -> None:
-        """Detach one node and release its pages.  No eviction counters —
-        pressure eviction (_evict_leaf) counts itself; invalidate()/
-        clear() must not read as cache thrash on /metrics."""
+        """Detach one node and release its pages (or discard its tier
+        run).  No eviction counters — pressure eviction (_evict_leaf)
+        counts itself; invalidate()/clear() must not read as cache thrash
+        on /metrics."""
         ps = self.pool.page_size
         parent = node.parent
         if parent is not None:
             parent.children.pop(tuple(node.tokens[:ps]), None)
-            if parent is not self._root and not parent.children:
+            if (
+                parent is not self._root
+                and not parent.children
+                and parent.host_run is None
+            ):
                 self._leaves[parent] = None  # parent became a leaf
-        self._release_pages(node.pages)
+        if node.host_run is not None:
+            if self.tier is not None:
+                self.tier.discard(node.host_run)
+            self._host_nodes -= 1
+            self._host_pages -= node.n_pages(ps)
+            node.host_run = None
+        else:
+            self._release_pages(node.pages)
+            self._n_pages -= len(node.pages)
         self.generation += 1
         self._n_nodes -= 1
-        self._n_pages -= len(node.pages)
         self._leaves.pop(node, None)
         node.parent = None
+
+    def _remove_subtree(self, node: _Node) -> None:
+        """Remove `node` and everything below it (a lost tier run makes
+        the whole subtree unreachable KV — deeper runs can never be
+        attached without their prefix)."""
+        stack = [node]
+        order: List[_Node] = []
+        while stack:
+            n = stack.pop()
+            order.append(n)
+            stack.extend(n.children.values())
+        for n in reversed(order):  # children before ancestors
+            self._remove(n)
 
     def _evict_leaf(self) -> bool:
         """Release the least-recently-used leaf — O(1) via the recency-
         ordered leaf map, not a tree walk (reclaim runs on the engine
         thread's allocation path).  Leaf-LRU by design: shared prefixes
-        near the root outlive their coldest consumer."""
+        near the root outlive their coldest consumer.
+
+        With a KV tier attached the victim is DEMOTED instead of dropped:
+        its rows are copied device->host (async; the gather is enqueued
+        before the pages are released, so in-order execution reads them
+        pre-overwrite), the pool pages are freed, and the node stays in
+        the tree as a host-resident run a future lookup can promote.  A
+        refused/failed demote (tier full, injected fault) falls back to
+        the plain drop."""
         if not self._leaves:
             return False
-        victim = next(iter(self._leaves))
+        self._evict_node(next(iter(self._leaves)))
+        return True
+
+    def _evict_node(self, victim: _Node) -> None:
+        """Demote-or-drop one leaf (the shared step of LRU eviction and
+        promotion's protected reclaim)."""
+        if self.tier is not None and victim.pages:
+            run = self.tier.demote(victim.pages)
+            if run is not None:
+                n = len(victim.pages)
+                self._release_pages(victim.pages)
+                self._n_pages -= n
+                self._host_pages += n
+                self._host_nodes += 1
+                victim.pages = []
+                victim.host_run = run
+                # host-resident runs leave the pool-eviction LRU; the
+                # tier's own second-chance LRU owns them now.  Content is
+                # unchanged (still matchable), so no generation bump.
+                self._leaves.pop(victim, None)
+                return
         self.evictions += 1
         self.pages_evicted += len(victim.pages)
         self._remove(victim)
-        return True
+
+    def _reclaim_protected(self, pages_needed: int, protect) -> None:
+        """Evict LRU leaves outside `protect` until the pool can satisfy
+        `pages_needed` (promotion's reclaim).  Best-effort: released
+        pages only become free when no live sequence shares them."""
+        while self.pool.free_pages < pages_needed:
+            victim = next(
+                (nd for nd in self._leaves if nd not in protect), None
+            )
+            if victim is None:
+                return
+            self._evict_node(victim)
 
     def _evict_to_budget(self) -> None:
         """Enforce the page budget, PAGE-granular: the LRU leaf is trimmed
@@ -396,6 +621,13 @@ class PrefixCache:
             return
         ps = self.pool.page_size
         while self._n_pages > self.max_pages and self._leaves:
+            if self.tier is not None:
+                # tiered: demote the whole LRU leaf (run granularity —
+                # demotion is not loss, so the partial-trim subtlety
+                # below doesn't apply)
+                if not self._evict_leaf():
+                    break
+                continue
             overage = self._n_pages - self.max_pages
             victim = next(iter(self._leaves))
             n = min(len(victim.pages), overage)
@@ -459,10 +691,16 @@ class PrefixCache:
     def clear(self) -> None:
         """Release everything (not counted as pressure eviction)."""
         for node in list(self._iter_nodes()):
-            self.pool.release(node.pages)
+            if node.host_run is not None:
+                if self.tier is not None:
+                    self.tier.discard(node.host_run)
+            else:
+                self.pool.release(node.pages)
         self._root = _Node([], [], None)
         self._n_nodes = 0
         self._n_pages = 0
+        self._host_nodes = 0
+        self._host_pages = 0
         self._leaves = OrderedDict()
         self._page_retains = {}
         self.generation += 1

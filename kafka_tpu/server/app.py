@@ -145,6 +145,8 @@ def build_tpu_provider(cfg: ServingConfig) -> LLMProvider:
         # 0 disables the radix prefix cache; None = pressure-bounded
         prefix_cache_entries=0 if cfg.prefix_cache_pages == 0 else 64,
         prefix_cache_pages=cfg.prefix_cache_pages or None,
+        kv_host_tier_mb=cfg.kv_host_tier_mb,
+        kv_disk_tier_dir=cfg.kv_disk_tier_dir,
         max_ttft_s=cfg.max_ttft_s,
         max_total_s=cfg.request_timeout_s,
         max_waiting=cfg.max_queue_depth,
@@ -330,6 +332,11 @@ def build_tpu_provider(cfg: ServingConfig) -> LLMProvider:
             # (one retrace if the padded table shape grows).
             if _warmup_grammar is not None:
                 e.warmup_grammar(_warmup_grammar)
+            # tiered-KV ship programs (KAFKA_TPU_KV_HOST_TIER_MB > 0):
+            # compile the per-bucket gather/scatter transfers so the first
+            # demotion/promotion pays copy latency, not an XLA compile on
+            # the scheduler thread (no-op when the tier is off)
+            e.warmup_kv_tier()
         engine.run_to_completion()
         engine_cfg.max_waiting = _admission_bound
         for e in engines:

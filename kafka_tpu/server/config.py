@@ -50,6 +50,16 @@ class ServingConfig:
     # Replaces the old per-thread entry-count cap — pages are what the
     # pool actually runs out of.
     prefix_cache_pages: Optional[int] = None
+    # Tiered KV cache (KAFKA_TPU_KV_HOST_TIER_MB, README "KV tiering"):
+    # host-RAM page tier under the pool, in MiB PER ENGINE REPLICA.
+    # Prefix-cache eviction demotes page runs host-side; a returning
+    # thread's lookup promotes them back instead of re-prefilling.  0
+    # (default) disables the tier — all paths byte-identical to before.
+    kv_host_tier_mb: int = 0
+    # Disk spill dir below the host tier (KAFKA_TPU_KV_DISK_TIER_DIR):
+    # host-budget overflow spills runs here (second-chance LRU) and the
+    # tracing span ring persists alongside.  None = drop on overflow.
+    kv_disk_tier_dir: Optional[str] = None
     # parallelism (SURVEY §2.2): the server builds its mesh from these.
     #   tp — tensor parallel within each engine (attention heads / MLP)
     #   sp — sequence parallel: ring-sharded chunked prefill for long
@@ -198,6 +208,10 @@ class ServingConfig:
             # while leaving the cache machinery running
             prefix_cache_pages=get("PREFIX_CACHE_PAGES", None,
                                    lambda v: max(0, int(v))),
+            # clamp negatives to 0 = disabled, same policy as above
+            kv_host_tier_mb=get("KV_HOST_TIER_MB", cls.kv_host_tier_mb,
+                                lambda v: max(0, int(v))),
+            kv_disk_tier_dir=get("KV_DISK_TIER_DIR", None),
             tp_size=get_axis("TP", cls.tp_size),
             sp_size=get_axis("SP", cls.sp_size),
             pp_size=get_axis("PP", cls.pp_size),

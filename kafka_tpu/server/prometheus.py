@@ -171,6 +171,13 @@ def render_prometheus(snap: Dict[str, Any]) -> str:
                  "(zero-roundtrip constrained decoding).")
         w.sample("kafka_tpu_constrained_ondevice_tokens_total",
                  con["constrained_ondevice_tokens"])
+    if "constrained_compile_pending" in con:
+        w.family("kafka_tpu_constrained_compile_pending", "gauge",
+                 "Grammar compiles queued/running on the background "
+                 "deferred-compile worker (requests use the host-mask "
+                 "path until their table lands).")
+        w.sample("kafka_tpu_constrained_compile_pending",
+                 con["constrained_compile_pending"])
 
     spec = snap.get("speculation") or {}
     if spec:
@@ -270,17 +277,68 @@ def render_prometheus(snap: Dict[str, Any]) -> str:
         w.family("kafka_tpu_prefix_cache_total", "counter",
                  "Prefix-cache events by kind.")
         for kind in ("hits", "misses", "tokens_reused",
-                     "cross_thread_hits", "evictions", "pages_evicted"):
+                     "cross_thread_hits", "host_tier_hits", "evictions",
+                     "pages_evicted"):
             if kind in pc:
                 w.sample("kafka_tpu_prefix_cache_total", pc[kind],
                          {"kind": kind})
         for idx, rpc in replica_pcs:
             for kind in ("hits", "misses", "tokens_reused",
-                         "cross_thread_hits", "evictions",
-                         "pages_evicted"):
+                         "cross_thread_hits", "host_tier_hits",
+                         "evictions", "pages_evicted"):
                 if kind in rpc:
                     w.sample("kafka_tpu_prefix_cache_total", rpc[kind],
                              {"replica": idx, "kind": kind})
+    if "host_nodes" in pc or "host_pages" in pc:
+        w.family("kafka_tpu_prefix_cache_host_resident", "gauge",
+                 "Radix runs currently demoted to the KV tier "
+                 "(still matchable; promoted back on lookup).")
+        for kind in ("host_nodes", "host_pages"):
+            if kind in pc:
+                w.sample("kafka_tpu_prefix_cache_host_resident",
+                         pc[kind], {"kind": kind})
+
+    # tiered KV cache (runtime/metrics.KV_TIER_METRIC_KEYS — the registry
+    # a static test enforces in both files; tests/test_kv_tier.py)
+    tier = snap.get("kv_tier") or {}
+    if tier:
+        w.family("kafka_tpu_kv_tier_bytes", "gauge",
+                 "Tiered-KV occupancy and budget by tier.")
+        for key, labels in (
+            ("host_bytes", {"tier": "host", "kind": "used"}),
+            ("host_budget_bytes", {"tier": "host", "kind": "budget"}),
+            ("disk_bytes", {"tier": "disk", "kind": "used"}),
+        ):
+            if key in tier:
+                w.sample("kafka_tpu_kv_tier_bytes", tier[key], labels)
+        w.family("kafka_tpu_kv_tier_runs", "gauge",
+                 "Demoted page runs resident per tier.")
+        for key, label in (("host_runs", "host"), ("disk_runs", "disk")):
+            if key in tier:
+                w.sample("kafka_tpu_kv_tier_runs", tier[key],
+                         {"tier": label})
+        w.family("kafka_tpu_kv_tier_total", "counter",
+                 "Tiered-KV events by kind.")
+        for key in ("demotions", "demote_failures", "promotions",
+                    "promote_failures", "host_evictions", "disk_spills",
+                    "disk_loads"):
+            if key in tier:
+                w.sample("kafka_tpu_kv_tier_total", tier[key],
+                         {"event": key})
+        w.family("kafka_tpu_kv_tier_pages_total", "counter",
+                 "Pages shipped between tiers by direction.")
+        for key, label in (("pages_demoted", "demoted"),
+                           ("pages_promoted", "promoted")):
+            if key in tier:
+                w.sample("kafka_tpu_kv_tier_pages_total", tier[key],
+                         {"dir": label})
+        w.family("kafka_tpu_kv_tier_bytes_total", "counter",
+                 "Bytes shipped between tiers by direction.")
+        for key, label in (("bytes_demoted", "demoted"),
+                           ("bytes_promoted", "promoted")):
+            if key in tier:
+                w.sample("kafka_tpu_kv_tier_bytes_total", tier[key],
+                         {"dir": label})
 
     sandbox = snap.get("sandbox") or {}
     if sandbox:

@@ -47,6 +47,7 @@ import contextlib
 import contextvars
 import dataclasses
 import itertools
+import json
 import logging
 import os
 import random
@@ -64,6 +65,16 @@ ENV_SPAN_CAP = "KAFKA_TPU_TRACE_SPAN_CAP"
 ENV_SLOW_TTFT = "KAFKA_TPU_SLOW_TTFT_MS"
 ENV_SLOW_TOTAL = "KAFKA_TPU_SLOW_TOTAL_MS"
 ENV_PROFILING = "KAFKA_TPU_PROFILING"
+# Span-ring persistence (PR 3 follow-up, closed by ISSUE 9): finished
+# traces are also written as JSON files under this directory, so the ring
+# survives process restarts alongside the disk KV tier.  Unset, it
+# defaults to <KAFKA_TPU_KV_DISK_TIER_DIR>/traces when the disk tier is
+# configured — the span ring persists "alongside the disk tier" with no
+# extra knob.  Explicit "" disables persistence even with a disk tier.
+ENV_PERSIST = "KAFKA_TPU_TRACE_PERSIST_DIR"
+# the disk-tier env is read by name (kv_tier.py owns it; importing the
+# runtime tier here would defeat this module's import-light contract)
+_ENV_DISK_TIER = "KAFKA_TPU_KV_DISK_TIER_DIR"
 
 # The DOCUMENTED SPAN REGISTRY: every span name emitted anywhere in
 # kafka_tpu/ (tracing.span("..."), record_span(ctx, "..."),
@@ -82,6 +93,10 @@ SPANS = (
                       # (candidate tokens offered / kept that round) (engine)
     "emit",           # first dispatch -> first token on host (engine)
     "sandbox.exec",   # tool execution INSIDE the sandbox subprocess
+    "kv.demote",      # page run copied device->host under pressure; attrs:
+                      # pages, bytes, overlap (runtime/kv_tier.py)
+    "kv.promote",     # page run re-materialized host->device ahead of the
+                      # suffix prefill; attrs: pages, bytes, source, overlap
 )
 
 # Trace-level instant events (supervisor actions that punctuate a request's
@@ -162,7 +177,10 @@ _span_cap = 2048
 _slow_ttft_ms: Optional[float] = None
 _slow_total_ms: Optional[float] = None
 _profiling = False
-_counters: Dict[str, int] = {"slow": 0, "traces": 0, "stitched_spans": 0}
+_persist_dir: Optional[str] = None
+_counters: Dict[str, int] = {
+    "slow": 0, "traces": 0, "stitched_spans": 0, "persisted": 0,
+}
 
 _ctx: "contextvars.ContextVar[Optional[TraceContext]]" = (
     contextvars.ContextVar("kafka_tpu_trace_ctx", default=None)
@@ -176,11 +194,24 @@ def configure(
     slow_total_ms: Optional[float] = None,
     profiling: Optional[bool] = None,
     span_cap: Optional[int] = None,
+    persist_dir: Optional[str] = None,
 ) -> None:
     """Programmatic config (server boot / tests).  None = leave as is;
-    for the slow thresholds, 0 disables (matching the env contract)."""
+    for the slow thresholds, 0 disables (matching the env contract); for
+    persist_dir, "" disables persistence."""
     global _sample, _capacity, _slow_ttft_ms, _slow_total_ms, _profiling
-    global _span_cap
+    global _span_cap, _persist_dir
+    if persist_dir is not None:
+        _persist_dir = persist_dir or None
+        if _persist_dir:
+            try:
+                os.makedirs(_persist_dir, exist_ok=True)
+            except OSError as e:
+                logger.warning(
+                    "trace persistence disabled (cannot create %s: %s)",
+                    _persist_dir, e,
+                )
+                _persist_dir = None
     if sample is not None:
         _sample = max(0.0, min(1.0, float(sample)))
     if ring is not None:
@@ -198,6 +229,13 @@ def configure(
 def load_env() -> None:
     """Read the env knobs (import time + server startup, like failpoints)."""
     env = os.environ
+    if ENV_PERSIST in env:
+        persist = env[ENV_PERSIST]  # explicit, "" = off
+    elif env.get(_ENV_DISK_TIER):
+        # persist the ring alongside the disk KV tier by default
+        persist = os.path.join(env[_ENV_DISK_TIER], "traces")
+    else:
+        persist = ""
     configure(
         sample=float(env.get(ENV_SAMPLE, "1.0")),
         ring=int(env.get(ENV_RING, "256")),
@@ -205,6 +243,7 @@ def load_env() -> None:
         slow_ttft_ms=float(env.get(ENV_SLOW_TTFT, "0") or 0),
         slow_total_ms=float(env.get(ENV_SLOW_TOTAL, "0") or 0),
         profiling=env.get(ENV_PROFILING, "0") in ("1", "true"),
+        persist_dir=persist,
     )
 
 
@@ -336,6 +375,8 @@ def finish_trace(root: Optional[Span], status: Any = None) -> None:
     if ctx is not None:
         _ctx.set(None)
     trace.done = True
+    if _persist_dir is not None:
+        _persist(trace)
     _check_slow(trace, root)
 
 
@@ -594,7 +635,136 @@ def get_trace(id_or_request_id: str) -> Optional[Trace]:
     if trace is None:
         tid = _by_request.get(id_or_request_id)
         trace = _traces.get(tid) if tid else None
+    if trace is None and _persist_dir is not None:
+        trace = _load_persisted(id_or_request_id)
     return trace
+
+
+# ---------------------------------------------------------------------------
+# ring persistence (alongside the disk KV tier — PR 3 follow-up)
+# ---------------------------------------------------------------------------
+
+# files kept on disk: a few rings' worth, pruned oldest-first at write time
+_PERSIST_KEEP_FACTOR = 4
+# prune cadence: listdir + stat + sort over the whole directory is ~1k
+# syscalls once full — amortize it instead of paying it per finished trace
+_PRUNE_EVERY = 64
+
+
+def _persist_name(trace_id: str) -> str:
+    """Filesystem-safe persisted-trace file name.
+
+    Trace ids can be ADOPTED VERBATIM from a client's X-Request-Id
+    header, so the id must never be used as a path: '../..' would write
+    (and let /debug/trace read) outside the persist dir.  The name keeps
+    a sanitized prefix for human ls-ability plus a digest of the full id
+    for uniqueness — computed identically on write and lookup."""
+    import hashlib
+
+    safe = "".join(
+        c if c.isalnum() or c in "._-" else "_" for c in trace_id[:48]
+    )
+    digest = hashlib.sha1(trace_id.encode()).hexdigest()[:12]
+    return f"{safe}.{digest}.trace.json"
+
+
+def _persist(trace: Trace) -> None:
+    """Write one finished trace as JSON (best-effort, never raises into
+    the serving path).  Files are named by a sanitized trace id; the
+    request id lives in the payload for the fallback scan."""
+    assert _persist_dir is not None
+    payload = {
+        "trace_id": trace.trace_id,
+        "request_id": trace.request_id,
+        "t0": trace.t0,
+        "root_id": trace.root_id,
+        "done": trace.done,
+        "dropped_spans": trace.dropped_spans,
+        "spans": [s.to_wire() for s in list(trace.spans)],
+        "events": list(trace.events),
+    }
+    path = os.path.join(_persist_dir, _persist_name(trace.trace_id))
+    try:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, path)
+        _counters["persisted"] += 1
+        if _counters["persisted"] % _PRUNE_EVERY == 0:
+            _prune_persisted()
+    except OSError as e:
+        logger.warning("trace persistence failed for %s: %s",
+                       trace.trace_id, e)
+
+
+def _prune_persisted() -> None:
+    """Bound the persisted set to a few rings' worth (oldest dropped)."""
+    assert _persist_dir is not None
+    try:
+        names = [n for n in os.listdir(_persist_dir)
+                 if n.endswith(".trace.json")]
+        keep = _capacity * _PERSIST_KEEP_FACTOR
+        if len(names) <= keep:
+            return
+        paths = [os.path.join(_persist_dir, n) for n in names]
+        paths.sort(key=lambda p: os.path.getmtime(p))
+        for p in paths[: len(paths) - keep]:
+            os.unlink(p)
+    except OSError:
+        pass
+
+
+def _trace_from_payload(payload: Dict[str, Any]) -> Trace:
+    trace = Trace(
+        trace_id=str(payload["trace_id"]),
+        request_id=str(payload.get("request_id", payload["trace_id"])),
+        t0=float(payload.get("t0", 0.0)),
+    )
+    trace.root_id = str(payload.get("root_id", ""))
+    trace.done = bool(payload.get("done", True))
+    trace.dropped_spans = int(payload.get("dropped_spans", 0))
+    for w in payload.get("spans", []):
+        trace.spans.append(Span(
+            name=str(w["name"]),
+            span_id=str(w["span_id"]),
+            parent_id=w.get("parent_id"),
+            t0=float(w["t0"]),
+            t1=float(w["t1"]) if w.get("t1") is not None else None,
+            attrs=dict(w.get("attrs") or {}),
+            thread=str(w.get("thread", "")),
+            pid=int(w.get("pid", 0)),
+        ))
+    trace.events = list(payload.get("events", []))
+    return trace
+
+
+def _load_persisted(id_or_request_id: str) -> Optional[Trace]:
+    """Disk fallback for a trace the ring evicted (or a prior process
+    recorded).  Direct hit by the sanitized trace-id file name (the same
+    derivation _persist used, so a hostile id cannot traverse out of the
+    dir); otherwise a bounded newest-first scan matching request_id —
+    cold path, debug endpoint."""
+    assert _persist_dir is not None
+    direct = os.path.join(_persist_dir, _persist_name(id_or_request_id))
+    try:
+        if os.path.exists(direct):
+            with open(direct) as f:
+                return _trace_from_payload(json.load(f))
+        names = [n for n in os.listdir(_persist_dir)
+                 if n.endswith(".trace.json")]
+        paths = [os.path.join(_persist_dir, n) for n in names]
+        paths.sort(key=lambda p: os.path.getmtime(p), reverse=True)
+        for p in paths[:512]:
+            try:
+                with open(p) as f:
+                    payload = json.load(f)
+            except (OSError, ValueError):
+                continue
+            if payload.get("request_id") == id_or_request_id:
+                return _trace_from_payload(payload)
+    except OSError:
+        return None
+    return None
 
 
 def recent_traces() -> List[Dict[str, Any]]:

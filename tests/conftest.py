@@ -105,6 +105,10 @@ _HEAVY_TAIL = (
     "test_server.py",
     "test_dp_router.py",
     "test_engine.py",
+    # after test_engine: the tier tests share its tiny-model shapes, and
+    # running them first would pre-warm the XLA cache under test_engine's
+    # wall-clock-sensitive deadline tests (timeout would race length)
+    "test_kv_tier.py",
     "test_grammar_fsm.py",
     "test_speculative.py",
     "test_server_parallel.py",

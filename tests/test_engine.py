@@ -672,6 +672,8 @@ class TestLifecycleHardening:
     def test_deadline_frees_slot_and_pages_mid_decode(self, model):
         import time as _time
 
+        from kafka_tpu.runtime import failpoints as _fp
+
         cfg, params = model
         eng = make_engine(cfg, params)
         req = GenRequest(request_id="mid", prompt_ids=[1, 2, 3],
@@ -679,10 +681,15 @@ class TestLifecycleHardening:
         eng.submit(req)
         reason = None
         t0 = _time.monotonic()
-        while reason is None and _time.monotonic() - t0 < 30:
-            for ev in eng.step():
-                if ev.finished:
-                    reason = ev.finish_reason
+        # slow each scheduler iteration so the deadline ALWAYS expires
+        # mid-decode — with warm compiled programs (XLA cache shared
+        # across modules) 500 tokens can otherwise finish inside 50ms
+        # and the finish reason races to "length"
+        with _fp.armed("engine.step", "delay", "0.005"):
+            while reason is None and _time.monotonic() - t0 < 30:
+                for ev in eng.step():
+                    if ev.finished:
+                        reason = ev.finish_reason
         assert reason == "timeout"
         assert all(s is None for s in eng.slots)
         assert eng.pool.free_pages == eng.pool.num_pages - 1
