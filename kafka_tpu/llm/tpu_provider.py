@@ -55,7 +55,7 @@ def _torn_items(d) -> list:
     """Snapshot a dict the engine thread mutates concurrently.
 
     list(dict.items()) can raise "dictionary changed size" mid-copy —
-    retry like metrics._copy_samples; torn reads are fine (a request
+    retry (the runtime/metrics.py policy); torn reads are fine (a request
     finishing during the copy no longer needs attention)."""
     for _ in range(8):
         try:
@@ -236,8 +236,98 @@ class TPULLMProvider(LLMProvider):
         """Count a gate-level HTTP 429 in requests.rejected (the engine
         backstop counts its own; without this, sustained overload — where
         the gate catches nearly everything — would show ~0 rejections).
-        Cross-thread int increment: GIL-atomic enough for a counter."""
+        A rejection is also an SLO miss (metrics.record_rejected), so the
+        attainment gauges see shed load.  Cross-thread int increment:
+        GIL-atomic enough for a counter."""
         self._replicas()[0].metrics.record_rejected()
+
+    def signals(self) -> Dict[str, Any]:
+        """One coherent autoscaler-input snapshot (GET /admin/signals,
+        ISSUE 10).  This is the INPUT CONTRACT for the coming resize
+        control loop — the fields below are stable:
+
+        * ``queue``: dp-wide waiting depth, peak since last snapshot, and
+          the 60s depth slope (``trend_per_s`` > 0 = demand outrunning
+          capacity).
+        * ``batch``: decode-slot occupancy (mean busy slots per step /
+          max_batch), active lanes, configured max_batch x dp.
+        * ``slo``: window attainment (1m/5m), the configured targets, and
+          goodput (tokens from SLO-met requests) — scale up when
+          attainment_1m sags under the target with a rising queue; scale
+          down when attainment holds at 1.0 with idle occupancy.
+        * ``utilization``: per-dispatch-kind MFU / HBM-bandwidth
+          utilization against the chip roofline (since-boot + 1m) — how
+          close each replica runs to the hardware, i.e. whether more
+          replicas or bigger batches is the right lever.
+        * ``replicas``: per-replica health state (quarantined replicas
+          are capacity the router cannot use), load, KV-page headroom,
+          and utilization.
+
+        Everything is read torn-tolerantly from the engine thread's
+        single-writer metrics; no locks, safe at scrape frequency.
+        """
+        engine = self.engine
+        # reset_peak=False: the ~1 Hz signal poll must not consume the
+        # /metrics scraper's peak-since-last-snapshot window
+        snap = engine.metrics.snapshot(engine, reset_peak=False)
+        replicas = self._replicas()
+        health = getattr(engine, "health", None)
+        occupancy = snap.get("decode", {}).get("batch_occupancy", 0.0)
+        max_batch = engine.ecfg.max_batch
+        per_replica: List[Dict[str, Any]] = []
+        rep_snaps = snap.get("replicas")
+        for i, e in enumerate(replicas):
+            rs = (rep_snaps[i] if rep_snaps and i < len(rep_snaps)
+                  else snap)
+            util = rs.get("utilization") or {}
+            per_replica.append({
+                "replica": i,
+                "state": health[i].state if health else "healthy",
+                "active": e.num_active,
+                "waiting": len(e.waiting),
+                "parked": len(e.parked),
+                "pages_free": e.pool.free_pages,
+                "pages_total": e.pool.num_pages,
+                "batch_occupancy": rs.get("decode", {}).get(
+                    "batch_occupancy", 0.0
+                ),
+                "utilization": {
+                    kind: {
+                        "mfu": util.get(kind, {}).get("mfu", 0.0),
+                        "mfu_1m": util.get(kind, {}).get("mfu_1m", 0.0),
+                        "hbm_bw_util": util.get(kind, {}).get(
+                            "hbm_bw_util", 0.0
+                        ),
+                        "hbm_bw_util_1m": util.get(kind, {}).get(
+                            "hbm_bw_util_1m", 0.0
+                        ),
+                    }
+                    for kind in ("prefill", "decode", "verify")
+                },
+            })
+        return {
+            "version": 1,
+            "dp": len(replicas),
+            "queue": dict(snap.get("queue") or {}),
+            "batch": {
+                "occupancy": occupancy,
+                "occupancy_frac": round(occupancy / max_batch, 4)
+                if max_batch else 0.0,
+                "active": engine.num_active,
+                "max_batch": max_batch,
+                "slots_total": max_batch * len(replicas),
+            },
+            "slo": {
+                k: v for k, v in (snap.get("slo") or {}).items()
+                if not k.startswith("window_")
+            },
+            "utilization": snap.get("utilization") or {},
+            "replicas": per_replica,
+            "supervisor": {
+                k: v for k, v in snap["replica_supervisor"].items()
+                if k != "health"
+            } if snap.get("replica_supervisor") else None,
+        }
 
     async def drain(self, timeout_s: float = 30.0) -> bool:
         """Graceful drain: let in-flight requests finish, then cancel.

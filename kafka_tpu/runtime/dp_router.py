@@ -611,10 +611,12 @@ class _AggregateMetrics:
         self._router = router
         self._engines = router.engines
 
-    def snapshot(self, engine=None) -> Dict[str, Any]:
-        from .metrics import _copy_samples, _percentiles
+    def snapshot(self, engine=None,
+                 reset_peak: bool = True) -> Dict[str, Any]:
+        from .metrics import HISTOGRAM_NAMES, StreamingHistogram
 
-        snaps = [e.metrics.snapshot(e) for e in self._engines]
+        snaps = [e.metrics.snapshot(e, reset_peak=reset_peak)
+                 for e in self._engines]
         agg: Dict[str, Any] = {
             "dp": len(snaps),
             "replicas": snaps,  # per-replica detail
@@ -628,6 +630,10 @@ class _AggregateMetrics:
         agg["queue"] = {
             "depth": sum(s["queue"]["depth"] for s in snaps),
             "peak": max(s["queue"]["peak"] for s in snaps),
+            # depth slopes add: the dp-wide queue's growth rate
+            "trend_per_s": round(
+                sum(s["queue"]["trend_per_s"] for s in snaps), 4
+            ),
         }
         gen = sum(s["tokens"]["generated"] for s in snaps)
         wasted = sum(s["tokens"]["fetch_pipeline_wasted"] for s in snaps)
@@ -679,26 +685,108 @@ class _AggregateMetrics:
                 acc / steps_v, 3
             ) if steps_v else 0.0,
         }
-        # latency percentiles cannot be combined from per-replica
-        # percentiles — pool the raw samples and recompute
-        ttft = [v for e in self._engines
-                for v in _copy_samples(e.metrics.ttft_ms)]
-        tpot = [v for e in self._engines
-                for v in _copy_samples(e.metrics.tpot_ms)]
-        agg["ttft_ms"] = {k: round(v, 2)
-                          for k, v in _percentiles(ttft).items()}
-        agg["tpot_ms"] = {k: round(v, 2)
-                          for k, v in _percentiles(tpot).items()}
-        bursts = [v for e in self._engines
-                  for v in _copy_samples(e.metrics.burst_tokens)]
-        gaps = [v for e in self._engines
-                for v in _copy_samples(e.metrics.burst_gap_ms)]
-        agg["emission"] = {
-            "burst_tokens": {k: round(v, 2)
-                             for k, v in _percentiles(bursts).items()},
-            "burst_gap_ms": {k: round(v, 2)
-                             for k, v in _percentiles(gaps).items()},
+        # latency distributions MERGE exactly (the whole point of the
+        # fixed-bucket streaming histograms, ISSUE 10): same bounds, bucket
+        # counts add — no raw-sample pooling, no percentile-of-percentiles.
+        # Merged from the SAME per-replica snapshots exported below so the
+        # aggregate equals the sum of agg["replicas"] within one scrape.
+        merged = {
+            name: StreamingHistogram.merged([
+                StreamingHistogram.from_snapshot(s["histograms"][name])
+                for s in snaps
+            ])
+            for name in HISTOGRAM_NAMES
         }
+        agg["histograms"] = {
+            name: h.snapshot() for name, h in merged.items()
+        }
+        agg["ttft_ms"] = merged["ttft_ms"].quantiles()
+        agg["tpot_ms"] = merged["tpot_ms"].quantiles()
+        agg["ttft_breakdown_ms"] = {
+            "queue_wait": merged["ttft_queue_ms"].quantiles(),
+            "prefill": merged["ttft_prefill_ms"].quantiles(),
+            "first_fetch": merged["ttft_fetch_ms"].quantiles(),
+        }
+        agg["emission"] = {
+            "burst_tokens": merged["burst_tokens"].quantiles(),
+            "burst_gap_ms": merged["burst_gap_ms"].quantiles(),
+        }
+        # SLO/goodput (SLO_METRIC_KEYS): counters and raw window sums add,
+        # then the SHARED builder recomputes every ratio (one home for the
+        # attainment/goodput math — metrics.build_slo_section — so the
+        # aggregate cannot drift from the per-engine exposition); targets
+        # are deployment-wide (same env), reported once
+        from .metrics import build_slo_section
+
+        slos = [s["slo"] for s in snaps]
+
+        def _wsum(key):
+            return {
+                f: sum(s[key][f] for s in slos)
+                for f in ("met", "missed", "goodput_tokens")
+            }
+
+        agg["slo"] = build_slo_section(
+            ttft_target_ms=slos[0]["slo_ttft_target_ms"],
+            tpot_target_ms=slos[0]["slo_tpot_target_ms"],
+            met=sum(s["slo_met_requests"] for s in slos),
+            missed=sum(s["slo_missed_requests"] for s in slos),
+            ttft_violations=sum(s["slo_ttft_violations"] for s in slos),
+            tpot_violations=sum(s["slo_tpot_violations"] for s in slos),
+            goodput_tokens=sum(s["goodput_tokens"] for s in slos),
+            generated_tokens=gen,
+            uptime_s=snaps[0]["uptime_s"],
+            window_1m=_wsum("window_1m"),
+            window_5m=_wsum("window_5m"),
+        )
+        # device utilization (UTILIZATION_METRIC_KEYS): per-kind counters
+        # sum; the MFU / HBM-BW ratios are recomputed from the summed
+        # flop/byte/busy totals against the (homogeneous) replica roofline
+        from .metrics import UTILIZATION_KINDS
+
+        utils = [s["utilization"] for s in snaps]
+        agg_util: Dict[str, Any] = {
+            "peak_tflops": utils[0]["peak_tflops"],
+            "peak_hbm_gbps": utils[0]["peak_hbm_gbps"],
+            "peak_source": utils[0]["peak_source"],
+        }
+        peak_f = (utils[0]["peak_tflops"] or 0) * 1e12
+        peak_b = (utils[0]["peak_hbm_gbps"] or 0) * 1e9
+        for kind in UTILIZATION_KINDS:
+            rows = [u[kind] for u in utils]
+            sec: Dict[str, Any] = {
+                "dispatches": sum(r["dispatches"] for r in rows),
+                "tokens": sum(r["tokens"] for r in rows),
+                "flops": sum(r["flops"] for r in rows),
+                "hbm_bytes": sum(r["hbm_bytes"] for r in rows),
+                "busy_s": round(sum(r["busy_s"] for r in rows), 3),
+                "mfu": 0.0, "hbm_bw_util": 0.0,
+                "mfu_1m": 0.0, "hbm_bw_util_1m": 0.0,
+            }
+            # aggregate busy time is SUMMED replica-seconds, so the ratio
+            # divides by replica-seconds of roofline — per-chip MFU, not
+            # fleet-total
+            if sec["busy_s"] > 0:
+                if peak_f:
+                    sec["mfu"] = round(
+                        sec["flops"] / (sec["busy_s"] * peak_f), 4
+                    )
+                if peak_b:
+                    sec["hbm_bw_util"] = round(
+                        sec["hbm_bytes"] / (sec["busy_s"] * peak_b), 4
+                    )
+            wf = sum(r["window_1m"]["flops"] for r in rows)
+            wb = sum(r["window_1m"]["hbm_bytes"] for r in rows)
+            ws = sum(r["window_1m"]["busy_s"] for r in rows)
+            if ws > 0:
+                if peak_f:
+                    sec["mfu_1m"] = round(wf / (ws * peak_f), 4)
+                if peak_b:
+                    sec["hbm_bw_util_1m"] = round(wb / (ws * peak_b), 4)
+            sec["window_1m"] = {"flops": wf, "hbm_bytes": wb,
+                                "busy_s": round(ws, 4)}
+            agg_util[kind] = sec
+        agg["utilization"] = agg_util
         steps = sum(s["decode"]["steps"] for s in snaps)
         busy = sum(e.metrics.decode_busy_slots for e in self._engines)
         agg["decode"] = {

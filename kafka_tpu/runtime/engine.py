@@ -81,7 +81,12 @@ from .kv_cache import (
 from .metrics import EngineMetrics
 from .prefix_cache import PrefixCache
 from .speculative import LaneSpeculator
-from .tracing import add_event, profiler_annotations_enabled, record_span
+from .tracing import (
+    add_event,
+    annotate,
+    profiler_annotations_enabled,
+    record_span,
+)
 
 logger = logging.getLogger("kafka_tpu.engine")
 
@@ -335,6 +340,11 @@ class GenRequest:
     # drain) and the lane is masked out of every dispatch until it drains.
     spec: Optional[LaneSpeculator] = None
     spec_ahead: int = 0
+    # SLO verdict (ISSUE 10): set at finalize by engine._finalize_slo —
+    # True = met every configured target, False = missed, None = excluded
+    # (client cancel) or not yet finalized.  The serving layer reads it
+    # for span attrs / logs; /metrics aggregates the counters.
+    slo_met: Optional[bool] = None
 
     @property
     def cached_len(self) -> int:
@@ -809,6 +819,38 @@ class InferenceEngine:
             )
             self.prefix_cache.tier = self.kv_tier
         self.metrics = EngineMetrics()
+        # Device-utilization estimator (ISSUE 10): the planner's
+        # per-dispatch flop/byte cost model plus this chip's datasheet
+        # roofline.  Every dispatch site reports its modeled cost to
+        # metrics.record_dispatch_cost; wall time is attributed there.
+        # Best-effort — an exotic tree/mesh that defeats the arithmetic
+        # disables the estimator, never serving.
+        self._cost_model = None
+        self._roofline: Optional[Tuple] = None
+        self._have_roofline = False
+        try:
+            from ..models.quant import param_bytes as _param_bytes
+            from .planner import device_peaks, dispatch_cost_model
+
+            n_dev = int(mesh.devices.size) if mesh is not None else 1
+            kv_b = int(getattr(self.k_pool.dtype, "itemsize", 2))
+            self._cost_model = dispatch_cost_model(
+                cfg,
+                n_devices=n_dev,
+                weight_bytes_total=_param_bytes(params),
+                kv_dtype_bytes=kv_b,
+                kv_replication=self._tq,
+            )
+            dev = (mesh.devices.flat[0] if mesh is not None
+                   else jax.devices()[0])
+            self._roofline = device_peaks(dev)
+            self.metrics.set_roofline(*self._roofline)
+            # a known roofline must survive metrics RESETS (warmup and
+            # bench swap in fresh EngineMetrics objects): the cost
+            # helpers re-apply it on the first dispatch they record
+            self._have_roofline = self._roofline[2] != "unknown"
+        except Exception as e:
+            logger.debug("dispatch cost model unavailable: %s", e)
         # DP replica index (set by runtime/dp_router.py): traced requests'
         # engine spans carry it so a timeline names the replica it ran on
         self.replica: Optional[int] = None
@@ -1616,7 +1658,7 @@ class InferenceEngine:
                 pass
         req.state = FINISHED
         req.finish_reason = reason
-        self.metrics.record_finish(reason)
+        self._finalize_slo(req, reason)
         if req.slot >= 0 or req.seq is not None:
             self._release_slot(req)
         self._requests.pop(request_id, None)
@@ -1676,7 +1718,7 @@ class InferenceEngine:
                 pass
         req.state = FINISHED
         req.finish_reason = "timeout"
-        self.metrics.record_finish("timeout")
+        self._finalize_slo(req, "timeout")
         if req.slot >= 0 or req.seq is not None or req in self.parked:
             self._release_slot(req)
         self._requests.pop(req.request_id, None)
@@ -1849,7 +1891,7 @@ class InferenceEngine:
                 continue
             req.state = FINISHED
             req.finish_reason = "error:engine"
-            self.metrics.record_finish("error:engine")
+            self._finalize_slo(req, "error:engine")
             add_event(req.trace, "engine.recover",
                       {"reason": "error:engine", **self._tattrs()})
             self._release_slot(req)
@@ -2156,7 +2198,7 @@ class InferenceEngine:
             return
         req.finish_reason = reason
         req.state = FINISHED
-        self.metrics.record_finish(reason)
+        self._finalize_slo(req, reason)
         if (
             req.seq is not None
             and req.prefix_key is not None
@@ -2552,6 +2594,9 @@ class InferenceEngine:
             self._arg(top_ps), self._arg(seeds), self._arg(lane_active),
             *vis,
         )
+        self._record_prefill_cost([
+            (int(chunk_lens[i]), int(starts[i])) for i in range(len(reqs))
+        ])
         items: List[Optional[GenRequest]] = [None] * W
         finals_row: List[Optional[str]] = [None] * W
         for i, req in enumerate(reqs):
@@ -2675,6 +2720,7 @@ class InferenceEngine:
             req.prefill_allowed,
             *vis,
         )
+        self._record_prefill_cost([(chunk_len, start)])
         req.seq.length = start + chunk_len
         if req.seq.length < total:
             return  # more chunks to go; decode proceeds meanwhile
@@ -2834,6 +2880,7 @@ class InferenceEngine:
                 self._dispatch_group(full_batch, self._d_active, None,
                                      full=True, fsm=fsm_any)
             self.metrics.record_decode_step(len(active_slots))
+            self._record_decode_cost(active_slots)
             return
         # Mixed/host-constrained batch.  A host-masked lane's next mask
         # depends on every token it has emitted so far, so its decode
@@ -2993,6 +3040,15 @@ class InferenceEngine:
             self.metrics.record_decode_step(
                 n_uncon + n_chain + n_amb_dispatched
             )
+            # cost model: same convention — the iteration's groups count
+            # as one dispatch over exactly the lanes they ADVANCED
+            # (awaiting/degraded lanes sat this iteration out and must not
+            # inflate MFU or dispatch_tokens)
+            dispatched = [m for m in uncon if m is not None]
+            dispatched += [req for req, _tok in chain_toks]
+            if n_amb_dispatched:
+                dispatched += [m for m in amb_m if m is not None]
+            self._record_decode_cost(dispatched)
 
     def _assert_private_tail(self, req: GenRequest, cl: int) -> None:
         """Speculative writes only ever land in the lane's PRIVATE tail
@@ -3177,6 +3233,9 @@ class InferenceEngine:
                 self._to_draining(req)
         self.metrics.record_decode_step(busy)
         self.metrics.record_verify_dispatch(n_proposed)
+        # verify cost: every lane advances >= 1 query plus its candidates
+        self._record_decode_cost(members, kind="verify",
+                                 queries=busy + n_proposed)
         return True
 
     def _pick_multi_step(self, active_slots: List[GenRequest]) -> int:
@@ -3277,6 +3336,7 @@ class InferenceEngine:
         self.metrics.record_decode_step(
             sum(1 for m in entry.items if m is not None), steps=k
         )
+        self._record_decode_cost(entry.items, steps=k)
 
     def _constrained_inflight(self) -> bool:
         """Is the constrained micro-batch still waiting on its last fetch?"""
@@ -3572,6 +3632,88 @@ class InferenceEngine:
             "over-tight constrained mask for %s (fsm state %s): sampler "
             "degrades this row to unconstrained", req.request_id, desc,
         )
+
+    def _finalize_slo(self, req: GenRequest, reason: Optional[str]) -> None:
+        """Terminal metrics + SLO verdict for one request (ISSUE 10).
+
+        TTFT and mean TPOT come from the request's own stamps (mean TPOT
+        spans first token -> finalize, so it includes the fetch-pipeline
+        drain the client actually experienced); the verdict is classified
+        against the configured targets in metrics.record_finish, goodput
+        is credited for met requests, and the verdict is stamped onto the
+        request's http.request root span for /debug/trace and the
+        slow-request log."""
+        now = time.monotonic()
+        ttft_s = (req.first_token_time - req.submit_time
+                  if req.first_token_time is not None else None)
+        n_out = len(req.output_ids)
+        tpot_s = None
+        if req.first_token_time is not None and n_out > 1:
+            tpot_s = (now - req.first_token_time) / (n_out - 1)
+        met = self.metrics.record_finish(
+            reason, ttft_s=ttft_s, tpot_s=tpot_s, tokens=n_out
+        )
+        req.slo_met = met
+        if met is not None and req.trace is not None:
+            annotate(req.trace, {
+                "slo_met": met,
+                "slo_ttft_ms": round(ttft_s * 1e3, 1)
+                if ttft_s is not None else None,
+                "slo_tpot_ms": round(tpot_s * 1e3, 2)
+                if tpot_s is not None else None,
+                "goodput_tokens": n_out if met else 0,
+            })
+
+    def _record_prefill_cost(self, lanes) -> None:
+        """Report one prefill dispatch's modeled cost: `lanes` is
+        [(chunk_tokens, start_pos), ...] for every lane the dispatch
+        advanced.  Weights stream once per dispatch, so the per-lane
+        weight-byte term is de-duplicated here."""
+        cm = self._cost_model
+        if cm is None or not self.metrics.enabled:
+            return
+        if self._have_roofline and self.metrics.peak_source == "unknown":
+            # fresh metrics object (warmup/bench reset): restore the
+            # roofline so MFU/HBM ratios don't silently flatline at 0
+            self.metrics.set_roofline(*self._roofline)
+        flops = bytes_ = 0.0
+        toks = 0
+        for chunk, start in lanes:
+            lf, lb = cm.prefill_cost(chunk, start)
+            flops += lf
+            bytes_ += lb - cm.weight_bytes
+            toks += chunk
+        bytes_ += cm.weight_bytes
+        self.metrics.record_dispatch_cost("prefill", toks, flops, bytes_)
+
+    def _record_decode_cost(self, members, steps: int = 1,
+                            kind: str = "decode",
+                            queries: Optional[int] = None) -> None:
+        """Report one decode/verify dispatch's modeled cost.  `members`
+        is the slot-aligned lane list (None = masked out); context is the
+        host-known per-lane KV length sum.  `queries` overrides the
+        query-token count for verify dispatches (sum of candidate widths
+        across lanes)."""
+        cm = self._cost_model
+        if cm is None or not self.metrics.enabled:
+            return
+        if self._have_roofline and self.metrics.peak_source == "unknown":
+            self.metrics.set_roofline(*self._roofline)  # survive resets
+        lanes = [m for m in members if m is not None]
+        if not lanes:
+            return
+        ctx = sum(m.seq.length if m.seq is not None else 0 for m in lanes)
+        if kind == "verify":
+            toks = queries if queries is not None else len(lanes)
+            # each lane's K+1-wide query block attends its whole context:
+            # pairs ~= ctx x mean query width (uniform-width estimate)
+            flops, bytes_ = cm.verify_cost(
+                toks, ctx, attn_pairs=ctx * toks / len(lanes)
+            )
+        else:
+            toks = len(lanes) * steps
+            flops, bytes_ = cm.decode_cost(toks, ctx, steps)
+        self.metrics.record_dispatch_cost(kind, toks, flops, bytes_)
 
     def _next_constraint(self, s: GenRequest):
         """Classify the next constrained step for a lane.

@@ -51,6 +51,20 @@ HBM_BYTES = {
     "v4": 32 * GiB,
 }
 
+# chip generation -> (peak dense bf16 FLOP/s, HBM bytes/s) per chip —
+# the roofline the device-utilization estimator (ISSUE 10) divides the
+# planner's modeled per-dispatch flop/byte costs by.  Public datasheet
+# numbers, like HBM_BYTES above.
+CHIP_PEAKS = {
+    "v5e": (197e12, 819e9),
+    "v5p": (459e12, 2765e9),
+    "v6e": (918e12, 1640e9),
+    "v4": (275e12, 1228e9),
+}
+
+PEAK_TFLOPS_ENV = "KAFKA_TPU_PEAK_TFLOPS"
+PEAK_HBM_GBPS_ENV = "KAFKA_TPU_PEAK_HBM_GBPS"
+
 _DTYPE_BYTES = {"bfloat16": 2, "float32": 4, "float16": 2, "int8": 1}
 
 
@@ -378,6 +392,143 @@ def plan_memory(
         ),
     )
     return plan
+
+
+def device_peaks(dev) -> tuple:
+    """(peak FLOP/s, peak HBM bytes/s, source) roofline for a live jax
+    device — the denominator of the MFU / HBM-bandwidth-utilization
+    estimator (ISSUE 10).
+
+    KAFKA_TPU_PEAK_TFLOPS / KAFKA_TPU_PEAK_HBM_GBPS override everything
+    (CPU runs, unlisted chip revisions, derated shared machines); else
+    the datasheet table by device_kind.  Unknown generations return
+    (None, None, "unknown") — the estimator then reports achieved
+    FLOP/s and GB/s without ratios rather than inventing a roofline.
+    """
+    import os as _os
+
+    env_tf = _os.environ.get(PEAK_TFLOPS_ENV)
+    env_bw = _os.environ.get(PEAK_HBM_GBPS_ENV)
+    if env_tf or env_bw:
+        try:
+            return (
+                float(env_tf) * 1e12 if env_tf else None,
+                float(env_bw) * 1e9 if env_bw else None,
+                "env",
+            )
+        except ValueError:
+            pass
+    if getattr(dev, "platform", None) != "tpu":
+        return None, None, "unknown"
+    kind = getattr(dev, "device_kind", "").lower()
+    if "v5p" in kind:
+        return (*CHIP_PEAKS["v5p"], "datasheet")
+    if "v6" in kind:
+        return (*CHIP_PEAKS["v6e"], "datasheet")
+    if "lite" in kind or "v5e" in kind or "v5" in kind:
+        return (*CHIP_PEAKS["v5e"], "datasheet")
+    if "v4" in kind:
+        return (*CHIP_PEAKS["v4"], "datasheet")
+    return None, None, "unknown"
+
+
+@dataclasses.dataclass(frozen=True)
+class DispatchCostModel:
+    """Per-device flop/byte cost of one engine dispatch, from the same
+    shape arithmetic the memory plan uses (ISSUE 10).
+
+    The engine calls the cost methods at every dispatch site with its
+    host-known shapes (new tokens sampled, total KV context attended);
+    the products divide by measured inter-dispatch wall time in
+    runtime/metrics.py to yield MFU and HBM-bandwidth utilization.
+    Deliberately an ESTIMATE: matmul flops use the 2·params convention
+    (embedding lookups and norms are noise), attention uses 4·H·D per
+    (query, kv) pair, and per-device sharing divides evenly across the
+    mesh — replication factors (tq groups, norms) undercount a few
+    percent, which is far inside the wall-time attribution error.
+    """
+
+    flops_per_token: float       # per device: matmul flops for 1 token
+    attn_flops_per_kv: float     # per device: per (query, kv-token) pair
+    weight_bytes: int            # per device: read once per dispatch step
+    kv_bytes_per_token: int      # per device: one token's k+v row
+
+    def decode_cost(self, new_tokens: int, kv_tokens: int,
+                    steps: int = 1) -> tuple:
+        """One decode dispatch advancing `new_tokens` lanes by `steps`
+        fused steps, attending ~`kv_tokens` total context per step.
+        Decode is HBM-bound: every weight byte streams once per step and
+        the batch's whole KV window is gathered per step."""
+        flops = steps * kv_tokens * self.attn_flops_per_kv \
+            + new_tokens * self.flops_per_token
+        bytes_ = steps * (self.weight_bytes
+                          + kv_tokens * self.kv_bytes_per_token) \
+            + new_tokens * self.kv_bytes_per_token
+        return flops, bytes_
+
+    def prefill_cost(self, chunk_tokens: int, start_tokens: int) -> tuple:
+        """One prefill chunk of `chunk_tokens` starting at position
+        `start_tokens`: causal attention pairs = chunk·start + chunk²/2;
+        KV reads cover the materialized window once, writes the chunk."""
+        pairs = chunk_tokens * start_tokens + chunk_tokens * chunk_tokens / 2
+        flops = (chunk_tokens * self.flops_per_token
+                 + pairs * self.attn_flops_per_kv)
+        bytes_ = (self.weight_bytes
+                  + (start_tokens + chunk_tokens) * self.kv_bytes_per_token
+                  + chunk_tokens * self.kv_bytes_per_token)
+        return flops, bytes_
+
+    def verify_cost(self, query_tokens: int, kv_tokens: int,
+                    attn_pairs: Optional[float] = None) -> tuple:
+        """One speculative verify dispatch scoring `query_tokens` total
+        candidate positions (sum over lanes of cand+1) against
+        `kv_tokens` of context.  `attn_pairs` is the (query, kv-token)
+        pair count — each of a lane's K+1 queries attends that lane's
+        whole context, so pairs ~= kv_tokens x per-lane query width, NOT
+        kv_tokens (the decode convention); callers pass it, the
+        query_tokens fallback covers width-1 degenerate calls.  Bytes
+        stay kv_tokens-based: the kernel streams each KV page once per
+        lane regardless of query width."""
+        if attn_pairs is None:
+            attn_pairs = float(kv_tokens)
+        flops = (query_tokens * self.flops_per_token
+                 + attn_pairs * self.attn_flops_per_kv)
+        bytes_ = (self.weight_bytes + kv_tokens * self.kv_bytes_per_token
+                  + query_tokens * self.kv_bytes_per_token)
+        return flops, bytes_
+
+
+def dispatch_cost_model(
+    cfg: ModelConfig,
+    *,
+    n_devices: int = 1,
+    weight_bytes_total: Optional[int] = None,
+    kv_dtype_bytes: int = 2,
+    kv_replication: int = 1,
+) -> DispatchCostModel:
+    """Build the per-device dispatch cost model for an engine.
+
+    `weight_bytes_total` is the engine's ACTUAL materialized parameter
+    bytes when known (models/quant.param_bytes — exact for int8 trees);
+    falls back to the planner's bf16 arithmetic.  `kv_replication` is the
+    tq factor (grouped GQA replicates each kv head across its tq group,
+    so per-device KV traffic does not shrink by the full device count).
+    """
+    if weight_bytes_total is None:
+        weight_bytes_total = weight_bytes_per_device(cfg, tp=1)
+    wb = _bytes(cfg.dtype)
+    # params from the unsharded bf16 arithmetic (stable vs quantization)
+    params_total = weight_bytes_per_device(cfg, tp=1) / wb
+    n = max(1, n_devices)
+    kv_row = 2 * cfg.num_layers * cfg.num_kv_heads * cfg.head_dim \
+        * kv_dtype_bytes
+    return DispatchCostModel(
+        flops_per_token=2.0 * params_total / n,
+        attn_flops_per_kv=4.0 * cfg.num_layers * cfg.num_heads
+        * cfg.head_dim / n,
+        weight_bytes=int(weight_bytes_total // n),
+        kv_bytes_per_token=int(kv_row * max(1, kv_replication) // n),
+    )
 
 
 def plan_for_serving(scfg, hbm_bytes: Optional[int] = None,

@@ -14,6 +14,7 @@ from ..tracing import (  # noqa: F401
     Trace,
     TraceContext,
     add_event,
+    annotate,
     child_collector,
     chrome_trace,
     configure,

@@ -392,6 +392,14 @@ async def create_app(
         slow_ttft_ms=cfg.slow_ttft_ms or 0,
         slow_total_ms=cfg.slow_total_ms or 0,
     )
+    # SLO targets likewise: configured before the engine builds so every
+    # EngineMetrics (including the post-warmup resets) classifies against
+    # the deployment's targets.  ALWAYS called — None clears any previous
+    # app build's override back to env/default, so two deployments in one
+    # process cannot leak targets into each other (runtime/metrics.py).
+    from ..runtime.metrics import configure_slo
+
+    configure_slo(ttft_ms=cfg.slo_ttft_ms, tpot_ms=cfg.slo_tpot_ms)
     if llm_provider is None:
         llm_provider = build_tpu_provider(cfg)
     if db is None:
@@ -496,8 +504,10 @@ def cors_middleware(origins: str):
 
 
 # paths that never start a trace: health probes and the observability
-# surface itself would otherwise churn the ring with noise
-_TRACE_SKIP = ("/health", "/metrics", "/playground", "/debug")
+# surface itself (incl. the autoscaler's ~1 Hz signal scrape) would
+# otherwise churn the ring with noise
+_TRACE_SKIP = ("/health", "/metrics", "/playground", "/debug",
+               "/admin/signals")
 
 
 def _incoming_trace(request: web.Request):
@@ -630,6 +640,7 @@ def _add_routes(app: web.Application) -> None:
     r.add_post("/v1/auth/login", auth_login)
     r.add_get("/health", health)
     r.add_get("/metrics", metrics)
+    r.add_get("/admin/signals", admin_signals)
     r.add_post("/admin/resize", resize_topology)
     r.add_post("/debug/profile", capture_profile)
     r.add_get("/debug/traces", debug_traces)
@@ -1220,6 +1231,35 @@ async def metrics(request: web.Request) -> web.Response:
                      "text/plain; version=0.0.4; charset=utf-8"},
         )
     return web.json_response(snap)
+
+
+async def admin_signals(request: web.Request) -> web.Response:
+    """The autoscaler signal feed (ISSUE 10): one coherent JSON snapshot
+    of queue depth + trend, batch occupancy, SLO window attainment,
+    goodput, and per-replica utilization + quarantine state.
+
+    This endpoint is the documented INPUT CONTRACT for the coming
+    /admin/resize control loop (README "SLO telemetry"): a scaler reads
+    it at ~1 Hz and decides dp from attainment_1m, queue trend, and
+    per-kind MFU/HBM headroom.  Read-only — unlike /admin/resize it
+    works without a configured API token (same policy as /metrics), and
+    honors the bearer gate when one is set."""
+    state = _state(request)
+    llm = state["llm"]
+    signals = getattr(llm, "signals", None)
+    if signals is None or getattr(llm, "engine", None) is None:
+        return web.json_response(
+            {"error": "no local engine (this deployment emits no "
+                      "autoscaler signals)"},
+            status=404,
+        )
+    payload = signals()
+    # serving-state bits only the app layer knows
+    payload["draining"] = bool(state.get("draining"))
+    payload["admission"] = {
+        "max_queue_depth": state["cfg"].max_queue_depth,
+    }
+    return web.json_response(payload)
 
 
 async def resize_topology(request: web.Request) -> web.Response:

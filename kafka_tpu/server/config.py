@@ -117,6 +117,18 @@ class ServingConfig:
     slow_ttft_ms: Optional[float] = None
     slow_total_ms: Optional[float] = None
     log_format: str = "text"
+    # SLO targets (README "SLO telemetry", ISSUE 10): every request is
+    # classified MET/MISSED at finalize against these; /metrics exports
+    # attainment (total/1m/5m windows) and goodput (tokens from SLO-met
+    # requests), and /admin/signals feeds them to the autoscaler.
+    #   slo_ttft_ms — time-to-first-token target (default 200, the
+    #       BASELINE north star; 0 disables the TTFT check)
+    #   slo_tpot_ms — per-output-token target (default 0 = disabled;
+    #       set it to bound decode-cadence SLOs, e.g. 50 for p99 TPOT)
+    # None here = defer to KAFKA_TPU_SLO_TTFT_MS / KAFKA_TPU_SLO_TPOT_MS
+    # (runtime/metrics.py reads them at engine construction).
+    slo_ttft_ms: Optional[float] = None
+    slo_tpot_ms: Optional[float] = None
     # server
     host: str = "0.0.0.0"
     port: int = 8000
@@ -230,6 +242,8 @@ class ServingConfig:
             trace_ring=get("TRACE_RING", cls.trace_ring, int),
             slow_ttft_ms=get("SLOW_TTFT_MS", None, float),
             slow_total_ms=get("SLOW_TOTAL_MS", None, float),
+            slo_ttft_ms=get("SLO_TTFT_MS", None, float),
+            slo_tpot_ms=get("SLO_TPOT_MS", None, float),
             log_format=get("LOG_FORMAT", cls.log_format),
             host=get("HOST", cls.host),
             port=get("PORT", cls.port, int),

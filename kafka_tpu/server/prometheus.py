@@ -76,8 +76,81 @@ class _Writer:
                 self.sample(name, quantiles[p],
                             {**(labels or {}), "quantile": q})
 
+    def histogram_family(
+        self, name: str, help_text: str,
+        rows: List[tuple],
+    ) -> None:
+        """One histogram family from StreamingHistogram snapshots
+        (ISSUE 10): true ``_bucket`` series with CUMULATIVE counts per
+        ``le`` bound (monotone by construction — the wire snapshot holds
+        non-negative per-bucket counts), a ``+Inf`` bucket equal to
+        ``_count``, and ``_sum``.  `rows` is [(labels, hist_snapshot)] —
+        all bucket series render before the sums/counts so each sample
+        NAME stays one contiguous group (exposition single-group rule,
+        enforced by the in-tree parser)."""
+        self.family(name, "histogram", help_text)
+        for labels, h in rows:
+            cum = 0
+            for le, c in zip(h["le"], h["counts"]):
+                cum += c
+                self.sample(f"{name}_bucket", cum,
+                            {**labels, "le": _fmt(le)})
+            self.sample(f"{name}_bucket", sum(h["counts"]),
+                        {**labels, "le": "+Inf"})
+        for labels, h in rows:
+            self.sample(f"{name}_sum", h["sum"], labels or None)
+        for labels, h in rows:
+            self.sample(f"{name}_count", sum(h["counts"]), labels or None)
+
     def render(self) -> str:
         return "\n".join(self.lines) + "\n"
+
+
+# histogram snapshot name -> (family, extra labels).  The three TTFT
+# phases share ONE family distinguished by the phase label, mirroring the
+# JSON breakdown section.
+_HISTOGRAM_FAMILIES = (
+    ("ttft_ms", "kafka_tpu_ttft_milliseconds",
+     "Time to first token.", {}),
+    ("tpot_ms", "kafka_tpu_tpot_milliseconds",
+     "Time per output token.", {}),
+    ("ttft_queue_ms", "kafka_tpu_ttft_phase_milliseconds",
+     "TTFT decomposition by phase.", {"phase": "queue_wait"}),
+    ("ttft_prefill_ms", "kafka_tpu_ttft_phase_milliseconds",
+     "TTFT decomposition by phase.", {"phase": "prefill"}),
+    ("ttft_fetch_ms", "kafka_tpu_ttft_phase_milliseconds",
+     "TTFT decomposition by phase.", {"phase": "first_fetch"}),
+    ("burst_tokens", "kafka_tpu_emission_burst_tokens",
+     "Tokens arriving together per emission burst.", {}),
+    ("burst_gap_ms", "kafka_tpu_emission_burst_gap_milliseconds",
+     "Gap between emission bursts.", {}),
+)
+
+
+def _render_histograms(w: "_Writer", snap: Dict[str, Any]) -> None:
+    """The latency/size histogram families: aggregate series plus one
+    replica-labeled series per DP replica (contiguous per family)."""
+    hists = snap.get("histograms") or {}
+    if not hists:
+        return
+    replica_hists = [
+        (idx, rs.get("histograms") or {})
+        for idx, rs in enumerate(snap.get("replicas") or [])
+        if rs.get("histograms")
+    ]
+    by_family: Dict[str, List[tuple]] = {}
+    help_by_family: Dict[str, str] = {}
+    for key, family, help_text, labels in _HISTOGRAM_FAMILIES:
+        if key not in hists:
+            continue
+        help_by_family[family] = help_text
+        rows = by_family.setdefault(family, [])
+        rows.append((dict(labels), hists[key]))
+        for idx, rh in replica_hists:
+            if key in rh:
+                rows.append(({**labels, "replica": idx}, rh[key]))
+    for family, rows in by_family.items():
+        w.histogram_family(family, help_by_family[family], rows)
 
 
 def render_prometheus(snap: Dict[str, Any]) -> str:
@@ -99,8 +172,15 @@ def render_prometheus(snap: Dict[str, Any]) -> str:
                  "Engine waiting-queue depth (last scheduler iteration).")
         w.sample("kafka_tpu_queue_depth", queue.get("depth", 0))
         w.family("kafka_tpu_queue_depth_peak", "gauge",
-                 "Peak waiting-queue depth since boot.")
+                 "Peak waiting-queue depth since the previous snapshot "
+                 "(each scrape re-arms the high-water mark).")
         w.sample("kafka_tpu_queue_depth_peak", queue.get("peak", 0))
+        if "trend_per_s" in queue:
+            w.family("kafka_tpu_queue_depth_trend_per_second", "gauge",
+                     "Queue-depth slope over the last minute (>0 = "
+                     "growing; an autoscaler scale-up signal).")
+            w.sample("kafka_tpu_queue_depth_trend_per_second",
+                     queue["trend_per_s"])
 
     tokens = snap.get("tokens") or {}
     if tokens:
@@ -119,15 +199,25 @@ def render_prometheus(snap: Dict[str, Any]) -> str:
         w.sample("kafka_tpu_tokens_generated_per_second",
                  tokens.get("generated_per_s", 0))
 
-    if "ttft_ms" in snap:
-        w.summary("kafka_tpu_ttft_milliseconds", snap["ttft_ms"],
-                  "Time to first token (recent window percentiles).")
-    for phase, q in (snap.get("ttft_breakdown_ms") or {}).items():
-        w.summary("kafka_tpu_ttft_phase_milliseconds", q,
-                  "TTFT decomposition by phase.", labels={"phase": phase})
-    if "tpot_ms" in snap:
-        w.summary("kafka_tpu_tpot_milliseconds", snap["tpot_ms"],
-                  "Time per output token (recent window percentiles).")
+    # Latency/size distributions: TRUE histogram families (_bucket with
+    # le labels, _sum, _count) from the streaming-histogram snapshots —
+    # cumulative since boot, mergeable in PromQL, per replica and
+    # aggregated (ISSUE 10; replaces the old summary-quantile rendering).
+    # When the snapshot predates histograms (stale client), fall back to
+    # the summary form so the endpoint never goes dark.
+    if snap.get("histograms"):
+        _render_histograms(w, snap)
+    else:
+        if "ttft_ms" in snap:
+            w.summary("kafka_tpu_ttft_milliseconds", snap["ttft_ms"],
+                      "Time to first token (percentiles).")
+        for phase, q in (snap.get("ttft_breakdown_ms") or {}).items():
+            w.summary("kafka_tpu_ttft_phase_milliseconds", q,
+                      "TTFT decomposition by phase.",
+                      labels={"phase": phase})
+        if "tpot_ms" in snap:
+            w.summary("kafka_tpu_tpot_milliseconds", snap["tpot_ms"],
+                      "Time per output token (percentiles).")
 
     decode = snap.get("decode") or {}
     if decode:
@@ -139,15 +229,154 @@ def render_prometheus(snap: Dict[str, Any]) -> str:
         w.sample("kafka_tpu_batch_occupancy",
                  decode.get("batch_occupancy", 0))
 
-    emission = snap.get("emission") or {}
-    if "burst_tokens" in emission:
-        w.summary("kafka_tpu_emission_burst_tokens",
-                  emission["burst_tokens"],
-                  "Tokens arriving together per emission burst.")
-    if "burst_gap_ms" in emission:
-        w.summary("kafka_tpu_emission_burst_gap_milliseconds",
-                  emission["burst_gap_ms"],
-                  "Gap between emission bursts.")
+    if not snap.get("histograms"):
+        emission = snap.get("emission") or {}
+        if "burst_tokens" in emission:
+            w.summary("kafka_tpu_emission_burst_tokens",
+                      emission["burst_tokens"],
+                      "Tokens arriving together per emission burst.")
+        if "burst_gap_ms" in emission:
+            w.summary("kafka_tpu_emission_burst_gap_milliseconds",
+                      emission["burst_gap_ms"],
+                      "Gap between emission bursts.")
+
+    # SLO / goodput (runtime/metrics.SLO_METRIC_KEYS — the registry a
+    # static test enforces in both files).  The autoscaler's primary
+    # inputs: attainment per window, goodput vs raw throughput.
+    slo = snap.get("slo") or {}
+    if slo:
+        w.family("kafka_tpu_slo_requests_total", "counter",
+                 "Requests by SLO verdict at finalize (timeouts, engine "
+                 "failures and 429 rejections count as missed; client "
+                 "cancels are excluded).")
+        for key, result in (("slo_met_requests", "met"),
+                            ("slo_missed_requests", "missed")):
+            if key in slo:
+                w.sample("kafka_tpu_slo_requests_total", slo[key],
+                         {"result": result})
+        w.family("kafka_tpu_slo_violations_total", "counter",
+                 "Missed-SLO attributions by violated target.")
+        for key, kind in (("slo_ttft_violations", "ttft"),
+                          ("slo_tpot_violations", "tpot")):
+            if key in slo:
+                w.sample("kafka_tpu_slo_violations_total", slo[key],
+                         {"kind": kind})
+        w.family("kafka_tpu_slo_target_milliseconds", "gauge",
+                 "Configured SLO targets (0 = target disabled).")
+        for key, kind in (("slo_ttft_target_ms", "ttft"),
+                          ("slo_tpot_target_ms", "tpot")):
+            if key in slo:
+                w.sample("kafka_tpu_slo_target_milliseconds", slo[key],
+                         {"kind": kind})
+        w.family("kafka_tpu_slo_attainment", "gauge",
+                 "Fraction of finalized requests meeting every SLO "
+                 "target, by window (1.0 when the window saw none).")
+        for key, window in (("slo_attainment", "total"),
+                            ("slo_attainment_1m", "1m"),
+                            ("slo_attainment_5m", "5m")):
+            if key in slo:
+                w.sample("kafka_tpu_slo_attainment", slo[key],
+                         {"window": window})
+        if "goodput_tokens" in slo:
+            w.family("kafka_tpu_goodput_tokens_total", "counter",
+                     "Tokens generated by SLO-met requests.")
+            w.sample("kafka_tpu_goodput_tokens_total",
+                     slo["goodput_tokens"])
+        w.family("kafka_tpu_goodput_tokens_per_second", "gauge",
+                 "Goodput rate by window (SLO-met tokens only).")
+        for key, window in (("goodput_tok_s", "total"),
+                            ("goodput_tok_s_1m", "1m")):
+            if key in slo:
+                w.sample("kafka_tpu_goodput_tokens_per_second", slo[key],
+                         {"window": window})
+        if "goodput_frac" in slo:
+            w.family("kafka_tpu_goodput_fraction", "gauge",
+                     "Goodput tokens / raw generated tokens.")
+            w.sample("kafka_tpu_goodput_fraction", slo["goodput_frac"])
+
+    # Device-utilization estimator (runtime/metrics.UTILIZATION_METRIC_
+    # KEYS), per dispatch kind; counters enable PromQL rate()-based MFU,
+    # the gauges are the ready-made since-boot and 1m ratios.  Per-replica
+    # ratio gauges ride as labeled series next to the aggregate.
+    util = snap.get("utilization") or {}
+    kinds = [k for k in ("prefill", "decode", "verify") if k in util]
+    if kinds:
+        replica_utils = [
+            (idx, rs.get("utilization") or {})
+            for idx, rs in enumerate(snap.get("replicas") or [])
+            if rs.get("utilization")
+        ]
+        w.family("kafka_tpu_dispatches_total", "counter",
+                 "Device dispatches by kind.")
+        for k in kinds:
+            w.sample("kafka_tpu_dispatches_total",
+                     util[k].get("dispatches", 0), {"kind": k})
+        w.family("kafka_tpu_dispatch_tokens_total", "counter",
+                 "Tokens processed by dispatch kind.")
+        for k in kinds:
+            w.sample("kafka_tpu_dispatch_tokens_total",
+                     util[k].get("tokens", 0), {"kind": k})
+        w.family("kafka_tpu_device_flops_total", "counter",
+                 "Modeled device FLOPs by dispatch kind (planner cost "
+                 "model).")
+        for k in kinds:
+            w.sample("kafka_tpu_device_flops_total",
+                     util[k].get("flops", 0), {"kind": k})
+        w.family("kafka_tpu_device_hbm_bytes_total", "counter",
+                 "Modeled HBM bytes moved by dispatch kind.")
+        for k in kinds:
+            w.sample("kafka_tpu_device_hbm_bytes_total",
+                     util[k].get("hbm_bytes", 0), {"kind": k})
+        w.family("kafka_tpu_dispatch_busy_seconds_total", "counter",
+                 "Wall time attributed to dispatch execution by kind.")
+        for k in kinds:
+            w.sample("kafka_tpu_dispatch_busy_seconds_total",
+                     util[k].get("busy_s", 0), {"kind": k})
+        w.family("kafka_tpu_mfu", "gauge",
+                 "Model FLOPs utilization vs the chip roofline, by "
+                 "dispatch kind and window (0 when no roofline known).")
+        for k in kinds:
+            for key, window in (("mfu", "total"), ("mfu_1m", "1m")):
+                w.sample("kafka_tpu_mfu", util[k].get(key, 0),
+                         {"kind": k, "window": window})
+        for idx, ru in replica_utils:
+            for k in kinds:
+                if k in ru:
+                    for key, window in (("mfu", "total"),
+                                        ("mfu_1m", "1m")):
+                        w.sample("kafka_tpu_mfu", ru[k].get(key, 0),
+                                 {"replica": idx, "kind": k,
+                                  "window": window})
+        w.family("kafka_tpu_hbm_bandwidth_utilization", "gauge",
+                 "HBM bandwidth utilization vs the chip roofline, by "
+                 "dispatch kind and window.")
+        for k in kinds:
+            for key, window in (("hbm_bw_util", "total"),
+                                ("hbm_bw_util_1m", "1m")):
+                w.sample("kafka_tpu_hbm_bandwidth_utilization",
+                         util[k].get(key, 0),
+                         {"kind": k, "window": window})
+        for idx, ru in replica_utils:
+            for k in kinds:
+                if k in ru:
+                    for key, window in (("hbm_bw_util", "total"),
+                                        ("hbm_bw_util_1m", "1m")):
+                        w.sample("kafka_tpu_hbm_bandwidth_utilization",
+                                 ru[k].get(key, 0),
+                                 {"replica": idx, "kind": k,
+                                  "window": window})
+        if util.get("peak_tflops"):
+            w.family("kafka_tpu_device_peak_teraflops", "gauge",
+                     "Roofline peak FLOP/s per chip (datasheet or env "
+                     "override), in TFLOP/s.")
+            w.sample("kafka_tpu_device_peak_teraflops",
+                     util["peak_tflops"])
+        if util.get("peak_hbm_gbps"):
+            w.family("kafka_tpu_device_peak_hbm_gigabytes_per_second",
+                     "gauge",
+                     "Roofline peak HBM bandwidth per chip, in GB/s.")
+            w.sample("kafka_tpu_device_peak_hbm_gigabytes_per_second",
+                     util["peak_hbm_gbps"])
 
     # constrained decoding (runtime/metrics.CONSTRAINED_METRIC_KEYS — the
     # registry a static test enforces in both files)

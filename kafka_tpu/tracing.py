@@ -12,7 +12,7 @@ Design, mirroring the two disciplines this repo already trusts:
   ``KAFKA_TPU_TRACE_RING`` entries).  Span recording is a plain
   ``list.append`` (GIL-atomic) onto the owning trace — no lock on any hot
   path; readers (`/debug/trace`, the slow-request log) take torn-tolerant
-  snapshots exactly like ``metrics._copy_samples``.
+  snapshots (retry-on-RuntimeError, same policy as runtime/metrics.py).
 * **failpoints' cross-process seam.**  The trace context serializes into
   the sandbox wire protocol (``POST /run`` carries ``{"trace": {...}}``)
   and the subprocess environment (:func:`subprocess_env`), so a
@@ -400,10 +400,15 @@ def _check_slow(trace: Trace, root: Span) -> None:
         return
     _counters["slow"] += 1
     logger.warning(
-        "slow request %s: total=%.1fms ttft=%s (thresholds: ttft=%s "
-        "total=%s)",
+        "slow request %s: total=%.1fms ttft=%s slo_met=%s (thresholds: "
+        "ttft=%s total=%s)",
         trace.request_id, total_ms,
         f"{ttft_ms:.1f}ms" if ttft_ms is not None else "n/a",
+        # the engine's SLO verdict (annotate() stamped it on the root at
+        # finalize; ISSUE 10) — a slow-log line is actionable only if it
+        # says whether the request also MISSED its SLO or merely tripped
+        # the softer slow threshold
+        root.attrs.get("slo_met"),
         _slow_ttft_ms, _slow_total_ms,
         extra={
             "trace_id": trace.trace_id,
@@ -411,6 +416,7 @@ def _check_slow(trace: Trace, root: Span) -> None:
             "slow_request": True,
             "total_ms": round(total_ms, 1),
             "ttft_ms": round(ttft_ms, 1) if ttft_ms is not None else None,
+            "slo_met": root.attrs.get("slo_met"),
             "spans": span_breakdown(trace),
         },
     )
@@ -514,6 +520,28 @@ def record_span(
         thread=threading.current_thread().name,
         pid=os.getpid(),
     ))
+
+
+def annotate(
+    ctx: Optional[TraceContext],
+    attrs: Dict[str, Any],
+) -> None:
+    """Merge attrs onto the trace's ROOT span (http.request).
+
+    The engine stamps each request's SLO verdict here at finalize
+    (ISSUE 10): slo_met / ttft_ms / tpot_ms show on the request's root
+    span in /debug/trace and ride the slow-request log's breakdown.
+    Same cost contract as record_span — None check untraced, one dict
+    update traced.  Races with finish_trace are benign (dict update)."""
+    if ctx is None:
+        return
+    trace = _traces.get(ctx.trace_id)
+    if trace is None or trace.root_id is None:
+        return
+    for s in list(trace.spans):
+        if s.span_id == trace.root_id:
+            s.attrs.update(attrs)
+            return
 
 
 def add_event(

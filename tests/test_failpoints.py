@@ -376,7 +376,14 @@ class TestWorkerRecovery:
             assert len(reasons) == 3
             # engine is servable again and accounting is clean
             deadline = time.monotonic() + 10
-            while eng.has_work and time.monotonic() < deadline:
+            # quiesce on the WORKER's route table too, not just engine
+            # state: the consumer observes its terminal event the moment
+            # call_soon_threadsafe schedules it, a beat before the worker
+            # thread reaches the route pop in _deliver — has_work alone
+            # races that last beat (a genuine leak still fails at the
+            # deadline)
+            while (eng.has_work or worker.check_routes()) \
+                    and time.monotonic() < deadline:
                 time.sleep(0.01)
             assert not eng.self_check(), eng.self_check()
             assert not worker.check_routes()
@@ -429,6 +436,11 @@ class TestWorkerRecovery:
 
                 reason = asyncio.run(go())
             assert reason in ("length", "stop")
+            # same route-pop race as above: give the worker thread its
+            # last dispatch beat before probing the table
+            deadline = time.monotonic() + 10
+            while worker.check_routes() and time.monotonic() < deadline:
+                time.sleep(0.01)
             assert not worker.check_routes()
         finally:
             worker.stop()

@@ -1,6 +1,8 @@
-"""Observability: engine counters + the /metrics endpoint (VERDICT r1 #9)."""
+"""Observability: engine counters, the streaming-histogram/SLO telemetry
+plane (ISSUE 10), and the /metrics + /admin/signals endpoints."""
 
 import asyncio
+import math
 
 import numpy as np
 import pytest
@@ -10,11 +12,110 @@ import jax.numpy as jnp
 
 from kafka_tpu.models import ModelConfig, init_params
 from kafka_tpu.runtime import EngineConfig, GenRequest, InferenceEngine
-from kafka_tpu.runtime.metrics import EngineMetrics, _percentiles
+from kafka_tpu.runtime.metrics import (
+    BURST_TOKEN_BOUNDS,
+    LATENCY_MS_BOUNDS,
+    EngineMetrics,
+    StreamingHistogram,
+    _percentiles,
+)
+
+
+def _bucket_bounds(h, value):
+    """(lo, hi] bucket enclosing `value` under the histogram's bounds."""
+    import bisect
+
+    i = bisect.bisect_left(h.bounds, value)
+    lo = h.bounds[i - 1] if i > 0 else 0.0
+    hi = h.bounds[i] if i < len(h.bounds) else float("inf")
+    return lo, hi
+
+
+class TestStreamingHistogram:
+    """Unit matrix for the fixed-bucket streaming histograms that replaced
+    the last-512-sample deques (ISSUE 10)."""
+
+    def test_bucket_boundaries_le_semantics(self):
+        h = StreamingHistogram((1.0, 2.0, 4.0))
+        for v in (0.5, 1.0, 1.5, 2.0, 4.0, 9.0):
+            h.record(v)
+        # le semantics: a value equal to a bound lands IN that bucket
+        assert h.counts == [2, 2, 1, 1]
+        assert h.count == 6
+        assert h.sum == pytest.approx(18.0)
+        assert h.max == 9.0
+
+    def test_cumulative_monotone(self):
+        h = StreamingHistogram(LATENCY_MS_BOUNDS)
+        rng = np.random.default_rng(7)
+        for v in rng.lognormal(3.0, 2.0, 500):
+            h.record(float(v))
+        cum = 0
+        for c in h.counts:
+            assert c >= 0
+            cum += c
+        assert cum == 500
+        # cumulative series is monotone by construction
+        running, prev = 0, -1
+        for c in h.counts:
+            running += c
+            assert running >= prev
+            prev = running
+
+    def test_merge_across_replicas(self):
+        a = StreamingHistogram(LATENCY_MS_BOUNDS)
+        b = StreamingHistogram(LATENCY_MS_BOUNDS)
+        for v in (1.0, 10.0, 100.0):
+            a.record(v)
+        for v in (5.0, 50.0):
+            b.record(v)
+        m = StreamingHistogram.merged([a, b])
+        assert m.count == 5
+        assert m.sum == pytest.approx(166.0)
+        assert m.max == 100.0
+        # merged counts are the element-wise sum
+        assert m.counts == [x + y for x, y in zip(a.counts, b.counts)]
+        # merging mismatched bounds must refuse, never mis-bucket
+        with pytest.raises(ValueError):
+            a.merge_from(StreamingHistogram((1.0, 2.0)))
+
+    def test_quantile_within_enclosing_bucket(self):
+        h = StreamingHistogram(LATENCY_MS_BOUNDS)
+        values = [3.0, 7.0, 20.0, 45.0, 200.0]
+        for v in values:
+            h.record(v)
+        for q, v in ((0.5, 20.0), (0.99, 200.0)):
+            lo, hi = _bucket_bounds(h, v)
+            assert lo < h.quantile(q) <= hi, (q, v, h.quantile(q))
+
+    def test_quantile_empty_and_overflow(self):
+        h = StreamingHistogram((1.0, 2.0))
+        assert h.quantile(0.5) == 0.0
+        h.record(1e9)  # +Inf bucket
+        # the overflow bucket reports the tracked max, not a made-up bound
+        assert h.quantile(0.99) == 1e9
+
+    def test_snapshot_roundtrip(self):
+        h = StreamingHistogram(BURST_TOKEN_BOUNDS)
+        for v in (1, 2, 3, 700, 2000):
+            h.record(float(v))
+        snap = h.snapshot()
+        assert snap["count"] == 5
+        assert len(snap["counts"]) == len(snap["le"]) + 1
+        back = StreamingHistogram.from_snapshot(snap)
+        assert back.counts == h.counts
+        assert back.sum == pytest.approx(h.sum)
+
+    def test_log_spacing(self):
+        ratios = [b / a for a, b in zip(LATENCY_MS_BOUNDS,
+                                        LATENCY_MS_BOUNDS[1:])]
+        assert all(r == pytest.approx(math.sqrt(2), rel=1e-4)
+                   for r in ratios)
 
 
 class TestMetricsUnit:
     def test_percentiles(self):
+        # client-side helper (bench latency arrays) — still nearest-rank
         ps = _percentiles([float(i) for i in range(1, 101)])
         assert ps["p50"] == 50.0
         assert ps["p90"] == 90.0
@@ -33,9 +134,235 @@ class TestMetricsUnit:
         assert snap["requests"]["submitted"] == 1
         assert snap["requests"]["finished"] == 1
         assert snap["tokens"]["generated"] == 1
-        assert snap["ttft_ms"]["p50"] == 50.0
+        # quantiles are bucket-derived now: within the enclosing bucket
+        lo, hi = _bucket_bounds(m.ttft_ms, 50.0)
+        assert lo < snap["ttft_ms"]["p50"] <= hi
         assert snap["decode"]["steps"] == 2
         assert snap["decode"]["batch_occupancy"] == 2.5
+        assert snap["histograms"]["ttft_ms"]["count"] == 1
+
+    def test_queue_peak_resets_per_snapshot(self):
+        """queue.peak is peak-SINCE-LAST-SNAPSHOT (ISSUE 10 satellite):
+        each scrape consumes the high-water mark and re-arms at the
+        current depth, so a boot-time burst stops dominating forever."""
+        m = EngineMetrics()
+        m.record_queue_depth(9)
+        m.record_queue_depth(2)
+        assert m.snapshot()["queue"]["peak"] == 9
+        # no new burst since: the next scrape reports the current level
+        assert m.snapshot()["queue"]["peak"] == 2
+        m.record_queue_depth(5)
+        m.record_queue_depth(3)
+        # a non-consuming read (/admin/signals) must not steal the window
+        assert m.snapshot(reset_peak=False)["queue"]["peak"] == 5
+        assert m.snapshot()["queue"]["peak"] == 5
+
+    def test_telemetry_off_keeps_slo_windows(self):
+        """KAFKA_TPU_TELEMETRY=0 disables per-dispatch recording, but the
+        SLO window gauges must keep tracking — an autoscaler reading a
+        vacuous attainment_1m=1.0 during an outage would never scale."""
+        m = EngineMetrics()
+        m.enabled = False
+        m.record_finish("timeout")
+        m.record_rejected()
+        snap = m.slo_snapshot()
+        assert snap["slo_attainment"] == 0.0
+        assert snap["slo_attainment_1m"] == 0.0
+        assert snap["slo_attainment_5m"] == 0.0
+
+
+class TestSLOAccounting:
+    def _m(self, ttft_ms=200.0, tpot_ms=0.0):
+        m = EngineMetrics()
+        m.slo_ttft_ms, m.slo_tpot_ms = ttft_ms, tpot_ms
+        return m
+
+    def test_met_and_missed_classification(self):
+        m = self._m()
+        assert m.record_finish("stop", ttft_s=0.05, tpot_s=0.01,
+                               tokens=10) is True
+        assert m.record_finish("stop", ttft_s=0.5, tpot_s=0.01,
+                               tokens=10) is False
+        snap = m.slo_snapshot()
+        assert snap["slo_met_requests"] == 1
+        assert snap["slo_missed_requests"] == 1
+        assert snap["slo_ttft_violations"] == 1
+        assert snap["slo_attainment"] == 0.5
+        # goodput counts ONLY the met request's tokens
+        assert snap["goodput_tokens"] == 10
+        assert snap["goodput_frac"] == 0.0  # no record_token calls
+
+    def test_tpot_target(self):
+        m = self._m(ttft_ms=0.0, tpot_ms=50.0)  # TTFT check disabled
+        assert m.record_finish("stop", ttft_s=9.9, tpot_s=0.01,
+                               tokens=4) is True
+        assert m.record_finish("stop", ttft_s=0.01, tpot_s=0.2,
+                               tokens=4) is False
+        assert m.slo_tpot_violations == 1
+
+    def test_timeout_and_error_always_miss(self):
+        m = self._m()
+        assert m.record_finish("timeout") is False
+        assert m.record_finish("error:engine", ttft_s=0.01,
+                               tokens=3) is False
+        snap = m.slo_snapshot()
+        assert snap["slo_missed_requests"] == 2
+        assert snap["goodput_tokens"] == 0
+        # a timeout that never produced a first token is a TTFT violation
+        assert snap["slo_ttft_violations"] >= 1
+
+    def test_cancel_excluded(self):
+        m = self._m()
+        assert m.record_finish("cancelled") is None
+        snap = m.slo_snapshot()
+        assert snap["slo_met_requests"] == 0
+        assert snap["slo_missed_requests"] == 0
+        assert m.requests_cancelled == 1
+
+    def test_rejected_counts_as_miss(self):
+        """A 429 admission rejection IS a missed SLO: shed load must show
+        as attainment loss, or the autoscaler sees overload as health."""
+        m = self._m()
+        m.record_finish("stop", ttft_s=0.01, tokens=2)
+        m.record_rejected()
+        snap = m.slo_snapshot()
+        assert snap["slo_missed_requests"] == 1
+        assert snap["slo_attainment"] == 0.5
+        assert m.requests_rejected == 1
+
+    def test_window_attainment_moves(self):
+        m = self._m()
+        for _ in range(3):
+            m.record_finish("stop", ttft_s=0.01, tokens=5)
+        m.record_finish("stop", ttft_s=0.9, tokens=5)
+        snap = m.slo_snapshot()
+        assert snap["slo_attainment_1m"] == 0.75
+        assert snap["slo_attainment_5m"] == 0.75
+        assert snap["goodput_tok_s_1m"] == pytest.approx(15 / 60.0)
+
+    def test_verdict_stamped_on_trace_root(self, engine):
+        """The SLO verdict lands on the request's http.request root span
+        at finalize (ISSUE 10): /debug/trace and the slow-request log
+        carry slo_met / slo_ttft_ms without re-deriving them."""
+        from kafka_tpu import tracing
+
+        tracing.reset()
+        tracing.configure(sample=1.0)
+        root = tracing.start_trace(name="http.request")
+        ctx = tracing.current()
+        try:
+            req = GenRequest(request_id="slo-span", prompt_ids=[4, 5, 6],
+                             max_new_tokens=3, trace=ctx)
+            engine.submit(req)
+            engine.run_to_completion()
+            assert req.slo_met is not None
+            assert root.attrs["slo_met"] == req.slo_met
+            assert root.attrs["slo_ttft_ms"] > 0
+        finally:
+            tracing.finish_trace(root)
+
+    def test_gauges_survive_failpoint_chaos(self):
+        """ISSUE 10: the gauges the autoscaler reads are chaos-tested
+        against the existing failpoint sites — an engine.step failure
+        storm must land in slo_missed (via the worker's fail-all path or
+        engine recovery), never wedge the counters, and the snapshot the
+        signal feed serves must stay coherent throughout."""
+        from kafka_tpu import failpoints
+
+        cfg = ModelConfig(name="chaos-slo", vocab_size=128, hidden_size=64,
+                          intermediate_size=128, num_layers=2, num_heads=4,
+                          num_kv_heads=2, head_dim=16, dtype="float32")
+        params = init_params(cfg, jax.random.PRNGKey(11))
+        eng = InferenceEngine(
+            cfg, params,
+            EngineConfig(max_batch=2, page_size=8, num_pages=64,
+                         max_pages_per_seq=8, prefill_buckets=(8, 16, 32)),
+            kv_dtype=jnp.float32,
+        )
+        eng.generate([1, 2, 3], max_new_tokens=2)  # compile
+        eng.submit(GenRequest(request_id="chaos-1", prompt_ids=[4, 5, 6],
+                              max_new_tokens=8))
+        # a STARTED lane is what engine recovery fail-stops (recovery
+        # deliberately re-queues WAITING requests instead)
+        while not eng.num_active:
+            eng.step()
+        failpoints.configure("engine.step", "error", "chaos", count=1)
+        try:
+            with pytest.raises(Exception):
+                eng.run_to_completion()
+        finally:
+            failpoints.clear()
+        events = eng.recover_from_failure()
+        assert any(ev.finish_reason == "error:engine" for ev in events)
+        snap = eng.metrics.snapshot(eng)
+        # the failed request is an SLO miss with an intact snapshot
+        assert snap["slo"]["slo_missed_requests"] >= 1
+        assert snap["requests"]["failed"] >= 1
+        assert snap["slo"]["slo_attainment"] < 1.0
+        assert 0.0 <= snap["slo"]["slo_attainment_1m"] <= 1.0
+        assert "utilization" in snap and "histograms" in snap
+        # and the engine still serves cleanly afterwards (gauges recover)
+        eng.metrics.slo_ttft_ms = 10_000.0
+        r2 = eng.generate([7, 8, 9], max_new_tokens=2)
+        assert r2.slo_met is True
+
+    def test_roofline_survives_metrics_reset(self, monkeypatch):
+        """Warmup/bench swap in fresh EngineMetrics objects; a known
+        roofline (datasheet or env override) must be re-applied by the
+        engine's cost recording, or MFU would flatline at 0 forever on
+        the default (warmup=True) server path."""
+        monkeypatch.setenv("KAFKA_TPU_PEAK_TFLOPS", "100")
+        monkeypatch.setenv("KAFKA_TPU_PEAK_HBM_GBPS", "800")
+        cfg = ModelConfig(name="roof-test", vocab_size=128, hidden_size=64,
+                          intermediate_size=128, num_layers=2, num_heads=4,
+                          num_kv_heads=2, head_dim=16, dtype="float32")
+        params = init_params(cfg, jax.random.PRNGKey(12))
+        eng = InferenceEngine(
+            cfg, params,
+            EngineConfig(max_batch=2, page_size=8, num_pages=64,
+                         max_pages_per_seq=8, prefill_buckets=(8, 16, 32)),
+            kv_dtype=jnp.float32,
+        )
+        assert eng.metrics.peak_source == "env"
+        eng.metrics = EngineMetrics()  # the warmup-reset pattern
+        assert eng.metrics.peak_source == "unknown"
+        eng.generate([1, 2, 3], max_new_tokens=3)
+        assert eng.metrics.peak_source == "env"
+        assert eng.metrics.peak_flops == pytest.approx(100e12)
+        snap = eng.metrics.snapshot(eng)
+        assert snap["utilization"]["peak_tflops"] == 100.0
+
+    def test_engine_deadline_timeout_is_slo_miss(self):
+        """End-to-end: a request expiring its TTFT deadline finalizes as
+        an SLO miss through the engine path (ISSUE 10 satellite)."""
+        cfg = ModelConfig(name="slo-test", vocab_size=128, hidden_size=64,
+                          intermediate_size=128, num_layers=2, num_heads=4,
+                          num_kv_heads=2, head_dim=16, dtype="float32")
+        params = init_params(cfg, jax.random.PRNGKey(9))
+        eng = InferenceEngine(
+            cfg, params,
+            EngineConfig(max_batch=2, page_size=8, num_pages=64,
+                         max_pages_per_seq=8, prefill_buckets=(8, 16, 32)),
+            kv_dtype=jnp.float32,
+        )
+        eng.generate([1, 2, 3], max_new_tokens=2)  # compile
+        met0 = eng.metrics.slo_met_requests
+        # deadline 0: expired by the first _check_deadlines sweep
+        req = GenRequest(request_id="slo-dl", prompt_ids=[4, 5, 6],
+                         max_new_tokens=4, deadline_ttft_s=0.0)
+        eng.submit(req)
+        eng.run_to_completion()
+        assert req.finish_reason == "timeout"
+        assert req.slo_met is False
+        assert eng.metrics.slo_missed_requests >= 1
+        assert eng.metrics.slo_met_requests == met0
+        # a clean request on the same engine is MET with goodput (target
+        # widened so a loaded CI host can't flake the verdict)
+        eng.metrics.slo_ttft_ms = 10_000.0
+        good0 = eng.metrics.goodput_tokens
+        r2 = eng.generate([7, 8, 9], max_new_tokens=3)
+        assert r2.slo_met is True
+        assert eng.metrics.goodput_tokens == good0 + len(r2.output_ids)
 
 
 @pytest.fixture(scope="module")
@@ -53,24 +380,41 @@ def engine():
 
 
 class TestTTFTBreakdown:
-    def test_phases_sum_to_ttft_and_export(self, engine):
+    def test_phases_recorded_and_exported(self, engine):
+        engine.metrics = EngineMetrics()  # phase-local histograms
         req = engine.generate([5, 9, 23, 4], max_new_tokens=4)
         assert req.t_prefill_start is not None
         assert req.t_first_dispatch is not None
         snap = engine.metrics.snapshot(engine)
         bd = snap["ttft_breakdown_ms"]
         assert set(bd) == {"queue_wait", "prefill", "first_fetch"}
-        # the three phases reassemble the recorded TTFT exactly (all four
-        # numbers derive from the same stamps; single request -> p50 is
-        # that request) — catches unit mismatches and swapped stamps
-        total = bd["queue_wait"]["p50"] + bd["prefill"]["p50"] \
-            + bd["first_fetch"]["p50"]
-        assert total == pytest.approx(snap["ttft_ms"]["p50"], abs=0.05)
+        # bucket-derived quantiles: each phase histogram recorded exactly
+        # one sample whose TRUE value comes from the request's stamps —
+        # the reported p50 must land in that sample's enclosing bucket
+        # (catches unit mismatches and swapped stamps at bucket precision)
+        truths = {
+            "queue_wait": (req.t_prefill_start - req.submit_time) * 1e3,
+            "prefill": (req.t_first_dispatch - req.t_prefill_start) * 1e3,
+            "first_fetch": (req.first_token_time
+                            - req.t_first_dispatch) * 1e3,
+        }
+        for phase, truth in truths.items():
+            lo, hi = _bucket_bounds(engine.metrics.ttft_queue_ms,
+                                    max(truth, 1e-6))
+            # + slack: the JSON export rounds to 2 decimals, which can
+            # nudge a value sitting exactly on the bucket bound past it
+            assert lo < bd[phase]["p50"] <= hi + max(0.01, hi * 1e-5), (
+                phase, truth, bd[phase]
+            )
+        # and the sum/count invariants hold per histogram
+        for name in ("ttft_queue_ms", "ttft_prefill_ms", "ttft_fetch_ms"):
+            h = snap["histograms"][name]
+            assert h["count"] == sum(h["counts"]) >= 1
 
     def test_missing_stamp_records_nothing(self):
         m = EngineMetrics()
         m.record_ttft_breakdown(1.0, None, 2.0, 3.0)
-        assert len(m.ttft_queue_ms) == 0
+        assert m.ttft_queue_ms.count == 0
 
     def test_forced_grammar_chains_without_roundtrips(self, engine):
         """A fully-forced grammar (singleton masks) never awaits a round
@@ -116,7 +460,22 @@ class TestEngineRecording:
         assert snap["engine"]["pages_total"] == 64
         assert snap["prefix_cache"]["entries"] == 3
         assert snap["engine"]["rtt_est_ms"] >= 0
-        assert snap["emission"]["burst_tokens"]["p50"] >= 1
+        # bucket-derived p50 interpolates from 0 inside the lowest (0,1]
+        # bucket when every burst is a single token (histogram_quantile
+        # semantics), so the honest floor is >0, not >=1
+        assert snap["emission"]["burst_tokens"]["p50"] > 0
+        assert engine.metrics.burst_tokens.max >= 1
+        # utilization estimator moved (ISSUE 10): real dispatches ran, so
+        # the cost model accumulated flops/bytes against busy wall time
+        util = snap["utilization"]
+        assert util["decode"]["dispatches"] > 0
+        assert util["decode"]["flops"] > 0
+        assert util["prefill"]["tokens"] >= 27  # 3 x 9-token prompts
+        assert util["decode"]["busy_s"] > 0
+        # SLO verdicts were classified for every finished request
+        slo = snap["slo"]
+        assert (slo["slo_met_requests"] + slo["slo_missed_requests"]
+                >= 3)
 
     def test_solo_stream_emits_smoothly(self):
         """VERDICT r2 #7: a lone interactive stream must not receive its
@@ -148,8 +507,8 @@ class TestEngineRecording:
             eng.metrics = EngineMetrics()
             eng.generate(list(range(1, 9)), max_new_tokens=40)
             snap = eng.metrics.snapshot(eng)
-            last = (len(eng.metrics.burst_tokens),
-                    max(eng.metrics.burst_tokens),
+            last = (eng.metrics.burst_tokens.count,
+                    eng.metrics.burst_tokens.max,
                     snap["emission"]["burst_gap_ms"]["p50"])
             if last[0] >= 3 and last[1] <= 30 and last[2] < 100:
                 break
@@ -180,7 +539,10 @@ class TestEngineRecording:
         m = EngineMetrics()
         m.record_emit_burst(3)
         m.record_emit_burst(1)
-        assert m.snapshot()["emission"]["burst_tokens"]["p99"] == 3.0
+        # bucket-derived: the p99 lands in 3's enclosing (2, 4] bucket
+        p99 = m.snapshot()["emission"]["burst_tokens"]["p99"]
+        assert 2.0 < p99 <= 4.0
+        assert m.burst_tokens.max == 3.0
 
 
 class TestMetricsEndpoint:
@@ -232,6 +594,39 @@ class TestMetricsEndpoint:
                 snap = await r.json()
                 assert "ttft_ms" in snap and "engine" in snap
                 assert snap["engine"]["pages_total"] == 64
+                # the JSON snapshot carries the full telemetry plane
+                assert "slo" in snap and "utilization" in snap
+                assert "histograms" in snap
+
+                # /admin/signals: the autoscaler input contract (ISSUE 10)
+                s = await client.get("/admin/signals")
+                assert s.status == 200
+                sig = await s.json()
+                assert sig["version"] == 1
+                assert sig["dp"] == 1
+                assert set(sig["queue"]) >= {"depth", "peak",
+                                             "trend_per_s"}
+                assert set(sig["batch"]) >= {"occupancy", "active",
+                                             "max_batch", "slots_total"}
+                for key in ("slo_attainment_1m", "slo_attainment_5m",
+                            "goodput_tok_s", "slo_ttft_target_ms"):
+                    assert key in sig["slo"], key
+                # raw window sections stay internal to /metrics
+                assert not any(k.startswith("window_")
+                               for k in sig["slo"])
+                assert set(sig["utilization"]) >= {"prefill", "decode",
+                                                   "verify"}
+                rep = sig["replicas"][0]
+                assert rep["replica"] == 0
+                assert rep["state"] == "healthy"
+                for key in ("active", "waiting", "pages_free",
+                            "pages_total", "utilization"):
+                    assert key in rep, key
+                assert set(rep["utilization"]["decode"]) == {
+                    "mfu", "mfu_1m", "hbm_bw_util", "hbm_bw_util_1m"
+                }
+                assert sig["draining"] is False
+                assert sig["admission"]["max_queue_depth"] == 256
             finally:
                 await client.close()
                 provider.worker.stop()

@@ -1,9 +1,13 @@
-"""Prometheus text exposition (ISSUE 3 satellite): a minimal format
-parser validates /metrics?format=prometheus output — TYPE lines present
-for every family, no duplicate series, values parse, labels escape — so
-the endpoint stays scrapeable as metrics evolve."""
+"""Prometheus text exposition (ISSUE 3 satellite, histogram families +
+SLO registry ISSUE 10): a minimal format parser validates
+/metrics?format=prometheus output — TYPE lines present for every family,
+no duplicate series, values parse, labels escape, histogram families
+carry ordered le buckets with +Inf and consistent sum/count — so the
+endpoint stays scrapeable as metrics evolve."""
 
 import asyncio
+import dataclasses
+import os
 import re
 
 import pytest
@@ -56,8 +60,20 @@ def parse_exposition(text: str):
         assert key not in seen, f"duplicate series: {key}"
         seen.add(key)
         # every sample belongs to a TYPEd family (summary samples share
-        # the family's base name in the classic text format)
-        assert name in families, f"sample {name} has no TYPE line"
+        # the family's base name; histogram samples carry the _bucket/
+        # _sum/_count suffixes of a histogram-typed base family)
+        base = name
+        if name not in families:
+            for suffix in ("_bucket", "_sum", "_count"):
+                stem = name[: -len(suffix)] if name.endswith(suffix) \
+                    else None
+                if stem and stem in families:
+                    assert families[stem] == "histogram", (
+                        f"{name} suffix on non-histogram family {stem}"
+                    )
+                    base = stem
+                    break
+        assert base in families, f"sample {name} has no TYPE line"
         # all samples of one family must form a single contiguous group
         if name != current:
             assert name not in closed, f"non-contiguous family: {name}"
@@ -66,6 +82,40 @@ def parse_exposition(text: str):
             current = name
         samples.append((name, labels, float(value)))
     return families, samples
+
+
+def validate_histogram_family(families, samples, family):
+    """Histogram-family invariants (ISSUE 10): per labelset, le bounds
+    strictly increase and end at +Inf, cumulative bucket counts are
+    monotone, the +Inf bucket equals _count, and _sum exists."""
+    assert families.get(family) == "histogram", family
+    by = {(n, tuple(sorted(l.items()))): v for n, l, v in samples}
+    groups = {}
+    for n, labels, v in samples:
+        if n == f"{family}_bucket":
+            key = tuple(sorted(
+                (k, lv) for k, lv in labels.items() if k != "le"
+            ))
+            groups.setdefault(key, []).append((labels["le"], v))
+    assert groups, f"no _bucket series for {family}"
+    for key, rows in groups.items():
+        les = [le for le, _ in rows]
+        assert les[-1] == "+Inf", f"{family}{dict(key)}: no +Inf bucket"
+        finite = [float(le) for le in les[:-1]]
+        assert finite == sorted(finite) and len(set(finite)) == len(
+            finite
+        ), f"{family}{dict(key)}: le bounds not strictly increasing"
+        counts = [v for _, v in rows]
+        assert counts == sorted(counts), (
+            f"{family}{dict(key)}: bucket counts not monotone"
+        )
+        assert by[(f"{family}_count", key)] == counts[-1], (
+            f"{family}{dict(key)}: +Inf bucket != _count"
+        )
+        assert (f"{family}_sum", key) in by, (
+            f"{family}{dict(key)}: missing _sum"
+        )
+    return groups
 
 
 def populated_snapshot():
@@ -78,10 +128,12 @@ def populated_snapshot():
     m.record_decode_step(2)
     m.record_emit_burst(3)
     m.record_emit_burst(2)
-    m.record_finish("stop")
+    m.record_finish("stop", ttft_s=0.05, tokens=5)  # SLO met -> goodput
     m.record_finish("timeout")
     m.record_rejected()
     m.record_queue_depth(4)
+    m.record_dispatch_cost("decode", 3, 1e9, 2e9)
+    m.record_dispatch_cost("decode", 3, 1e9, 2e9)
     snap = m.snapshot()
     snap["requests"]["slow"] = 1
     snap["sandbox"] = {"crashes": 2, "restarts": 1, "crash_loops": 0,
@@ -107,8 +159,6 @@ class TestRenderer:
             "kafka_tpu_requests_total",
             "kafka_tpu_queue_depth",
             "kafka_tpu_tokens_total",
-            "kafka_tpu_ttft_milliseconds",
-            "kafka_tpu_tpot_milliseconds",
             "kafka_tpu_decode_steps_total",
             "kafka_tpu_batch_occupancy",
             "kafka_tpu_sandbox_total",
@@ -119,13 +169,21 @@ class TestRenderer:
             "kafka_tpu_prefix_cache_nodes",
             "kafka_tpu_prefix_cache_pages",
             "kafka_tpu_prefix_cache_total",
+            # SLO telemetry plane (ISSUE 10)
+            "kafka_tpu_slo_requests_total",
+            "kafka_tpu_goodput_tokens_total",
+            "kafka_tpu_queue_depth_trend_per_second",
+            "kafka_tpu_mfu",
         ):
             assert expected in names, expected
         assert families["kafka_tpu_requests_total"] == "counter"
-        assert families["kafka_tpu_ttft_milliseconds"] == "summary"
+        # the latency families are TRUE histograms now (ISSUE 10)
+        assert families["kafka_tpu_ttft_milliseconds"] == "histogram"
+        assert families["kafka_tpu_tpot_milliseconds"] == "histogram"
+        assert "kafka_tpu_ttft_milliseconds_bucket" in names
 
-    def test_counter_values_and_quantiles(self):
-        _, samples = parse_exposition(
+    def test_counter_values_and_histograms(self):
+        families, samples = parse_exposition(
             render_prometheus(populated_snapshot())
         )
         by = {(n, tuple(sorted(l.items()))): v for n, l, v in samples}
@@ -137,8 +195,8 @@ class TestRenderer:
                    (("state", "slow"),))] == 1
         assert by[("kafka_tpu_tokens_total",
                    (("kind", "generated"),))] == 5
-        assert by[("kafka_tpu_ttft_milliseconds",
-                   (("quantile", "0.5"),))] == 50.0
+        assert by[("kafka_tpu_ttft_milliseconds_count", ())] == 1
+        assert by[("kafka_tpu_ttft_milliseconds_sum", ())] == 50.0
         assert by[("kafka_tpu_queue_depth", ())] == 4
         assert by[("kafka_tpu_stitched_spans_total", ())] == 3
         assert by[("kafka_tpu_prefix_cache_total",
@@ -147,6 +205,13 @@ class TestRenderer:
                    (("kind", "evictions"),))] == 1
         assert by[("kafka_tpu_prefix_cache_pages", ())] == 11
         assert by[("kafka_tpu_prefix_cache_nodes", ())] == 3
+        # SLO families carry the verdicts populated_snapshot recorded
+        assert by[("kafka_tpu_slo_requests_total",
+                   (("result", "met"),))] == 1
+        # timeout + rejection both count as missed
+        assert by[("kafka_tpu_slo_requests_total",
+                   (("result", "missed"),))] == 2
+        assert by[("kafka_tpu_goodput_tokens_total", ())] == 5
 
     def test_dp_aggregate_snapshot_renders(self):
         """The renderer must also swallow the DP aggregate shape (extra
@@ -232,6 +297,198 @@ class TestRenderer:
         from kafka_tpu.server.prometheus import _escape
 
         assert _escape('a"b\\c\nd') == 'a\\"b\\\\c\\nd'
+
+
+class TestHistogramExposition:
+    """ISSUE 10: the latency/size families are true histograms — the
+    parser extension validates le ordering, +Inf, monotone cumulative
+    counts, and sum/count consistency."""
+
+    def test_all_histogram_families_valid(self):
+        families, samples = parse_exposition(
+            render_prometheus(populated_snapshot())
+        )
+        for family in ("kafka_tpu_ttft_milliseconds",
+                       "kafka_tpu_tpot_milliseconds",
+                       "kafka_tpu_ttft_phase_milliseconds",
+                       "kafka_tpu_emission_burst_tokens",
+                       "kafka_tpu_emission_burst_gap_milliseconds"):
+            validate_histogram_family(families, samples, family)
+
+    def test_phase_family_one_series_per_phase(self):
+        families, samples = parse_exposition(
+            render_prometheus(populated_snapshot())
+        )
+        groups = validate_histogram_family(
+            families, samples, "kafka_tpu_ttft_phase_milliseconds"
+        )
+        phases = {dict(k)["phase"] for k in groups}
+        assert phases == {"queue_wait", "prefill", "first_fetch"}
+
+    def test_per_replica_histogram_series(self):
+        """DP aggregates export each replica's histograms as labeled
+        series (replica="<i>") alongside the merged aggregate, contiguous
+        per family (exposition single-group rule)."""
+        from kafka_tpu.runtime.metrics import StreamingHistogram
+
+        snap = populated_snapshot()
+        r0 = EngineMetrics()
+        r0.record_first_token(0.01)
+        r0.record_first_token(0.02)
+        rep_snap = {"histograms": r0.histograms_snapshot()}
+        snap["dp"] = 2
+        snap["replicas"] = [rep_snap, {}]  # replica 1: no detail
+        families, samples = parse_exposition(render_prometheus(snap))
+        groups = validate_histogram_family(
+            families, samples, "kafka_tpu_ttft_milliseconds"
+        )
+        assert () in groups  # aggregate
+        assert (("replica", "0"),) in groups
+        assert (("replica", "1"),) not in groups
+        by = {(n, tuple(sorted(l.items()))): v for n, l, v in samples}
+        assert by[("kafka_tpu_ttft_milliseconds_count",
+                   (("replica", "0"),))] == 2
+
+    def test_aggregate_merge_equals_sum(self):
+        """The DP aggregate's merged histogram is the bucket-wise sum of
+        the replica histograms — the mergeability the deques could never
+        offer."""
+        from kafka_tpu.runtime.metrics import (
+            LATENCY_MS_BOUNDS,
+            StreamingHistogram,
+        )
+
+        a, b = EngineMetrics(), EngineMetrics()
+        for v in (0.01, 0.05, 0.4):
+            a.record_first_token(v)
+        for v in (0.02, 0.8):
+            b.record_first_token(v)
+        merged = StreamingHistogram.merged([a.ttft_ms, b.ttft_ms])
+        assert merged.count == 5
+        assert merged.counts == [
+            x + y for x, y in zip(a.ttft_ms.counts, b.ttft_ms.counts)
+        ]
+
+    def test_utilization_families_render(self):
+        m = EngineMetrics()
+        m.set_roofline(100e12, 800e9, "env")
+        m.record_dispatch_cost("prefill", 128, 5e12, 1e10)
+        m.record_dispatch_cost("decode", 8, 1e12, 8e9)
+        m.record_dispatch_cost("decode", 8, 1e12, 8e9)
+        families, samples = parse_exposition(render_prometheus(
+            m.snapshot()
+        ))
+        by = {(n, tuple(sorted(l.items()))): v for n, l, v in samples}
+        assert families["kafka_tpu_device_flops_total"] == "counter"
+        assert families["kafka_tpu_mfu"] == "gauge"
+        # the first two dispatches have been attributed (gap to the next
+        # record); the in-flight last one has not
+        assert by[("kafka_tpu_dispatches_total",
+                   (("kind", "prefill"),))] == 1
+        assert by[("kafka_tpu_device_flops_total",
+                   (("kind", "prefill"),))] == 5e12
+        assert by[("kafka_tpu_device_peak_teraflops", ())] == 100.0
+        # synthetic costs over microsecond gaps produce MFU >> 1; only
+        # presence/shape is asserted here (real ratios are engine-tested)
+        assert by[("kafka_tpu_mfu",
+                   (("kind", "prefill"), ("window", "total")))] >= 0
+        assert ("kafka_tpu_mfu",
+                (("kind", "decode"), ("window", "1m"))) in by
+
+
+class TestSLORegistry:
+    """ISSUE 10 satellite: SLO_METRIC_KEYS and UTILIZATION_METRIC_KEYS
+    are both-directions registries across runtime/metrics.py and
+    server/prometheus.py, and every EngineMetrics field is either
+    exported or on the explicit exclusion list."""
+
+    def _source(self, relpath):
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        with open(os.path.join(root, "kafka_tpu", relpath)) as f:
+            return f.read()
+
+    def test_registry_both_directions(self):
+        from kafka_tpu.runtime.metrics import (
+            SLO_METRIC_KEYS,
+            UTILIZATION_METRIC_KEYS,
+        )
+
+        metrics_src = self._source("runtime/metrics.py")
+        prom_src = self._source("server/prometheus.py")
+        for key in SLO_METRIC_KEYS + UTILIZATION_METRIC_KEYS:
+            assert f'"{key}"' in metrics_src, (
+                f"{key} missing from runtime/metrics.py"
+            )
+            assert f'"{key}"' in prom_src, (
+                f"{key} missing from server/prometheus.py"
+            )
+
+    def test_no_unregistered_slo_metrics(self):
+        """Neither file invents slo_*/goodput_* names outside the
+        registry (the invent-proof direction)."""
+        from kafka_tpu.runtime.metrics import SLO_METRIC_KEYS
+
+        pattern = re.compile(r'"((?:slo|goodput)_[a-z0-9_]+)"')
+        allowed = set(SLO_METRIC_KEYS) | {
+            # request-local span attrs / config knobs, not metric keys
+            "slo_met", "slo_ttft_ms", "slo_tpot_ms",
+        }
+        for rel in ("runtime/metrics.py", "server/prometheus.py"):
+            for name in pattern.findall(self._source(rel)):
+                assert name in allowed, f"{name} in {rel} not registered"
+
+    def test_slo_snapshot_matches_registry(self):
+        from kafka_tpu.runtime.metrics import SLO_METRIC_KEYS
+
+        snap = EngineMetrics().slo_snapshot()
+        flat = {k for k in snap if not k.startswith("window_")}
+        assert flat == set(SLO_METRIC_KEYS)
+
+    def test_utilization_snapshot_matches_registry(self):
+        from kafka_tpu.runtime.metrics import (
+            UTILIZATION_KINDS,
+            UTILIZATION_METRIC_KEYS,
+        )
+
+        m = EngineMetrics()
+        m.record_dispatch_cost("decode", 1, 1.0, 1.0)
+        m.record_dispatch_cost("decode", 1, 1.0, 1.0)
+        snap = m.utilization_snapshot()
+        for kind in UTILIZATION_KINDS:
+            keys = {k for k in snap[kind]
+                    if not k.startswith(("window_", "achieved_"))}
+            assert keys == set(UTILIZATION_METRIC_KEYS), kind
+
+    def test_every_engine_metrics_field_accounted(self):
+        """Lint (ISSUE 10 satellite): a new EngineMetrics counter must be
+        wired into the exposition (ENGINE_METRIC_EXPORTS, with its
+        snapshot path verified live) or explicitly excluded with a reason
+        — silent drops from /metrics are a test failure now."""
+        from kafka_tpu.runtime.metrics import (
+            ENGINE_METRIC_EXCLUDED,
+            ENGINE_METRIC_EXPORTS,
+        )
+
+        fields = {f.name for f in dataclasses.fields(EngineMetrics)}
+        exported = set(ENGINE_METRIC_EXPORTS)
+        excluded = set(ENGINE_METRIC_EXCLUDED)
+        assert not exported & excluded, exported & excluded
+        missing = fields - exported - excluded
+        assert not missing, (
+            f"EngineMetrics fields neither exported nor excluded: "
+            f"{sorted(missing)}"
+        )
+        stale = (exported | excluded) - fields
+        assert not stale, f"registry names without fields: {sorted(stale)}"
+        # every declared export path resolves in a live snapshot
+        snap = EngineMetrics().snapshot()
+        for field, path in ENGINE_METRIC_EXPORTS.items():
+            node = snap
+            for part in path:
+                assert part in node, (
+                    f"{field}: snapshot path {path} broken at {part!r}"
+                )
+                node = node[part]
 
 
 class TestPrometheusHTTP:
