@@ -194,6 +194,44 @@ def telemetry_overhead_phase(engine, cfg, args, rng) -> dict:
     }
 
 
+def flight_overhead_phase(engine, cfg, args, rng) -> dict:
+    """Decode tok/s with the flight recorder ON vs OFF (ISSUE 11
+    acceptance: recorder cost within noise).  The recorder is pure host
+    bookkeeping on an unchanged set of compiled programs, so the SAME
+    engine runs the same workload with `engine.flight` attached vs
+    detached — interleaved best-of-3, mirroring telemetry_overhead_phase
+    (sub-1% comparisons need the noise discipline)."""
+    from kafka_tpu.runtime.flight_recorder import FlightRecorder
+    from kafka_tpu.runtime.metrics import EngineMetrics
+
+    saved_flight = engine.flight
+    gen = 48 if args.quick else 192
+    batch = min(args.batch, 8)
+    tps = {"on": [], "off": []}
+    try:
+        for _round in range(3):
+            for mode in ("off", "on"):
+                engine.flight = (
+                    FlightRecorder(256) if mode == "on" else None
+                )
+                engine.metrics = EngineMetrics()
+                t, _ = decode_phase(engine, cfg, batch,
+                                    args.prompt_len // 2, gen, rng)
+                tps[mode].append(t)
+    finally:
+        engine.flight = saved_flight
+        engine.metrics = EngineMetrics()
+    on, off = max(tps["on"]), max(tps["off"])
+    return {
+        "tok_s_on": round(on, 1),
+        "tok_s_off": round(off, 1),
+        "regression_frac": round(max(0.0, 1 - on / off), 4) if off else 0.0,
+        "note": ("same engine/programs, interleaved runs, best-of-3 per "
+                 "mode; regression_frac is the flight recorder's decode "
+                 "throughput cost (acceptance: within noise, <= 0.01)"),
+    }
+
+
 def shared_prefix_phase(cfg, params, n_threads: int, common_len: int,
                         suffix_len: int, gen_len: int,
                         page_size: int = 16, seed: int = 11) -> dict:
@@ -1649,6 +1687,12 @@ def main() -> None:
         f"{telemetry['tok_s_off']} tok/s "
         f"({100 * telemetry['regression_frac']:.2f}% regression)")
 
+    # ---- flight-recorder overhead A/B (ISSUE 11: within noise) ----------
+    flight = flight_overhead_phase(engine, cfg, args, rng)
+    log(f"flight recorder overhead: on {flight['tok_s_on']} vs off "
+        f"{flight['tok_s_off']} tok/s "
+        f"({100 * flight['regression_frac']:.2f}% regression)")
+
     # ---- served path: HTTP/SSE through the real app (VERDICT r3 #1) -----
     if args.no_serve:
         served = {}
@@ -1720,6 +1764,7 @@ def main() -> None:
                 "queue": snap["queue"],
             },
             "telemetry_overhead": telemetry,
+            "flight_overhead": flight,
             "concurrent_slo": concurrent_slo,
             "server_path": served.get("server_path"),
             "agent_path": served.get("agent_path"),

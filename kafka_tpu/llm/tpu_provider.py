@@ -237,9 +237,15 @@ class TPULLMProvider(LLMProvider):
         backstop counts its own; without this, sustained overload — where
         the gate catches nearly everything — would show ~0 rejections).
         A rejection is also an SLO miss (metrics.record_rejected), so the
-        attainment gauges see shed load.  Cross-thread int increment:
-        GIL-atomic enough for a counter."""
-        self._replicas()[0].metrics.record_rejected()
+        attainment gauges see shed load, and a flight-recorder "reject"
+        cause (drained into the next ring record), so an overload
+        burst's postmortem shows the shed traffic.  Cross-thread int
+        increment: GIL-atomic enough for a counter."""
+        replica = self._replicas()[0]
+        replica.metrics.record_rejected()
+        flight = getattr(replica, "flight", None)
+        if flight is not None:
+            flight.note_gate_reject()
 
     def signals(self) -> Dict[str, Any]:
         """One coherent autoscaler-input snapshot (GET /admin/signals,
@@ -262,6 +268,17 @@ class TPULLMProvider(LLMProvider):
         * ``replicas``: per-replica health state (quarantined replicas
           are capacity the router cannot use), load, KV-page headroom,
           and utilization.
+        * ``anomalies`` (version 2, ISSUE 11): the flight recorder's
+          step-cadence detector state — edge-triggered firing counters
+          plus the CURRENTLY-ACTIVE list (queue stall, fetch-pipeline
+          starvation, MFU collapse, prefill convoy), each active entry
+          naming the replica it fires on.  This is the "something is
+          wrong, don't scale on stale math" input: while any anomaly is
+          active the utilization/attainment numbers describe a sick
+          replica, and a controller must hold rather than resize on
+          them.  The ``utilization`` section also carries the measured
+          dispatch timing (``measured_busy_s``/``modeled_busy_s``/
+          ``model_skew``) calibrating the modeled MFU/HBM-BW figures.
 
         Everything is read torn-tolerantly from the engine thread's
         single-writer metrics; no locks, safe at scrape frequency.
@@ -291,6 +308,9 @@ class TPULLMProvider(LLMProvider):
                 "batch_occupancy": rs.get("decode", {}).get(
                     "batch_occupancy", 0.0
                 ),
+                "anomalies_active": (rs.get("anomalies") or {}).get(
+                    "anomalies_active", 0
+                ),
                 "utilization": {
                     kind: {
                         "mfu": util.get(kind, {}).get("mfu", 0.0),
@@ -301,14 +321,34 @@ class TPULLMProvider(LLMProvider):
                         "hbm_bw_util_1m": util.get(kind, {}).get(
                             "hbm_bw_util_1m", 0.0
                         ),
+                        # measured/modeled calibration (ISSUE 11): >1 =
+                        # this replica runs slower than the cost model
+                        # assumes, so its MFU figures read high
+                        "model_skew": util.get(kind, {}).get(
+                            "model_skew", 0.0
+                        ),
                     }
                     for kind in ("prefill", "decode", "verify")
                 },
             })
+        # anomalies: the aggregate section already attributes active
+        # entries to replicas (dp); a single engine's lacks the field —
+        # stamp replica 0 so the contract shape is dp-independent
+        anomalies = dict(snap.get("anomalies") or {})
+        if anomalies.get("active"):
+            anomalies["active"] = [
+                {**a, "replica": a.get("replica", 0)}
+                for a in anomalies["active"]
+            ]
         return {
-            "version": 1,
+            # version 2 (ISSUE 11): + anomalies section, per-replica
+            # anomalies_active, measured-utilization fields under
+            # utilization.* (measured_busy_s / modeled_busy_s /
+            # model_skew / measured_dispatches)
+            "version": 2,
             "dp": len(replicas),
             "queue": dict(snap.get("queue") or {}),
+            "anomalies": anomalies,
             "batch": {
                 "occupancy": occupancy,
                 "occupancy_frac": round(occupancy / max_batch, 4)

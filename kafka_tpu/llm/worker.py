@@ -261,6 +261,16 @@ class EngineWorker:
 
     def _fail_all(self):
         """Device-step failure: every in-flight request gets a terminal event."""
+        # recovery itself died: the flight recorder's ring is the only
+        # artifact that will explain this engine — dump it before the
+        # cancel sweep rewrites the lane table (best-effort, ISSUE 11)
+        for e in getattr(self.engine, "engines", [self.engine]):
+            try:
+                dump = getattr(e, "dump_postmortem", None)
+                if dump is not None:
+                    dump("recovery_failed")
+            except Exception:  # pragma: no cover - defensive
+                logger.exception("recovery-failure postmortem dump failed")
         events = []
         for rid in list(self.engine._requests):
             req = self.engine._requests.get(rid)

@@ -9,10 +9,12 @@ from .engine import (
     TokenEvent,
 )
 from .failpoints import FailpointError
+from .flight_recorder import FlightRecorder
 from .kv_cache import OutOfPagesError, PagePool, SequencePages, TRASH_PAGE
 from .kv_tier import KVTierManager, LocalPageShipper, PageShipper
 
 __all__ = [
+    "FlightRecorder",
     "KVTierManager",
     "LocalPageShipper",
     "PageShipper",

@@ -172,6 +172,9 @@ class DataParallelEngines:
             )
             # traced requests' engine spans carry the replica they ran on
             engine.replica = r
+            if engine.flight is not None:
+                # postmortems and /debug/flight/{replica} name the replica
+                engine.flight.replica = r
             engines.append(engine)
         self.dp = dp
         self.engines = engines
@@ -256,6 +259,14 @@ class DataParallelEngines:
                 "replica %d quarantined for %.1fs after %d failure(s) "
                 "(trip #%d)", i, window, threshold, h.quarantine_count,
             )
+            # black box out the door while the evidence is fresh: the
+            # quarantined replica's ring + lane table explain the step
+            # sequence that tripped the breaker (ISSUE 11; best-effort,
+            # a dump failure must never mask the quarantine itself)
+            try:
+                self.engines[i].dump_postmortem("quarantine")
+            except Exception:  # pragma: no cover - defensive
+                logger.exception("quarantine postmortem dump failed")
 
     def _note_success(self, i: int) -> None:
         h = self.health[i]
@@ -754,6 +765,8 @@ class _AggregateMetrics:
         peak_b = (utils[0]["peak_hbm_gbps"] or 0) * 1e9
         for kind in UTILIZATION_KINDS:
             rows = [u[kind] for u in utils]
+            measured_s = sum(r.get("measured_busy_s", 0.0) for r in rows)
+            modeled_s = sum(r.get("modeled_busy_s", 0.0) for r in rows)
             sec: Dict[str, Any] = {
                 "dispatches": sum(r["dispatches"] for r in rows),
                 "tokens": sum(r["tokens"] for r in rows),
@@ -762,6 +775,15 @@ class _AggregateMetrics:
                 "busy_s": round(sum(r["busy_s"] for r in rows), 3),
                 "mfu": 0.0, "hbm_bw_util": 0.0,
                 "mfu_1m": 0.0, "hbm_bw_util_1m": 0.0,
+                # measured dispatch timing (ISSUE 11): sums add across
+                # replicas; the skew RATIO recomputes from the sums
+                "measured_dispatches": sum(
+                    r.get("measured_dispatches", 0) for r in rows
+                ),
+                "measured_busy_s": round(measured_s, 4),
+                "modeled_busy_s": round(modeled_s, 4),
+                "model_skew": round(measured_s / modeled_s, 3)
+                if modeled_s > 0 else 0.0,
             }
             # aggregate busy time is SUMMED replica-seconds, so the ratio
             # divides by replica-seconds of roofline — per-chip MFU, not
@@ -812,6 +834,27 @@ class _AggregateMetrics:
             agg["kv_tier"] = {
                 k: sum(t[k] for t in tier_snaps)
                 for k in tier_snaps[0]
+            }
+        # Flight recorder + anomaly detectors (ISSUE 11): counters sum;
+        # each active anomaly carries the replica it fires on so the
+        # autoscaler's "don't scale while an anomaly is active" guard can
+        # tell a sick replica from a sick fleet.
+        anoms = [s.get("anomalies") or {} for s in snaps]
+        active: List[Dict[str, Any]] = []
+        for i, a in enumerate(anoms):
+            for entry in a.get("active", []):
+                active.append({**entry, "replica": i})
+        agg["anomalies"] = {
+            key: sum(a.get(key, 0) for a in anoms)
+            for key in ("anomaly_queue_stall", "anomaly_fetch_starvation",
+                        "anomaly_mfu_collapse", "anomaly_prefill_convoy")
+        }
+        agg["anomalies"]["anomalies_active"] = len(active)
+        agg["anomalies"]["active"] = active
+        flights = [s["flight"] for s in snaps if "flight" in s]
+        if flights:
+            agg["flight"] = {
+                k: sum(f[k] for f in flights) for k in flights[0]
             }
         # replica-lifecycle observability: per-replica health gauges +
         # the supervisor counter family (quarantine/re-admit/migration)

@@ -70,6 +70,13 @@ from ..ops.sampling import (
     sample_tokens_per_slot,
 )
 from .failpoints import failpoint
+from .flight_recorder import (
+    FlightRecorder,
+    KIND_DECODE,
+    KIND_MULTI,
+    KIND_VERIFY,
+    ring_default,
+)
 from .kv_cache import (
     OutOfPagesError,
     PagePool,
@@ -220,6 +227,14 @@ class EngineConfig:
     # with the sequential path's own per-(seed, position) keys).  Does not
     # compose with sp/pp meshes yet (validated at construction).
     speculative_k: int = 0
+    # Scheduler flight recorder (runtime/flight_recorder.py, README
+    # "Flight recorder"): a fixed ring of this many per-iteration records
+    # (decision log + measured dispatch timing + anomaly detectors +
+    # postmortem capture).  0 disables it entirely: no recorder is built
+    # and every dispatch/eviction path is byte-identical to before (each
+    # hook is one `if flight is not None` branch).  Default honors
+    # KAFKA_TPU_FLIGHT_RING at construction time.
+    flight_ring: int = dataclasses.field(default_factory=ring_default)
 
     @property
     def max_window(self) -> int:
@@ -402,6 +417,14 @@ class _Fetch:
     # t_ready + rtt_est is when popping becomes non-blocking
     t_ready: Optional[float] = None
     spec: Optional[_SpecMeta] = None
+    # Flight-recorder attribution (ISSUE 11): which utilization kind this
+    # dispatch bills to and its modeled roofline seconds.  When the
+    # completion is observed (t_ready stamped), the measured device time
+    # derived from fetch-maturation order feeds the modeled-vs-measured
+    # skew gauge.  modeled_s None = no cost model / recorder off: the
+    # entry is timed for the ring but never billed to the skew gauge.
+    kind: str = "decode"
+    modeled_s: Optional[float] = None
 
 
 class _GrammarTables:
@@ -818,6 +841,33 @@ class InferenceEngine:
                 page_size=ps,
             )
             self.prefix_cache.tier = self.kv_tier
+        if self.ecfg.flight_ring < 0:
+            raise ValueError(
+                "flight_ring must be >= 0 (0 disables the flight recorder)"
+            )
+        # Scheduler flight recorder (ISSUE 11): one record per scheduler
+        # iteration + anomaly detectors + postmortem capture.  None when
+        # disabled — every hook site below is one branch, so the
+        # flight_ring=0 dispatch paths are byte-identical to a
+        # recorder-less build (tested).
+        self.flight: Optional[FlightRecorder] = (
+            FlightRecorder(self.ecfg.flight_ring)
+            if self.ecfg.flight_ring > 0 else None
+        )
+        # completion time of the previously-observed fetch: the baseline
+        # the measured-dispatch-latency derivation subtracts from (in-
+        # order device execution — a dispatch starts when its predecessor
+        # finishes or when it was enqueued, whichever is later)
+        self._last_ready_t: Optional[float] = None
+        # Modeled roofline seconds accumulated over prefill chunk
+        # dispatches whose completions are UNOBSERVED (intermediate
+        # chunks create no fetch entry).  The final chunk's entry
+        # carries the whole accumulated sum: its measured span covers
+        # the device backlog of every unobserved chunk before it, so
+        # pairing it with only the last chunk's modeled cost would
+        # inflate the prefill skew gauge by ~the chunk count on long
+        # prompts — exactly the workload the gauge calibrates.
+        self._prefill_modeled_acc: Optional[float] = None
         self.metrics = EngineMetrics()
         # Device-utilization estimator (ISSUE 10): the planner's
         # per-dispatch flop/byte cost model plus this chip's datasheet
@@ -1474,6 +1524,8 @@ class InferenceEngine:
             and len(self.waiting) >= self.ecfg.max_waiting
         ):
             self.metrics.record_rejected()
+            if self.flight is not None:
+                self.flight.note_cause("reject")
             raise AdmissionError(
                 len(self.waiting), self.ecfg.max_waiting,
                 self.retry_after_estimate(),
@@ -1719,6 +1771,8 @@ class InferenceEngine:
         req.state = FINISHED
         req.finish_reason = "timeout"
         self._finalize_slo(req, "timeout")
+        if self.flight is not None:
+            self.flight.note_cause("timeout")
         if req.slot >= 0 or req.seq is not None or req in self.parked:
             self._release_slot(req)
         self._requests.pop(req.request_id, None)
@@ -1770,6 +1824,10 @@ class InferenceEngine:
             self._drain(block=True)
         if not self.num_active:
             self.metrics.mark_idle()  # idle gaps are not TPOT
+            self._last_ready_t = None  # measured-latency chain restarts
+        if self.flight is not None:
+            # commit this iteration's record + run the anomaly detectors
+            self.flight.finish_step(self)
         out, self._out_events = self._out_events, []
         return out
 
@@ -1856,6 +1914,60 @@ class InferenceEngine:
         )
         return problems
 
+    def lane_table(self) -> List[Dict[str, Any]]:
+        """The active-lane table for postmortems: every registered
+        request's scheduler-visible state, readable without the engine."""
+        now = time.monotonic()
+        out: List[Dict[str, Any]] = []
+        for req in self._requests.values():
+            out.append({
+                "request_id": req.request_id,
+                "state": req.state,
+                "slot": req.slot,
+                "age_s": round(now - req.submit_time, 3)
+                if req.submit_time else None,
+                "prompt_tokens": len(req.prompt_ids),
+                "output_tokens": len(req.output_ids),
+                "dispatched": req.dispatched,
+                "drained": req.drained,
+                "spec_ahead": req.spec_ahead,
+                "cached_tokens": req.cached_tokens,
+                "cache_source": req.cache_source,
+                "grammar": req.grammar is not None,
+                "host_constrained": self._host_constrained(req),
+                "predicted": len(req.predicted),
+                "pages": len(req.seq.pages) if req.seq is not None else 0,
+                "seq_len": req.seq.length if req.seq is not None else 0,
+                "finish_reason": req.finish_reason,
+            })
+        return out
+
+    def dump_postmortem(self, reason: str) -> Optional[str]:
+        """Write a flight-recorder postmortem (ring + metrics snapshot +
+        active-lane table) for this replica.  Best-effort and exception-
+        free — this runs on failure paths.  None when the recorder is
+        off or no dump directory is configured."""
+        if self.flight is None:
+            return None
+        try:
+            # flush the failing iteration's partial staging into the ring
+            # so the dump's LAST record describes the step that died
+            self.flight.finish_step(self)
+        except Exception:  # pragma: no cover - defensive
+            pass
+        try:
+            lanes = self.lane_table()
+        except Exception:  # pragma: no cover - defensive
+            lanes = []
+        try:
+            snap = self.metrics.snapshot(self, reset_peak=False)
+        except Exception:  # pragma: no cover - defensive
+            snap = {}
+        self.flight.replica = self.replica
+        return self.flight.dump_postmortem(
+            reason, lanes=lanes, metrics_snapshot=snap,
+        )
+
     def recover_from_failure(self) -> List[TokenEvent]:
         """Rebuild a servable engine after a step() exception.
 
@@ -1866,6 +1978,9 @@ class InferenceEngine:
         rebuilt from scratch.  The caller (EngineWorker) dispatches the
         returned events.
         """
+        # black-box first: capture the ring + lane table BEFORE recovery
+        # mutates them (the postmortem must explain the failing step)
+        self.dump_postmortem("engine_failure")
         events: List[TokenEvent] = list(self._out_events)
         self._out_events = []
         # In-flight fetches reference arrays whose producing computation
@@ -1874,6 +1989,8 @@ class InferenceEngine:
         self._pending.clear()
         self._pending_steps = 0
         self._constrained_fetch = None
+        self._last_ready_t = None
+        self._prefill_modeled_acc = None  # its chunks died with the step
         for req in list(self._requests.values()):
             if req.state == WAITING:
                 # never started compute: keep it queued, but make sure a
@@ -1975,8 +2092,14 @@ class InferenceEngine:
             popped = self._pending.pop(0)
             self._pending_steps -= popped.steps
             emitted += self._process_entry(popped)
+        if not self._pending:
+            # empty pipeline: the next completion's measured latency
+            # baselines on its own enqueue time, not a stale completion
+            self._last_ready_t = None
         if emitted:
             self.metrics.record_emit_burst(emitted)
+            if self.flight is not None:
+                self.flight.note_pop(emitted)
 
     def _push_entry(self, entry: _Fetch) -> None:
         self._pending.append(entry)
@@ -1990,7 +2113,31 @@ class InferenceEngine:
             if e.t_ready is None and getattr(
                 e.arr, "is_ready", lambda: True
             )():
-                e.t_ready = now
+                self._note_ready(e, now)
+
+    def _note_ready(self, entry: _Fetch, now: float) -> None:
+        """Stamp one fetch's compute completion and derive its MEASURED
+        device time (ISSUE 11): with in-order device execution a dispatch
+        starts at max(its enqueue, the previous dispatch's completion),
+        so completion - that start is the wall time the device spent on
+        it.  Completions are observed at scheduler-poll cadence —
+        several dispatches finishing between polls telescope into the
+        first one's sample — so the per-kind SUMS (not the individual
+        samples) are the calibrated quantity the skew gauge reads."""
+        entry.t_ready = now
+        start = entry.t0
+        if self._last_ready_t is not None and self._last_ready_t > start:
+            start = self._last_ready_t
+        self._last_ready_t = now
+        measured = now - start
+        if measured < 0.0 or measured > 10.0:
+            return  # clock weirdness / wedged device: not a calibration
+        if entry.modeled_s is not None:
+            self.metrics.record_measured_dispatch(
+                entry.kind, entry.modeled_s, measured
+            )
+        if self.flight is not None:
+            self.flight.note_measured(measured)
 
     def _rtt_age_bound(self) -> float:
         """Age at which an in-flight fetch's transfer has presumably landed
@@ -2333,6 +2480,8 @@ class InferenceEngine:
                 break
             self.parked.remove(oldest)
             self._seat(oldest, slot)
+            if self.flight is not None:
+                self.flight.note_cause("admit_parked")
         self._admit_offslot()
 
     def _admit_waiting_head(self, slot: int) -> bool:
@@ -2351,6 +2500,8 @@ class InferenceEngine:
             needed, req
         ):
             self._detach_prefix(req)
+            if self.flight is not None:
+                self.flight.note_cause("page_blocked")
             return False
         self.waiting.pop(0)
         try:
@@ -2360,7 +2511,11 @@ class InferenceEngine:
             self._detach_prefix(req)
             req.state = WAITING
             self.waiting.insert(0, req)
+            if self.flight is not None:
+                self.flight.note_cause("page_blocked")
             return False
+        if self.flight is not None:
+            self.flight.note_cause("admit")
         return True
 
     def _seat(self, req: GenRequest, slot: int) -> None:
@@ -2421,6 +2576,8 @@ class InferenceEngine:
                 self.waiting.insert(0, req)
                 break
             self.parked.append(req)
+            if self.flight is not None:
+                self.flight.note_cause("park")
 
     def _start_prefill(self, req: GenRequest, slot: int) -> None:
         """Reserve pages + the batch slot; chunks run via _advance_prefill.
@@ -2466,6 +2623,8 @@ class InferenceEngine:
                     "degrading to the host mask path", req.request_id,
                 )
                 req.grammar = None
+                if self.flight is not None:
+                    self.flight.note_cause("degrade")
         if req.logits_mask_fn is not None and req.prefill_allowed is None \
                 and req.grammar is None:
             allowed_ids = req.logits_mask_fn(req.output_ids)
@@ -2594,9 +2753,11 @@ class InferenceEngine:
             self._arg(top_ps), self._arg(seeds), self._arg(lane_active),
             *vis,
         )
-        self._record_prefill_cost([
+        self._accrue_prefill_modeled(self._record_prefill_cost([
             (int(chunk_lens[i]), int(starts[i])) for i in range(len(reqs))
-        ])
+        ]))
+        if self.flight is not None:
+            self.flight.note_prefill(len(reqs), int(chunk_lens.sum()))
         items: List[Optional[GenRequest]] = [None] * W
         finals_row: List[Optional[str]] = [None] * W
         for i, req in enumerate(reqs):
@@ -2647,7 +2808,8 @@ class InferenceEngine:
             toks.copy_to_host_async()
             self._push_entry(_Fetch(
                 arr=toks, items=items, final=[finals_row],
-                t0=time.monotonic(),
+                t0=time.monotonic(), kind="prefill",
+                modeled_s=self._take_prefill_modeled(),
             ))
             for req, fin in zip(items, finals_row):
                 if req is not None and fin is not None:
@@ -2720,7 +2882,11 @@ class InferenceEngine:
             req.prefill_allowed,
             *vis,
         )
-        self._record_prefill_cost([(chunk_len, start)])
+        self._accrue_prefill_modeled(
+            self._record_prefill_cost([(chunk_len, start)])
+        )
+        if self.flight is not None:
+            self.flight.note_prefill(1, chunk_len)
         req.seq.length = start + chunk_len
         if req.seq.length < total:
             return  # more chunks to go; decode proceeds meanwhile
@@ -2770,7 +2936,8 @@ class InferenceEngine:
         final = self._limit_reason_after_dispatch(req)
         tok.copy_to_host_async()
         entry = _Fetch(arr=tok, items=[req], final=[[final]],
-                       t0=time.monotonic())
+                       t0=time.monotonic(), kind="prefill",
+                       modeled_s=self._take_prefill_modeled())
         self._push_entry(entry)
         if final is not None:
             self._to_draining(req)
@@ -2874,13 +3041,13 @@ class InferenceEngine:
                 d_act = self._dev(
                     np.array([m is not None for m in full_batch])
                 )
-                self._dispatch_group(full_batch, d_act, None, full=False,
-                                     fsm=fsm_any)
+                entry = self._dispatch_group(full_batch, d_act, None,
+                                             full=False, fsm=fsm_any)
             else:
-                self._dispatch_group(full_batch, self._d_active, None,
-                                     full=True, fsm=fsm_any)
+                entry = self._dispatch_group(full_batch, self._d_active,
+                                             None, full=True, fsm=fsm_any)
             self.metrics.record_decode_step(len(active_slots))
-            self._record_decode_cost(active_slots)
+            self._record_decode_cost(active_slots, entry=entry)
             return
         # Mixed/host-constrained batch.  A host-masked lane's next mask
         # depends on every token it has emitted so far, so its decode
@@ -3033,6 +3200,10 @@ class InferenceEngine:
                     # its next mask: a genuine choice point
                     m.constrained_roundtrips += 1
                     self.metrics.constrained_roundtrips += 1
+        if self.flight is not None and (n_chain or n_amb_dispatched):
+            # host-constrained groups this iteration: chained (grammar-
+            # forced, no round trip) vs awaited (genuine choice points)
+            self.flight.note_constrained(n_chain, n_amb_dispatched)
         if n_uncon or n_chain or n_amb_dispatched:
             # one scheduler iteration = one TPOT sample / occupancy record,
             # however many dispatch groups it took (group dispatches land
@@ -3228,6 +3399,10 @@ class InferenceEngine:
             spec=_SpecMeta(cand_lens=cand_lens, width=K + 1),
         )
         self._push_entry(entry)
+        if self.flight is not None:
+            self.flight.note_dispatch(KIND_VERIFY, busy,
+                                      busy + n_proposed)
+            self.flight.note_spec(n_proposed)
         for req, fin in zip(members, finals):
             if req is not None and fin is not None:
                 self._to_draining(req)
@@ -3235,7 +3410,7 @@ class InferenceEngine:
         self.metrics.record_verify_dispatch(n_proposed)
         # verify cost: every lane advances >= 1 query plus its candidates
         self._record_decode_cost(members, kind="verify",
-                                 queries=busy + n_proposed)
+                                 queries=busy + n_proposed, entry=entry)
         return True
 
     def _pick_multi_step(self, active_slots: List[GenRequest]) -> int:
@@ -3336,7 +3511,7 @@ class InferenceEngine:
         self.metrics.record_decode_step(
             sum(1 for m in entry.items if m is not None), steps=k
         )
-        self._record_decode_cost(entry.items, steps=k)
+        self._record_decode_cost(entry.items, steps=k, entry=entry)
 
     def _constrained_inflight(self) -> bool:
         """Is the constrained micro-batch still waiting on its last fetch?"""
@@ -3451,6 +3626,12 @@ class InferenceEngine:
         entry = _Fetch(arr=toks, items=items, final=finals,
                        t0=time.monotonic(), steps=steps)
         self._push_entry(entry)
+        if self.flight is not None:
+            lanes = sum(1 for m in items if m is not None)
+            self.flight.note_dispatch(
+                KIND_MULTI if steps > 1 else KIND_DECODE,
+                lanes, lanes * steps, steps=steps,
+            )
         for req, fin in zip(members, last_final):
             if req is not None and fin is not None:
                 self._to_draining(req)
@@ -3586,6 +3767,8 @@ class InferenceEngine:
             )
             req.grammar = None
             self._d_fsm = self._d_fsm.at[slot].set(-1)
+            if self.flight is not None:
+                self.flight.note_cause("degrade")
             return
         off = self._grammars.offsets[g_idx]
         # at activation at most ONE token (the prefill's sample, still a
@@ -3599,6 +3782,8 @@ class InferenceEngine:
             )
             req.grammar = None
             self._d_fsm = self._d_fsm.at[slot].set(-1)
+            if self.flight is not None:
+                self.flight.note_cause("degrade")
             return
         if drained_all:
             self._d_fsm = self._d_fsm.at[slot].set(off + state)
@@ -3618,6 +3803,8 @@ class InferenceEngine:
         grammar here): ops/sampling degrades the row to unconstrained —
         count it, and log once per request with the mask's state."""
         self.metrics.constrained_mask_overtight += 1
+        if self.flight is not None:
+            self.flight.note_cause("overtight")
         if req.overtight_logged:
             return
         req.overtight_logged = True
@@ -3664,14 +3851,43 @@ class InferenceEngine:
                 "goodput_tokens": n_out if met else 0,
             })
 
-    def _record_prefill_cost(self, lanes) -> None:
+    def _modeled_dispatch_s(self, flops: float,
+                            bytes_: float) -> Optional[float]:
+        """Roofline execution time for one dispatch (None = no roofline):
+        the slower of the compute and bandwidth bounds — the denominator
+        of the modeled-vs-measured skew gauge."""
+        m = self.metrics
+        if not m.peak_flops or not m.peak_hbm_bps:
+            return None
+        return max(flops / m.peak_flops, bytes_ / m.peak_hbm_bps)
+
+    def _accrue_prefill_modeled(self, modeled: Optional[float]) -> None:
+        """Bank one prefill chunk dispatch's modeled seconds until a
+        prefill FETCH ENTRY exists to carry them (only final chunks ship
+        one; see _prefill_modeled_acc)."""
+        if modeled is not None:
+            self._prefill_modeled_acc = (
+                (self._prefill_modeled_acc or 0.0) + modeled
+            )
+
+    def _take_prefill_modeled(self) -> Optional[float]:
+        """Consume the banked prefill modeled time for the entry being
+        created — its measured span covers every unobserved chunk since
+        the previous observed completion, so it gets their modeled SUM."""
+        modeled = self._prefill_modeled_acc
+        self._prefill_modeled_acc = None
+        return modeled
+
+    def _record_prefill_cost(self, lanes) -> Optional[float]:
         """Report one prefill dispatch's modeled cost: `lanes` is
         [(chunk_tokens, start_pos), ...] for every lane the dispatch
         advanced.  Weights stream once per dispatch, so the per-lane
-        weight-byte term is de-duplicated here."""
+        weight-byte term is de-duplicated here.  Returns the modeled
+        roofline seconds (None = no model/roofline) so final-chunk
+        dispatches can tag their fetch entry for the skew gauge."""
         cm = self._cost_model
         if cm is None or not self.metrics.enabled:
-            return
+            return None
         if self._have_roofline and self.metrics.peak_source == "unknown":
             # fresh metrics object (warmup/bench reset): restore the
             # roofline so MFU/HBM ratios don't silently flatline at 0
@@ -3685,15 +3901,23 @@ class InferenceEngine:
             toks += chunk
         bytes_ += cm.weight_bytes
         self.metrics.record_dispatch_cost("prefill", toks, flops, bytes_)
+        modeled = self._modeled_dispatch_s(flops, bytes_)
+        if self.flight is not None:
+            self.flight.note_cost(flops, bytes_, modeled)
+        return modeled
 
     def _record_decode_cost(self, members, steps: int = 1,
                             kind: str = "decode",
-                            queries: Optional[int] = None) -> None:
+                            queries: Optional[int] = None,
+                            entry: Optional[_Fetch] = None) -> None:
         """Report one decode/verify dispatch's modeled cost.  `members`
         is the slot-aligned lane list (None = masked out); context is the
         host-known per-lane KV length sum.  `queries` overrides the
         query-token count for verify dispatches (sum of candidate widths
-        across lanes)."""
+        across lanes).  `entry` tags the dispatch's in-flight fetch with
+        the modeled time so its maturation feeds the skew gauge (mixed
+        host-constrained iterations pass None — several groups share one
+        cost record, so no single fetch can carry it honestly)."""
         cm = self._cost_model
         if cm is None or not self.metrics.enabled:
             return
@@ -3714,6 +3938,12 @@ class InferenceEngine:
             toks = len(lanes) * steps
             flops, bytes_ = cm.decode_cost(toks, ctx, steps)
         self.metrics.record_dispatch_cost(kind, toks, flops, bytes_)
+        modeled = self._modeled_dispatch_s(flops, bytes_)
+        if entry is not None:
+            entry.kind = kind
+            entry.modeled_s = modeled
+        if self.flight is not None:
+            self.flight.note_cost(flops, bytes_, modeled)
 
     def _next_constraint(self, s: GenRequest):
         """Classify the next constrained step for a lane.
@@ -3789,6 +4019,10 @@ class InferenceEngine:
     def _preempt(self, victim: GenRequest) -> None:
         logger.warning("preempting %s (out of KV pages)", victim.request_id)
         self.metrics.record_preempt()
+        if self.flight is not None:
+            self.flight.note_cause(
+                "park_rollback" if victim in self.parked else "preempt"
+            )
         add_event(victim.trace, "preempt",
                   {"generated": len(victim.output_ids),
                    **self._tattrs()})

@@ -30,6 +30,7 @@ import hashlib
 import hmac
 import json
 import logging
+from dataclasses import replace as dataclasses_replace
 from typing import Any, AsyncIterator, Dict, List, Optional
 
 from aiohttp import web
@@ -151,6 +152,10 @@ def build_tpu_provider(cfg: ServingConfig) -> LLMProvider:
         max_total_s=cfg.request_timeout_s,
         max_waiting=cfg.max_queue_depth,
     )
+    if cfg.flight_ring is not None:
+        # None defers to the EngineConfig default (KAFKA_TPU_FLIGHT_RING)
+        engine_cfg = dataclasses_replace(engine_cfg,
+                                         flight_ring=cfg.flight_ring)
     # Memory-fit validation (runtime/planner.py): per-device bytes under
     # the actual sharding rules, against the live device's HBM.  When the
     # WEIGHTS ALONE exceed the budget — never a false positive, the
@@ -645,6 +650,7 @@ def _add_routes(app: web.Application) -> None:
     r.add_post("/debug/profile", capture_profile)
     r.add_get("/debug/traces", debug_traces)
     r.add_get("/debug/trace/{request_id}", debug_trace)
+    r.add_get("/debug/flight/{replica}", debug_flight)
     r.add_get("/playground", playground)
     # OPTIONS preflight is answered by cors_middleware before routing
 
@@ -1333,6 +1339,41 @@ async def debug_trace(request: web.Request) -> web.Response:
     return web.json_response(data)
 
 
+async def debug_flight(request: web.Request) -> web.Response:
+    """One replica's live flight-recorder ring (ISSUE 11): the per-
+    scheduler-iteration decision log, measured dispatch timing, and the
+    anomaly detector state.  `scripts/flightview.py` pretty-prints the
+    payload; postmortem dumps of the same shape land next to the
+    persisted traces on engine failure/quarantine."""
+    llm = _state(request)["llm"]
+    engine = getattr(llm, "engine", None)
+    if engine is None:
+        return web.json_response({"error": "no local engine"}, status=404)
+    replicas = getattr(engine, "engines", [engine])
+    try:
+        idx = int(request.match_info["replica"])
+    except ValueError:
+        return web.json_response(
+            {"error": "replica must be an integer index"}, status=400
+        )
+    if not 0 <= idx < len(replicas):
+        return web.json_response(
+            {"error": f"replica {idx} out of range (dp={len(replicas)})"},
+            status=404,
+        )
+    flight = getattr(replicas[idx], "flight", None)
+    if flight is None:
+        return web.json_response(
+            {"error": "flight recorder disabled "
+                      "(KAFKA_TPU_FLIGHT_RING=0)"},
+            status=404,
+        )
+    payload = flight.snapshot()
+    payload["replica"] = idx
+    payload["dp"] = len(replicas)
+    return web.json_response(payload)
+
+
 async def playground(request: web.Request) -> web.Response:
     """The in-tree chat client (reference: playground/src/, a Next.js app).
 
@@ -1348,6 +1389,20 @@ _PROFILE_BUSY = False
 _PROFILE_DIR = "/tmp/kafka_tpu_trace"
 
 
+def _flight_seqs(llm) -> Optional[List[Dict[str, Any]]]:
+    """Per-replica flight-recorder sequence cursors (None = no engine or
+    recorder off everywhere)."""
+    engine = getattr(llm, "engine", None)
+    if engine is None:
+        return None
+    out = []
+    for i, e in enumerate(getattr(engine, "engines", [engine])):
+        flight = getattr(e, "flight", None)
+        if flight is not None:
+            out.append({"replica": i, "seq": flight.next_seq})
+    return out or None
+
+
 async def capture_profile(request: web.Request) -> web.Response:
     """Capture a jax.profiler device trace (xplane) for offline analysis.
 
@@ -1355,14 +1410,39 @@ async def capture_profile(request: web.Request) -> web.Response:
     server-chosen, not client-chosen) covers whatever the engine executes
     during the window — point a load at the server first.  Gated behind
     KAFKA_TPU_PROFILING=1 (trace files can contain workload detail); one
-    capture at a time."""
+    capture at a time.
+
+    When an API token is configured, this endpoint requires the MACHINE
+    token specifically — a per-user session that satisfies the general
+    bearer middleware does not qualify (ISSUE 11 satellite: profile
+    captures expose workload detail and eat device time; they are an
+    operator surface like /admin/resize, not a user one).
+
+    The response includes the flight-recorder window covering the
+    capture (per-replica [start_seq, end_seq) plus wall timestamps), so
+    xplane slices correlate with the scheduler's per-iteration decision
+    records at GET /debug/flight/{replica}."""
     import os
+    import time as _time
 
     if os.environ.get("KAFKA_TPU_PROFILING", "0") not in ("1", "true"):
         return web.json_response(
             {"error": "profiling disabled (set KAFKA_TPU_PROFILING=1)"},
             status=403,
         )
+    cfg = _state(request)["cfg"]
+    if cfg.api_token:
+        supplied = request.headers.get("Authorization", "")
+        if not hmac.compare_digest(
+            supplied.encode("utf-8", "surrogateescape"),
+            f"Bearer {cfg.api_token}".encode(),
+        ):
+            return web.json_response(
+                {"error": {"message": "profile capture requires the "
+                           "configured API token",
+                           "type": "authentication_error"}},
+                status=401,
+            )
     global _PROFILE_BUSY
     # check-and-set with no await in between: concurrent requests must not
     # race past the guard (asyncio is single-threaded, so this is atomic)
@@ -1390,14 +1470,38 @@ async def capture_profile(request: web.Request) -> web.Response:
             return web.json_response(
                 {"error": "'seconds' must be in [0.1, 30]"}, status=400
             )
+        llm = _state(request)["llm"]
+        start_seqs = _flight_seqs(llm)
+        t_start = _time.time()
         jax.profiler.start_trace(_PROFILE_DIR)
         try:
             await asyncio.sleep(seconds)
         finally:
             jax.profiler.stop_trace()
+        t_end = _time.time()
+        end_seqs = _flight_seqs(llm)
     finally:
         _PROFILE_BUSY = False
-    return web.json_response({"trace_dir": _PROFILE_DIR, "seconds": seconds})
+    flight_window = None
+    if start_seqs is not None and end_seqs is not None:
+        ends = {e["replica"]: e["seq"] for e in end_seqs}
+        flight_window = {
+            "t_start": round(t_start, 4),
+            "t_end": round(t_end, 4),
+            "replicas": [
+                {"replica": s["replica"], "start_seq": s["seq"],
+                 "end_seq": ends.get(s["replica"], s["seq"])}
+                for s in start_seqs
+            ],
+        }
+    return web.json_response({
+        "trace_dir": _PROFILE_DIR,
+        "seconds": seconds,
+        # correlate xplane slices with scheduler decisions: fetch
+        # /debug/flight/{replica} and select records with
+        # start_seq <= seq < end_seq (or t in [t_start, t_end])
+        "flight_window": flight_window,
+    })
 
 
 def run_server(cfg: Optional[ServingConfig] = None) -> None:

@@ -117,6 +117,13 @@ class ServingConfig:
     slow_ttft_ms: Optional[float] = None
     slow_total_ms: Optional[float] = None
     log_format: str = "text"
+    # Scheduler flight recorder (README "Flight recorder", ISSUE 11):
+    # per-replica ring of this many per-scheduler-iteration records
+    # (decision log, measured dispatch timing, anomaly detectors,
+    # postmortem capture at GET /debug/flight/{replica}).  0 disables it
+    # with byte-identical dispatch paths; None defers to
+    # KAFKA_TPU_FLIGHT_RING (default 256).
+    flight_ring: Optional[int] = None
     # SLO targets (README "SLO telemetry", ISSUE 10): every request is
     # classified MET/MISSED at finalize against these; /metrics exports
     # attainment (total/1m/5m windows) and goodput (tokens from SLO-met
@@ -242,6 +249,9 @@ class ServingConfig:
             trace_ring=get("TRACE_RING", cls.trace_ring, int),
             slow_ttft_ms=get("SLOW_TTFT_MS", None, float),
             slow_total_ms=get("SLOW_TOTAL_MS", None, float),
+            # clamp negatives to 0 = disabled, same policy as the caches
+            flight_ring=get("FLIGHT_RING", None,
+                            lambda v: max(0, int(v))),
             slo_ttft_ms=get("SLO_TTFT_MS", None, float),
             slo_tpot_ms=get("SLO_TPOT_MS", None, float),
             log_format=get("LOG_FORMAT", cls.log_format),

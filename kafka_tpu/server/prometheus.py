@@ -365,6 +365,34 @@ def render_prometheus(snap: Dict[str, Any]) -> str:
                                  ru[k].get(key, 0),
                                  {"replica": idx, "kind": k,
                                   "window": window})
+        # Measured dispatch timing + model skew (ISSUE 11, the flight
+        # recorder's fetch-maturation derivation): counters for PromQL
+        # rate()-based skew, plus the ready-made since-boot ratio gauge.
+        w.family("kafka_tpu_measured_dispatches_total", "counter",
+                 "Dispatches with a measured device-time sample by kind.")
+        for k in kinds:
+            w.sample("kafka_tpu_measured_dispatches_total",
+                     util[k].get("measured_dispatches", 0), {"kind": k})
+        w.family("kafka_tpu_dispatch_measured_seconds_total", "counter",
+                 "Measured device execution time by dispatch kind "
+                 "(fetch-maturation timing).")
+        for k in kinds:
+            w.sample("kafka_tpu_dispatch_measured_seconds_total",
+                     util[k].get("measured_busy_s", 0), {"kind": k})
+        w.family("kafka_tpu_dispatch_modeled_seconds_total", "counter",
+                 "Modeled roofline execution time for the SAME measured "
+                 "dispatches, by kind.")
+        for k in kinds:
+            w.sample("kafka_tpu_dispatch_modeled_seconds_total",
+                     util[k].get("modeled_busy_s", 0), {"kind": k})
+        w.family("kafka_tpu_dispatch_model_skew", "gauge",
+                 "Measured / modeled dispatch time by kind (>1 = the "
+                 "device runs slower than the cost model assumes, so the "
+                 "modeled MFU/HBM-BW figures read high by this factor; "
+                 "0 = no samples yet).")
+        for k in kinds:
+            w.sample("kafka_tpu_dispatch_model_skew",
+                     util[k].get("model_skew", 0), {"kind": k})
         if util.get("peak_tflops"):
             w.family("kafka_tpu_device_peak_teraflops", "gauge",
                      "Roofline peak FLOP/s per chip (datasheet or env "
@@ -568,6 +596,49 @@ def render_prometheus(snap: Dict[str, Any]) -> str:
             if key in tier:
                 w.sample("kafka_tpu_kv_tier_bytes_total", tier[key],
                          {"dir": label})
+
+    # Flight-recorder anomaly detectors (runtime/metrics.ANOMALY_METRIC_
+    # KEYS — the registry a static test enforces in both files).  The
+    # counters are edge-triggered firings; the gauge is how many
+    # detectors are CURRENTLY firing (the autoscaler's "don't scale on
+    # stale math" input, also in /admin/signals).
+    anom = snap.get("anomalies") or {}
+    if anom:
+        w.family("kafka_tpu_anomalies_total", "counter",
+                 "Scheduler anomaly detector firings by kind "
+                 "(edge-triggered).")
+        for key, kind in (
+            ("anomaly_queue_stall", "queue_stall"),
+            ("anomaly_fetch_starvation", "fetch_starvation"),
+            ("anomaly_mfu_collapse", "mfu_collapse"),
+            ("anomaly_prefill_convoy", "prefill_convoy"),
+        ):
+            if key in anom:
+                w.sample("kafka_tpu_anomalies_total", anom[key],
+                         {"kind": kind})
+        if "anomalies_active" in anom:
+            w.family("kafka_tpu_anomalies_active", "gauge",
+                     "Anomaly detectors currently firing.")
+            w.sample("kafka_tpu_anomalies_active",
+                     anom["anomalies_active"])
+
+    # Flight recorder ring state (runtime/metrics.FLIGHT_METRIC_KEYS);
+    # the record contents live at GET /debug/flight/{replica}
+    fl = snap.get("flight") or {}
+    if fl:
+        w.family("kafka_tpu_flight_ring_size", "gauge",
+                 "Configured flight-recorder ring length (records; "
+                 "summed across DP replicas).")
+        w.sample("kafka_tpu_flight_ring_size",
+                 fl.get("flight_ring_size", 0))
+        w.family("kafka_tpu_flight_records_total", "counter",
+                 "Scheduler iterations recorded by the flight recorder.")
+        w.sample("kafka_tpu_flight_records_total",
+                 fl.get("flight_records", 0))
+        w.family("kafka_tpu_flight_postmortems_total", "counter",
+                 "Flight-recorder postmortem dumps written.")
+        w.sample("kafka_tpu_flight_postmortems_total",
+                 fl.get("flight_postmortems", 0))
 
     sandbox = snap.get("sandbox") or {}
     if sandbox:

@@ -106,6 +106,8 @@ EVENTS = (
     "migrate",         # dp_router moved the queued request off a sick replica
     "quarantine",      # the request's replica was circuit-broken mid-flight
     "engine.recover",  # engine failure terminated the request
+    "anomaly",         # a flight-recorder detector fired on the request's
+                       # engine (attrs: kind, detail — flight_recorder.py)
 )
 
 
@@ -269,6 +271,13 @@ def reset() -> None:
 
 def counters() -> Dict[str, int]:
     return dict(_counters)
+
+
+def persist_dir() -> Optional[str]:
+    """The configured trace-persistence directory (None = persistence
+    off).  The flight recorder's postmortem dumps land alongside the
+    persisted trace rings by default (runtime/flight_recorder.py)."""
+    return _persist_dir
 
 
 def slow_count() -> int:
@@ -679,21 +688,29 @@ _PERSIST_KEEP_FACTOR = 4
 _PRUNE_EVERY = 64
 
 
-def _persist_name(trace_id: str) -> str:
-    """Filesystem-safe persisted-trace file name.
-
-    Trace ids can be ADOPTED VERBATIM from a client's X-Request-Id
-    header, so the id must never be used as a path: '../..' would write
-    (and let /debug/trace read) outside the persist dir.  The name keeps
-    a sanitized prefix for human ls-ability plus a digest of the full id
-    for uniqueness — computed identically on write and lookup."""
+def sanitize_stem(raw: str) -> str:
+    """Filesystem-safe file-name stem: a sanitized prefix for human
+    ls-ability plus a digest of the full string for uniqueness.  THE
+    path-traversal defense for every artifact named from untrusted
+    content — persisted traces (ids adopted verbatim from X-Request-Id)
+    and flight-recorder postmortems both derive names through this one
+    helper, so a hardening change cannot drift between them."""
     import hashlib
 
     safe = "".join(
-        c if c.isalnum() or c in "._-" else "_" for c in trace_id[:48]
+        c if c.isalnum() or c in "._-" else "_" for c in raw[:48]
     )
-    digest = hashlib.sha1(trace_id.encode()).hexdigest()[:12]
-    return f"{safe}.{digest}.trace.json"
+    digest = hashlib.sha1(raw.encode()).hexdigest()[:12]
+    return f"{safe}.{digest}"
+
+
+def _persist_name(trace_id: str) -> str:
+    """Persisted-trace file name (see sanitize_stem: trace ids can be
+    ADOPTED VERBATIM from a client's X-Request-Id header, so the id must
+    never be used as a path — '../..' would write, and let /debug/trace
+    read, outside the persist dir).  Computed identically on write and
+    lookup."""
+    return f"{sanitize_stem(trace_id)}.trace.json"
 
 
 def _persist(trace: Trace) -> None:

@@ -4,6 +4,10 @@ Builds the bench configuration (llama-3.2-1b, batch 8), prefers the real
 TPU, and times nested subsets of the decode step:
 
   A. engine.step() loop            — everything (host scheduling included)
+  A'. measured dispatch latency    — the flight recorder's fetch-maturation
+                                     timing (same source as the /metrics
+                                     model-skew gauge), isolating device
+                                     time from host scheduling
   B. decode_fn device loop         — jitted step only, device-resident args
   C. variant: greedy argmax only   — drops the top-k/top-p sort pipeline
   D. variant: no logits head       — drops the [H, V] projection + sampling
@@ -98,6 +102,35 @@ def main() -> None:
     ms_a = (time.monotonic() - t0) / dsteps * 1e3
     print(f"A engine.step() full loop      : {ms_a:8.2f} ms/device-step "
           f"({dsteps} device steps in {iters} scheduler iterations)")
+
+    # ---- A'. measured dispatch latency (flight recorder, ISSUE 11) -------
+    # The recorder derives per-dispatch device time from fetch-maturation
+    # order inside the async pipeline — the SAME numbers /metrics exports
+    # as kafka_tpu_dispatch_measured_seconds_total and the model-skew
+    # gauge, so this section replaces the ad-hoc wall arithmetic the old
+    # script attributed whole-loop time with.  Wall-clock A above keeps
+    # the host scheduling overhead visible; A' isolates device time.
+    util = engine.metrics.utilization_snapshot()
+    dec = util.get("decode") or {}
+    if dec.get("measured_dispatches"):
+        meas_ms = dec["measured_busy_s"] / dec["measured_dispatches"] * 1e3
+        print(f"A' measured dispatch latency   : {meas_ms:8.2f} ms/dispatch "
+              f"({dec['measured_dispatches']} measured; "
+              f"model skew {dec.get('model_skew', 0)}x)")
+    elif engine.flight is not None:
+        recs = engine.flight.records()
+        meas = sorted(r["measured_ms"] for r in recs
+                      if r["measured_ms"] > 0)
+        if meas:
+            # median: the first sample absorbs any XLA compile that ran
+            # inside the window, which would wreck a mean
+            print(f"A' measured dispatch latency   : "
+                  f"{meas[len(meas) // 2]:8.2f} ms/iteration median "
+                  f"({len(meas)} recorded iterations; no roofline on "
+                  "this backend, so no model-skew figure)")
+    else:
+        print("A' measured dispatch latency   :     n/a "
+              "(KAFKA_TPU_FLIGHT_RING=0)")
 
     # ---- device-resident args for the raw fn loops ----------------------
     B, ps, C = ecfg.max_batch, ecfg.page_size, ecfg.max_window

@@ -109,6 +109,10 @@ _HEAVY_TAIL = (
     # running them first would pre-warm the XLA cache under test_engine's
     # wall-clock-sensitive deadline tests (timeout would race length)
     "test_kv_tier.py",
+    # flight-recorder integration shares the tiny-model shapes too and
+    # arms wall-clock-sensitive delay failpoints — keep it off the cold
+    # compile path like test_kv_tier
+    "test_flight_recorder.py",
     "test_grammar_fsm.py",
     "test_speculative.py",
     "test_server_parallel.py",

@@ -602,10 +602,20 @@ class TestMetricsEndpoint:
                 s = await client.get("/admin/signals")
                 assert s.status == 200
                 sig = await s.json()
-                assert sig["version"] == 1
+                assert sig["version"] == 2
                 assert sig["dp"] == 1
                 assert set(sig["queue"]) >= {"depth", "peak",
                                              "trend_per_s"}
+                # version 2 (ISSUE 11): flight-recorder anomaly state is
+                # part of the contract — the "don't scale on stale math"
+                # guard input
+                assert sig["anomalies"]["anomalies_active"] == 0
+                assert sig["anomalies"]["active"] == []
+                for key in ("anomaly_queue_stall",
+                            "anomaly_fetch_starvation",
+                            "anomaly_mfu_collapse",
+                            "anomaly_prefill_convoy"):
+                    assert sig["anomalies"][key] == 0, key
                 assert set(sig["batch"]) >= {"occupancy", "active",
                                              "max_batch", "slots_total"}
                 for key in ("slo_attainment_1m", "slo_attainment_5m",
@@ -623,8 +633,10 @@ class TestMetricsEndpoint:
                             "pages_total", "utilization"):
                     assert key in rep, key
                 assert set(rep["utilization"]["decode"]) == {
-                    "mfu", "mfu_1m", "hbm_bw_util", "hbm_bw_util_1m"
+                    "mfu", "mfu_1m", "hbm_bw_util", "hbm_bw_util_1m",
+                    "model_skew",
                 }
+                assert rep["anomalies_active"] == 0
                 assert sig["draining"] is False
                 assert sig["admission"]["max_queue_depth"] == 256
             finally:
