@@ -811,6 +811,256 @@ def kv_tier_phase(cfg, params, n_churn: int = 3, prompt_len: int = 2048,
     }
 
 
+def disagg_phase(cfg, params, n_chatty: int = 4, n_long: int = 4,
+                 chatty_prompt: int = 48, chatty_gen: int = 96,
+                 long_prompt: int = 1025, long_gen: int = 8,
+                 page_size: int = 16, seed: int = 31,
+                 min_prefill_tokens: int = 128,
+                 stagger_steps: int = 8) -> dict:
+    """Disaggregated prefill/decode A/B (ISSUE 12): mixed open-loop
+    traffic — chatty decode threads streaming tokens while long-prefill
+    threads keep arriving — on dp=2 colocated vs ``prefill:1,decode:1``.
+
+    The TPOT-p99 killer under test: a long prompt admitted next to
+    decode lanes steals one prefill chunk's compute from them every
+    scheduler iteration until it finishes.  Colocated, every replica
+    serves mixed traffic, so chatty lanes eat that stall; disaggregated,
+    long prompts prefill on the prefill replica and their KV pages ship
+    to the decode replica at first-token time, so decode lanes never
+    share an iteration with a long chunk.  multi_step is pinned to 1 so
+    the inter-token gap measures scheduler interleaving, not fusion
+    cadence.
+
+    Reports decode-lane TPOT p99 (client-observed inter-token gaps),
+    TTFT p99 for both classes, ship MB/s, the shipped-thread
+    zero-re-prefill proof (cache_source="shipped", 0 prompt tokens
+    recomputed beyond the mandatory boundary token), and
+    slo_attainment/goodput from the PR 10 plane.  Outputs are asserted
+    token-identical between the two configurations (greedy) — the
+    acceptance criterion for the split changing WHERE work runs, never
+    WHAT it computes.
+    """
+    import jax as _jax
+
+    from kafka_tpu.runtime import EngineConfig, GenRequest
+    from kafka_tpu.runtime.dp_router import DataParallelEngines
+    from kafka_tpu.runtime.metrics import EngineMetrics
+
+    rng = random.Random(seed)
+    win_pages = max(
+        4, -(-(long_prompt + long_gen + 2 * page_size) // page_size)
+    )
+    ecfg = EngineConfig(
+        max_batch=max(2, n_chatty),
+        page_size=page_size,
+        max_pages_per_seq=win_pages,
+        num_pages=(n_chatty + 2 * n_long + 2) * win_pages // 2 + 8,
+        # bucket cap = chunk size: long prompts prefill in repeated
+        # 256-token chunks, the interleaved shape whose per-chunk stalls
+        # are the decode-lane interference under test (a single
+        # whole-prompt bucket would collapse the A/B into one stall)
+        prefill_buckets=(16, 64, 256),
+        multi_step=1,
+        # prompt emission on both sides: the default 150ms fetch-age
+        # bound paces 3+-stream replicas differently than 2-stream ones
+        # (the adaptive tightening engages only at <=2), which would
+        # compare emission cadence, not scheduler interference
+        fetch_wait_s=0.01,
+    )
+    chatty_prompts = [make_prompt(rng, chatty_prompt, cfg.vocab_size)
+                      for _ in range(n_chatty)]
+    long_prompts = [make_prompt(rng, long_prompt, cfg.vocab_size)
+                   for _ in range(n_long)]
+
+    def run(roles) -> dict:
+        dp = DataParallelEngines(
+            cfg, params, ecfg, dp=2, tp=1,
+            dp_roles=roles, disagg_min_prefill_tokens=min_prefill_tokens,
+        )
+        # Compile EVERYTHING the measured run dispatches, outside it (the
+        # classic bench pollution — a mid-measurement XLA compile reads
+        # as a 100ms+ inter-token gap and buries the effect under test):
+        # the long bucket, the 1-token resume-suffix bucket, the batched
+        # prefill at the admission-storm widths (4-wide disagg decode
+        # pool, 2-wide colocated spread), decode, and the ship programs.
+        for n, e in enumerate(dp.engines):
+            for j, blen in enumerate((long_prompt, max(4, page_size // 2))):
+                e.submit(GenRequest(request_id=f"__w{n}_{j}",
+                                    prompt_ids=[3] * blen,
+                                    max_new_tokens=2))
+                e.run_to_completion()
+            for width in (2, 4):
+                for i in range(width):
+                    e.submit(GenRequest(request_id=f"__wb{n}_{width}_{i}",
+                                        prompt_ids=[3 + i] * chatty_prompt,
+                                        max_new_tokens=2))
+                e.run_to_completion()
+        dp.warmup_disagg()
+        for e in dp.engines:
+            e.metrics = EngineMetrics()
+        chatty = [
+            GenRequest(request_id=f"c{i}", prompt_ids=list(p),
+                       max_new_tokens=chatty_gen, prefix_key=f"chat-{i}")
+            for i, p in enumerate(chatty_prompts)
+        ]
+        longs = [
+            GenRequest(request_id=f"l{i}", prompt_ids=list(p),
+                       max_new_tokens=long_gen, prefix_key=f"long-{i}")
+            for i, p in enumerate(long_prompts)
+        ]
+        # Per-replica step-time intervals, for the host-serialization
+        # correction below: on real accelerators e.step() is an async
+        # enqueue (~0 wall), but the CPU backend dispatches
+        # SYNCHRONOUSLY, so one router thread driving dp replicas
+        # serializes every replica's chunk compute into every other
+        # replica's cadence — a 1-core emulation artifact the
+        # disaggregation cannot (and on TPU need not) remove.  Each
+        # decode-lane gap is therefore also reported net of time the
+        # router spent inside OTHER replicas' steps: the decode
+        # replica's own serialized timeline, i.e. what a
+        # parallel-device host observes.  Ship/handoff time runs
+        # outside any e.step() and stays charged to every gap — the
+        # true cost of disaggregation is never subtracted.
+        intervals: list = []
+        for i, e in enumerate(dp.engines):
+            def _wrap(orig, idx):
+                def stepper():
+                    t0 = time.monotonic()
+                    try:
+                        return orig()
+                    finally:
+                        intervals.append((t0, time.monotonic(), idx))
+                return stepper
+            e.step = _wrap(e.step, i)
+        for r in chatty:
+            dp.submit(r)
+        homes = {r.request_id: dp._route[r.request_id] for r in chatty}
+        # open loop: long prompts keep arriving every `stagger_steps`
+        # scheduler iterations regardless of progress (arrival process,
+        # not closed-loop backpressure)
+        t_tok: dict = {r.request_id: [] for r in chatty}
+        pending = list(longs)
+        steps = 0
+        warm_steps = 12  # let the decode lanes reach steady cadence
+        while dp.has_work or pending:
+            if pending and steps >= warm_steps and (
+                (steps - warm_steps) % stagger_steps == 0
+            ):
+                dp.submit(pending.pop(0))
+            evs = dp.step()
+            now = time.monotonic()
+            for ev in evs:
+                if ev.token_id is not None and ev.request_id in t_tok:
+                    t_tok[ev.request_id].append(now)
+            steps += 1
+        gaps = [
+            b - a
+            for times in t_tok.values()
+            for a, b in zip(times, times[1:])
+        ]
+
+        def _other_replica_time(a: float, b: float, home: int) -> float:
+            return sum(
+                min(b, t1) - max(a, t0)
+                for t0, t1, i in intervals
+                if i != home and t1 > a and t0 < b
+            )
+
+        net_gaps = [
+            max(0.0, (b - a) - _other_replica_time(a, b, homes[rid]))
+            for rid, times in t_tok.items()
+            for a, b in zip(times, times[1:])
+        ]
+        shipped = [r for r in longs if r.cache_source == "shipped"]
+        recomputed = [
+            max(0, (len(r.prompt_ids) - 1) - r.cached_tokens)
+            for r in shipped
+        ]
+        disagg = dp.disagg.snapshot()
+        ship_s = disagg["ship_ms"]["sum"] / 1e3
+        out = {
+            "tpot_ms": percentiles_ms(gaps),
+            "tpot_net_ms": percentiles_ms(net_gaps),
+            "chatty_ttft_ms": percentiles_ms(
+                [r.first_token_time - r.submit_time for r in chatty]
+            ),
+            "long_ttft_ms": percentiles_ms(
+                [r.first_token_time - r.submit_time for r in longs]
+            ),
+            "shipped_threads": len(shipped),
+            "shipped_runs": disagg["disagg_shipped_runs"],
+            "shipped_pages": disagg["disagg_shipped_pages"],
+            "ship_mb_s": round(
+                disagg["disagg_shipped_bytes"] / ship_s / 1e6, 1
+            ) if ship_s > 0 else None,
+            "ship_failures": disagg["disagg_ship_failures"],
+            "prefill_tokens_recomputed": sum(recomputed),
+            "long_cache_sources": sorted(
+                {r.cache_source or "none" for r in longs}
+            ),
+            "outputs": {
+                r.request_id: list(r.output_ids) for r in chatty + longs
+            },
+            "slo": phase_slo(dp),
+        }
+        del dp
+        return out
+
+    disagg = run("prefill:1,decode:1")
+    base = run(None)
+    assert disagg["outputs"] == base["outputs"], \
+        "disaggregation changed generated tokens"
+    assert disagg["shipped_threads"] == len(long_prompts), \
+        f"expected every long thread shipped: {disagg['long_cache_sources']}"
+    assert disagg["prefill_tokens_recomputed"] == 0, \
+        "shipped threads re-prefilled prompt tokens on the decode pool"
+    assert (
+        disagg["tpot_net_ms"]["p99"] < base["tpot_net_ms"]["p99"]
+    ), (
+        "decode-lane TPOT p99 under concurrent long prefill must be "
+        f"strictly better disaggregated ({disagg['tpot_net_ms']['p99']}ms)"
+        f" than colocated ({base['tpot_net_ms']['p99']}ms)"
+    )
+    speedup = (
+        round(base["tpot_net_ms"]["p99"] / disagg["tpot_net_ms"]["p99"], 2)
+        if disagg["tpot_net_ms"]["p99"] else None
+    )
+    return {
+        # headline: the host-serialization-corrected figure (identical
+        # to raw on async-dispatch accelerators; on the CPU backend it
+        # removes only the one-thread-drives-every-replica emulation
+        # artifact, never the ship/hand-off cost)
+        "decode_tpot_p99_ms": {
+            "colocated": base["tpot_net_ms"]["p99"],
+            "disaggregated": disagg["tpot_net_ms"]["p99"],
+            "improvement": speedup,
+        },
+        "decode_tpot_ms": {"colocated": base["tpot_net_ms"],
+                           "disaggregated": disagg["tpot_net_ms"]},
+        "decode_tpot_raw_wall_ms": {"colocated": base["tpot_ms"],
+                                    "disaggregated": disagg["tpot_ms"]},
+        "chatty_ttft_p99_ms": {
+            "colocated": base["chatty_ttft_ms"]["p99"],
+            "disaggregated": disagg["chatty_ttft_ms"]["p99"],
+        },
+        "long_ttft_p99_ms": {
+            "colocated": base["long_ttft_ms"]["p99"],
+            "disaggregated": disagg["long_ttft_ms"]["p99"],
+        },
+        "shipped_runs": disagg["shipped_runs"],
+        "shipped_pages": disagg["shipped_pages"],
+        "ship_mb_s": disagg["ship_mb_s"],
+        "ship_failures": disagg["ship_failures"],
+        "prefill_tokens_recomputed": disagg["prefill_tokens_recomputed"],
+        "slo": {"colocated": base["slo"], "disaggregated": disagg["slo"]},
+        "note": ("mixed open-loop traffic on dp=2: chatty decode lanes + "
+                 "staggered long-prefill arrivals, colocated vs "
+                 "prefill:1,decode:1 (outputs token-identical; shipped "
+                 "threads admit with cache_source='shipped' and zero "
+                 "prompt re-prefill on the decode pool)"),
+    }
+
+
 def serving_phase(cfg, params, args, quick: bool):
     """Measure the SERVED path end to end: real aiohttp app, real SSE
     clients, agent loop + constrained tool calls (VERDICT r3 next #1;
@@ -1260,12 +1510,15 @@ def scale_phase(args, base_cfg, base_params) -> dict:
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("scenario", nargs="?", default="all",
-                    choices=("all", "speculative", "constrained", "kv_tier"),
+                    choices=("all", "speculative", "constrained", "kv_tier",
+                             "disagg"),
                     help="'speculative' runs ONLY the speculative-decoding "
                          "A/B phase; 'constrained' runs ONLY the on-device "
                          "grammar FSM vs host-mask A/B; 'kv_tier' runs ONLY "
                          "the tiered-KV cold-resume A/B (promote vs "
-                         "re-prefill)")
+                         "re-prefill); 'disagg' runs ONLY the disaggregated "
+                         "prefill/decode A/B (colocated vs "
+                         "prefill:1,decode:1 under mixed open-loop traffic)")
     ap.add_argument("--model", default="llama-3.2-1b")
     ap.add_argument("--quick", action="store_true",
                     help="tiny model + short runs (CI smoke)")
@@ -1283,6 +1536,18 @@ def main() -> None:
     ap.add_argument("--no-scale", action="store_true",
                     help="skip the 1B-int8/3B/8B model-scale phase")
     args = ap.parse_args()
+
+    if args.scenario == "disagg":
+        # dp=2 replicas need 2 devices; on a CPU host force the device
+        # count BEFORE jax initializes (the flag only affects the host
+        # platform — real TPU device sets are untouched)
+        import os as _os
+
+        _flags = _os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in _flags:
+            _os.environ["XLA_FLAGS"] = (
+                _flags + " --xla_force_host_platform_device_count=2"
+            ).strip()
 
     import jax
 
@@ -1381,6 +1646,33 @@ def main() -> None:
         print(json.dumps({
             "metric": f"kv_tier_cold_resume_speedup_{cfg.name}",
             "value": out["resume_ttft_ms"]["speedup"],
+            "unit": "x",
+            "extras": out,
+        }))
+        return
+
+    if args.scenario == "disagg":
+        # bench.py disagg: ONLY the disaggregated prefill/decode A/B
+        out = disagg_phase(
+            cfg, params,
+            n_chatty=4,
+            n_long=3 if args.quick else 4,
+            chatty_prompt=32 if args.quick else 48,
+            chatty_gen=64 if args.quick else 128,
+            long_prompt=513 if args.quick else 2049,
+            long_gen=4 if args.quick else 16,
+            page_size=8 if args.quick else 16,
+            min_prefill_tokens=64 if args.quick else 256,
+        )
+        log(f"disagg: decode TPOT p99 colocated "
+            f"{out['decode_tpot_p99_ms']['colocated']}ms -> "
+            f"disaggregated {out['decode_tpot_p99_ms']['disaggregated']}ms "
+            f"({out['decode_tpot_p99_ms']['improvement']}x), shipped "
+            f"{out['shipped_pages']} pages at {out['ship_mb_s']} MB/s, "
+            f"{out['prefill_tokens_recomputed']} prompt tokens recomputed")
+        print(json.dumps({
+            "metric": f"disagg_decode_tpot_p99_improvement_{cfg.name}",
+            "value": out["decode_tpot_p99_ms"]["improvement"],
             "unit": "x",
             "extras": out,
         }))
@@ -1517,6 +1809,28 @@ def main() -> None:
         f"{kv_tier['resume_ttft_ms']['promote']}ms vs re-prefill "
         f"{kv_tier['resume_ttft_ms']['reprefill']}ms "
         f"({kv_tier['resume_ttft_ms']['speedup']}x)")
+
+    # ---- disaggregated prefill/decode: colocated vs role pools ----------
+    disagg = None
+    if len(jax.devices()) >= 2:
+        disagg = disagg_phase(
+            cfg, params,
+            n_chatty=4,
+            n_long=3 if args.quick else 4,
+            chatty_prompt=32 if args.quick else 48,
+            chatty_gen=64 if args.quick else 128,
+            long_prompt=257 if args.quick else 2049,
+            long_gen=4 if args.quick else 16,
+            page_size=8 if args.quick else 16,
+            min_prefill_tokens=64 if args.quick else 256,
+        )
+        log(f"disagg: decode TPOT p99 colocated "
+            f"{disagg['decode_tpot_p99_ms']['colocated']}ms -> "
+            f"disaggregated "
+            f"{disagg['decode_tpot_p99_ms']['disaggregated']}ms "
+            f"({disagg['decode_tpot_p99_ms']['improvement']}x)")
+    else:
+        log("disagg: skipped (needs >= 2 devices for dp=2 pools)")
 
     # ---- speculative decoding: tool-echo A/B (spec on vs off) ------------
     speculative = speculative_phase(
@@ -1744,6 +2058,7 @@ def main() -> None:
             },
             "shared_prefix": shared_prefix,
             "kv_tier": kv_tier,
+            "disagg": disagg,
             "speculative": speculative,
             "batch_sweep": sweep,
             "fused_depth_ablation": depth_ablation,

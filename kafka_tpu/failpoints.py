@@ -69,6 +69,13 @@ SITES = (
     # slow link.
     "kv.demote",
     "kv.promote",
+    # Cross-replica page shipping (runtime/kv_tier.CrossReplicaPageShipper,
+    # disaggregated prefill/decode): fired once per shipped chunk, so
+    # `error` with nth=2 on a multi-chunk run produces a genuinely TORN
+    # cross-replica copy — the destination frees every partially-written
+    # page and the thread degrades to re-prefill on the decode replica,
+    # never partial KV; `delay` simulates a slow inter-replica link.
+    "kv.ship",
     "worker.dispatch",
     "sandbox.exec",
     "sandbox.boot",

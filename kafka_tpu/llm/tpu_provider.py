@@ -268,6 +268,17 @@ class TPULLMProvider(LLMProvider):
         * ``replicas``: per-replica health state (quarantined replicas
           are capacity the router cannot use), load, KV-page headroom,
           and utilization.
+        * ``pools`` (version 3, ISSUE 12): one entry per role pool —
+          role ("prefill" / "decode", or "colocated" when
+          KAFKA_TPU_DP_ROLES is unset), replica ids, queue depth, batch
+          occupancy, and per-kind MFU / HBM-BW utilization — so the
+          autoscaler can size the prefill pool (compute-bound) and the
+          decode pool (bandwidth-bound) INDEPENDENTLY: grow prefill on
+          prefill-pool queue growth with high prefill MFU, grow decode
+          on decode-pool attainment collapse with high HBM-BW
+          utilization.  ``disagg`` carries the router's ship counters
+          (runs/pages/bytes, failures, fallbacks) when pools are
+          configured, else null.
         * ``anomalies`` (version 2, ISSUE 11): the flight recorder's
           step-cadence detector state — edge-triggered firing counters
           plus the CURRENTLY-ACTIVE list (queue stall, fetch-pipeline
@@ -340,15 +351,49 @@ class TPULLMProvider(LLMProvider):
                 {**a, "replica": a.get("replica", 0)}
                 for a in anomalies["active"]
             ]
+        # Per-pool section (version 3, ISSUE 12): the aggregate snapshot
+        # carries it when role pools are configured; otherwise the whole
+        # fleet is one "colocated" pool so the contract shape is
+        # role-independent.
+        disagg = snap.get("disagg") or {}
+        if disagg.get("pools"):
+            pools = disagg["pools"]
+        else:
+            pools = [{
+                "role": "colocated",
+                "replicas": list(range(len(replicas))),
+                "queue_depth": sum(len(e.waiting) for e in replicas),
+                "active": engine.num_active,
+                "parked": sum(len(e.parked) for e in replicas),
+                "batch_occupancy": occupancy,
+                "utilization": {
+                    kind: {
+                        k: (snap.get("utilization") or {}).get(
+                            kind, {}
+                        ).get(k, 0.0)
+                        for k in ("mfu", "mfu_1m", "hbm_bw_util",
+                                  "hbm_bw_util_1m")
+                    }
+                    for kind in ("prefill", "decode", "verify")
+                },
+            }]
         return {
-            # version 2 (ISSUE 11): + anomalies section, per-replica
-            # anomalies_active, measured-utilization fields under
-            # utilization.* (measured_busy_s / modeled_busy_s /
-            # model_skew / measured_dispatches)
-            "version": 2,
+            # version 3 (ISSUE 12): + pools section (per-role replica
+            # ids, queue depth, occupancy, per-kind MFU/HBM-BW) and the
+            # disagg ship counters.  Version 2 (ISSUE 11) added the
+            # anomalies section, per-replica anomalies_active, and the
+            # measured-utilization fields under utilization.*
+            # (measured_busy_s / modeled_busy_s / model_skew /
+            # measured_dispatches).
+            "version": 3,
             "dp": len(replicas),
             "queue": dict(snap.get("queue") or {}),
             "anomalies": anomalies,
+            "pools": pools,
+            "disagg": {
+                k: v for k, v in disagg.items()
+                if k not in ("pools", "ship_ms")
+            } or None,
             "batch": {
                 "occupancy": occupancy,
                 "occupancy_frac": round(occupancy / max_batch, 4)
@@ -466,7 +511,11 @@ class TPULLMProvider(LLMProvider):
     async def _resize_locked(self, rebuild, dp: int,
                              drain_timeout_s: float) -> bool:
         def _started(e) -> bool:
-            return bool(e.num_active or e.parked or e._pending)
+            # pending disaggregated hand-offs are started work too: their
+            # pages + un-emitted first token complete at step cadence, so
+            # the drain loop below resumes the worker until they clear
+            return bool(e.num_active or e.parked or e._pending
+                        or getattr(e, "handoffs", None))
 
         clean = True
         deadline = time.monotonic() + drain_timeout_s
@@ -754,9 +803,11 @@ class TPULLMProvider(LLMProvider):
                     final = self._finalize(
                         mode, buffered, ev, completion_id, model_id,
                         len(prompt_ids), n_tokens,
-                        # radix prefix-cache share (engine thread wrote it
-                        # at admission, strictly before any token event)
-                        cached_tokens=req.cached_tokens,
+                        # FIRST-admission radix share (frozen at prefill
+                        # start): a preemption or disaggregated-hand-off
+                        # resume re-attaches the whole prefix, which must
+                        # not read as client-saved compute
+                        cached_tokens=req.usage_cached_tokens or 0,
                     )
                     for chunk in final:
                         yield chunk

@@ -54,15 +54,62 @@ import jax
 
 from ..models.config import ModelConfig
 from ..parallel import MeshConfig, make_mesh, resolve_tensor_axes
-from .engine import EngineConfig, GenRequest, InferenceEngine, TokenEvent
-from .metrics import ReplicaSupervisorMetrics
+from .engine import (
+    FINISHED,
+    EngineConfig,
+    GenRequest,
+    InferenceEngine,
+    TokenEvent,
+)
+from .kv_cache import OutOfPagesError
+from .metrics import DisaggMetrics, ReplicaSupervisorMetrics
 from .tracing import add_event
 
 logger = logging.getLogger("kafka_tpu.dp")
 
 QUARANTINE_THRESHOLD_ENV = "KAFKA_TPU_REPLICA_QUARANTINE_THRESHOLD"
+# Quarantine escalation (PR 2 follow-up): after this many quarantine trips
+# the supervisor REBUILDS the replica's engine at window expiry instead of
+# re-admitting it forever (0 disables; default 3).
+REBUILD_THRESHOLD_ENV = "KAFKA_TPU_REPLICA_REBUILD_THRESHOLD"
+# Disaggregated prefill/decode (ISSUE 12): "prefill:P,decode:D" splits the
+# dp fleet into role-specialized pools (P+D must equal dp).  Unset =
+# today's colocated behavior, byte-identical.
+DP_ROLES_ENV = "KAFKA_TPU_DP_ROLES"
+# Prompts whose UNCACHED prefill span is below this many tokens prefill in
+# place on the decode pool — shipping must never cost more than it saves.
+MIN_PREFILL_ENV = "KAFKA_TPU_DISAGG_MIN_PREFILL_TOKENS"
 
 HEALTHY, PROBATION, QUARANTINED = "healthy", "probation", "quarantined"
+
+
+def parse_dp_roles(spec: Optional[str]) -> Optional[Tuple[int, int]]:
+    """Parse ``KAFKA_TPU_DP_ROLES`` ("prefill:2,decode:6") into
+    (n_prefill, n_decode).  None/"" = colocated (no pools).  Repeated
+    role entries add; both pools must end up non-empty."""
+    if not spec:
+        return None
+    counts = {"prefill": 0, "decode": 0}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        role, _, n = part.partition(":")
+        role = role.strip().lower()
+        if role not in counts:
+            raise ValueError(
+                f"unknown pool role {role!r} in {spec!r} (expected "
+                "'prefill:P,decode:D')"
+            )
+        try:
+            counts[role] += int(n)
+        except ValueError:
+            raise ValueError(f"bad replica count in {spec!r}")
+    if counts["prefill"] <= 0 or counts["decode"] <= 0:
+        raise ValueError(
+            f"{spec!r} needs at least one prefill and one decode replica"
+        )
+    return counts["prefill"], counts["decode"]
 
 
 @dataclasses.dataclass
@@ -102,6 +149,9 @@ class DataParallelEngines:
         quarantine_threshold: Optional[int] = None,
         quarantine_window_s: float = 5.0,
         probation_steps: int = 3,
+        rebuild_threshold: Optional[int] = None,
+        dp_roles: Optional[str] = None,
+        disagg_min_prefill_tokens: Optional[int] = None,
     ):
         devices = list(devices if devices is not None else jax.devices())
         per = tp * sp * ep
@@ -125,10 +175,47 @@ class DataParallelEngines:
         self.quarantine_threshold = max(1, quarantine_threshold)
         self.quarantine_window_s = quarantine_window_s
         self.probation_steps = max(1, probation_steps)
+        if rebuild_threshold is None:
+            try:
+                rebuild_threshold = int(
+                    os.environ.get(REBUILD_THRESHOLD_ENV, "3") or 3
+                )
+            except ValueError:
+                rebuild_threshold = 3
+        self.rebuild_threshold = max(0, rebuild_threshold)  # 0 disables
+        # Disaggregated prefill/decode pools (ISSUE 12).  Unset env +
+        # unset param = no pools: every role-gated branch below is one
+        # empty-list check, so the colocated dispatch paths are
+        # byte-identical to before.
+        if dp_roles is None:
+            dp_roles = os.environ.get(DP_ROLES_ENV) or None
+        self._role_spec = parse_dp_roles(dp_roles)
+        if self._role_spec is not None and sum(self._role_spec) != dp:
+            raise ValueError(
+                f"KAFKA_TPU_DP_ROLES={dp_roles!r} names "
+                f"{sum(self._role_spec)} replicas but dp={dp}"
+            )
+        if disagg_min_prefill_tokens is None:
+            try:
+                disagg_min_prefill_tokens = int(
+                    os.environ.get(MIN_PREFILL_ENV, "512") or 512
+                )
+            except ValueError:
+                disagg_min_prefill_tokens = 512
+        self.min_prefill_tokens = max(1, disagg_min_prefill_tokens)
+        self.disagg = DisaggMetrics()
         self.supervisor = ReplicaSupervisorMetrics()
         self.engines: List[InferenceEngine] = []
         self.health: List[ReplicaHealth] = []
         self._build_engines(dp)
+        if self._prefill_pool and self.engines[0].prefix_cache is None:
+            logger.warning(
+                "KAFKA_TPU_DP_ROLES set but the prefix cache is disabled "
+                "— shipped runs have nowhere to register; serving "
+                "colocated"
+            )
+            self._role_spec = None
+            self._assign_roles(dp)
         self._route: Dict[str, int] = {}  # request_id -> replica
         # prefix_key -> replica, LRU-capped: a thread whose cache entry is
         # long evicted shouldn't stay pinned (or leak memory) forever
@@ -150,35 +237,70 @@ class DataParallelEngines:
         self._failed_replica: Optional[int] = None
         self._pre_failure_events: List[TokenEvent] = []
 
-    def _build_engines(self, dp: int) -> None:
+    def _make_engine(self, r: int) -> InferenceEngine:
+        """Build replica r's engine over its device slice (construction
+        and the per-replica rebuild escalation share this)."""
         cfg, engine_cfg = self._cfg, self._engine_cfg
         tp, sp, ep = self._tp, self._sp, self._ep
         per = tp * sp * ep
-        engines: List[InferenceEngine] = []
-        for r in range(dp):
-            slice_devices = self._devices[r * per : (r + 1) * per]
-            # a mesh over exactly this replica's devices pins its params
-            # and KV pool there (the engine places for any provided mesh);
-            # sp>1 replicas run ring-sharded chunked prefill internally
-            tpk, tq = resolve_tensor_axes(
-                tp, cfg.num_kv_heads,
-                cp_strategy=engine_cfg.cp_strategy, sp=sp,
-            )
-            mesh = make_mesh(MeshConfig(sp=sp, tp=tpk, tq=tq, ep=ep),
-                             devices=slice_devices)
-            engine = InferenceEngine(
-                cfg, self._params, engine_cfg,
-                kv_dtype=self._kv_dtype, mesh=mesh,
-            )
-            # traced requests' engine spans carry the replica they ran on
-            engine.replica = r
-            if engine.flight is not None:
-                # postmortems and /debug/flight/{replica} name the replica
-                engine.flight.replica = r
-            engines.append(engine)
+        slice_devices = self._devices[r * per : (r + 1) * per]
+        # a mesh over exactly this replica's devices pins its params
+        # and KV pool there (the engine places for any provided mesh);
+        # sp>1 replicas run ring-sharded chunked prefill internally
+        tpk, tq = resolve_tensor_axes(
+            tp, cfg.num_kv_heads,
+            cp_strategy=engine_cfg.cp_strategy, sp=sp,
+        )
+        mesh = make_mesh(MeshConfig(sp=sp, tp=tpk, tq=tq, ep=ep),
+                         devices=slice_devices)
+        engine = InferenceEngine(
+            cfg, self._params, engine_cfg,
+            kv_dtype=self._kv_dtype, mesh=mesh,
+        )
+        # traced requests' engine spans carry the replica they ran on
+        engine.replica = r
+        if engine.flight is not None:
+            # postmortems and /debug/flight/{replica} name the replica
+            engine.flight.replica = r
+        return engine
+
+    def _build_engines(self, dp: int) -> None:
         self.dp = dp
-        self.engines = engines
+        self.engines = [self._make_engine(r) for r in range(dp)]
         self.health = [ReplicaHealth() for _ in range(dp)]
+        self._assign_roles(dp)
+
+    def _assign_roles(self, dp: int) -> None:
+        """Map the parsed role spec onto replica indices: the first P
+        replicas form the prefill pool, the rest decode.  A rebuild to a
+        dp the spec cannot cover keeps the prefill count and flexes the
+        decode pool, or degrades to colocated when even that cannot fit
+        (construction validates exactly; this lenient path is for
+        /admin/resize)."""
+        spec = self._role_spec
+        if spec is not None:
+            n_pre, n_dec = spec
+            if n_pre + n_dec != dp:
+                if dp > n_pre:
+                    n_dec = dp - n_pre
+                    logger.warning(
+                        "dp=%d != prefill:%d+decode:%d; decode pool "
+                        "resized to %d", dp, n_pre, spec[1], n_dec,
+                    )
+                else:
+                    logger.warning(
+                        "dp=%d cannot fit prefill:%d,decode:%d pools; "
+                        "serving colocated", dp, n_pre, n_dec,
+                    )
+                    spec = None
+        if spec is None:
+            self._prefill_pool: List[int] = []
+            self._decode_pool: List[int] = []
+        else:
+            self._prefill_pool = list(range(n_pre))
+            self._decode_pool = list(range(n_pre, n_pre + n_dec))
+        self._prefill_set = set(self._prefill_pool)
+        self._decode_set = set(self._decode_pool)
 
     # -- engine-like surface (llm/worker.EngineWorker compatible) --------
 
@@ -196,7 +318,9 @@ class DataParallelEngines:
 
     @property
     def has_work(self) -> bool:
-        return any(e.has_work for e in self.engines)
+        # pending hand-offs count: their ship + requeue happens at step
+        # cadence even when no engine has dispatchable work left
+        return any(e.has_work or e.handoffs for e in self.engines)
 
     @property
     def waiting(self) -> List[GenRequest]:
@@ -205,15 +329,80 @@ class DataParallelEngines:
     # -- supervision -----------------------------------------------------
 
     def _refresh_health(self, now: Optional[float] = None) -> None:
-        """Expire quarantine windows: quarantined -> probation."""
+        """Expire quarantine windows: quarantined -> probation — or, past
+        the rebuild threshold, quarantined -> REBUILT engine on probation
+        (quarantine escalation, PR 2 follow-up): a replica that keeps
+        tripping the breaker is not re-admitted forever, its engine is
+        re-created from scratch."""
         now = time.monotonic() if now is None else now
         for i, h in enumerate(self.health):
             if h.state == QUARANTINED and now >= h.quarantined_until:
+                if (
+                    self.rebuild_threshold > 0
+                    and h.quarantine_count >= self.rebuild_threshold
+                    and self._rebuild_replica(i)
+                ):
+                    continue
                 h.state = PROBATION
                 h.probation_successes = 0
                 logger.warning(
                     "replica %d quarantine window expired; on probation", i
                 )
+
+    def _rebuild_replica(self, i: int) -> bool:
+        """Re-create one replica's engine after repeated quarantines.
+
+        Only safe when the replica holds no STARTED work (started lanes
+        own device state the new engine cannot adopt); failure recovery
+        and waiting-migration normally guarantee that by the time the
+        quarantine window expires — if not, the escalation is skipped
+        and the replica re-enters on probation as before.  WAITING
+        requests (stragglers that arrived between migrations) carry over
+        to the fresh engine.  The rebuilt engine is COLD: its first
+        dispatches pay the XLA compile (the persistent compile cache
+        makes that a disk load in steady deployments)."""
+        old = self.engines[i]
+        if old.num_active or old.parked or old._pending or old.handoffs:
+            logger.warning(
+                "replica %d rebuild skipped: still holds started work", i
+            )
+            return False
+        trips = self.health[i].quarantine_count
+        pending = old.take_waiting()
+        try:
+            engine = self._make_engine(i)
+        except Exception:
+            logger.exception(
+                "replica %d engine rebuild FAILED; re-admitting the old "
+                "engine on probation", i,
+            )
+            for req in pending:
+                old.adopt(req)
+            return False
+        # the replica's counter families (requests/tokens/SLO/histograms)
+        # carry over: they export as summed Prometheus counters across
+        # replicas, and a one-replica reset mid-serving would read as a
+        # partial counter decrease — rate()/increase() poison — unlike
+        # the full-topology rebuild() where every replica resets at once.
+        # The fresh engine re-applies its roofline on the first dispatch
+        # it records (the PR 10 reset rule), so transplanting is safe.
+        engine.metrics = old.metrics
+        self.engines[i] = engine
+        for req in pending:
+            engine.adopt(req)
+        # fresh engine, fresh record: backoff and trip count restart, but
+        # it still proves itself on probation before turning healthy
+        self.health[i] = ReplicaHealth(state=PROBATION)
+        # per-replica prefix-cache generations restarted at 0: memoized
+        # probe entries for the old engine must not validate against them
+        self._probe_memo.clear()
+        self.supervisor.replica_rebuilds += 1
+        logger.error(
+            "replica %d engine REBUILT after %d quarantine trip(s); "
+            "on probation (%d waiting request(s) carried over)",
+            i, trips, len(pending),
+        )
+        return True
 
     def _routable_indices(self) -> List[int]:
         self._refresh_health()
@@ -297,7 +486,18 @@ class DataParallelEngines:
                 self.engines[i].adopt(req)
             return
         for req in sorted(taken, key=lambda r: r.submit_time):
-            j = min(targets, key=lambda t: (
+            cands = targets
+            if self._prefill_pool:
+                # role pools: prefer same-role targets; a hand-off with
+                # no prefill replica left degrades to colocated service
+                pool = (self._prefill_set if req.handoff
+                        else self._decode_set)
+                same = [j for j in targets if j in pool]
+                if same:
+                    cands = same
+                elif req.handoff:
+                    req.handoff = False
+            j = min(cands, key=lambda t: (
                 self.engines[t].num_active + len(self.engines[t].waiting)
                 + len(self.engines[t].parked)
             ))
@@ -345,13 +545,50 @@ class DataParallelEngines:
         too — a replica holding a thread's demoted KV is routable
         affinity (promotion is cheaper than re-prefill), so an idle
         thread's return still steers to the replica that can re-
-        materialize it."""
+        materialize it.
+
+        With role pools configured (KAFKA_TPU_DP_ROLES, ISSUE 12) the
+        DECODE pool is every thread's home — affinity and prefix probes
+        run over it — and a keyed request whose uncached prefill span is
+        at least KAFKA_TPU_DISAGG_MIN_PREFILL_TOKENS routes to the
+        least-loaded PREFILL replica as a prefill-and-hand-off instead
+        (the router ships its pages to the decode home at first-token
+        time).  Shorter prompts prefill in place on the decode pool:
+        shipping must never cost more than it saves."""
         routable = self._routable_indices()
+        if not self._prefill_pool:
+            return self._pick_within(req, routable)
+        decode_routable = [i for i in routable if i in self._decode_set]
+        prefill_routable = [i for i in routable if i in self._prefill_set]
+        if not decode_routable:
+            # decode pool fully quarantined: degraded colocated service
+            # on whatever is routable beats refusing traffic
+            decode_routable = routable
+        home = self._pick_within(req, decode_routable)
+        if req.prefix_key is None or not prefill_routable:
+            return home
+        if self.engines[home].prefix_cache is None:
+            return home
+        # memoized probe (shared with _pick_within's routing probe): a
+        # warm fan-out head costs O(1) here instead of a second full
+        # radix walk per submit on the engine thread
+        cached = self._probe_matches([home], req.prompt_ids)[home]
+        if len(req.prompt_ids) - cached < self.min_prefill_tokens:
+            self.disagg.prefill_in_place += 1
+            return home
+        req.handoff = True
+        return min(prefill_routable, key=self._load)
+
+    def _pick_within(self, req: GenRequest, routable: List[int]) -> int:
+        """The prefix/affinity/load selection of _pick, over an explicit
+        candidate set (the whole routable fleet when colocated; the
+        decode pool when role pools are configured)."""
+        allowed = set(routable)
         pin: Optional[int] = None
         if req.prefix_key is not None:
             hit = self._affinity.get(req.prefix_key)
             if hit is not None and hit < len(self.engines):
-                if self.health[hit].routable:
+                if hit in allowed:
                     pin = hit
                 else:
                     # pinned replica is quarantined/dead: re-steer the
@@ -464,13 +701,32 @@ class DataParallelEngines:
         idx = self._pick(req)
         self.engines[idx].submit(req)  # may raise: record routes only after
         self._route[req.request_id] = idx
-        if req.prefix_key is not None:
+        if req.prefix_key is not None and not req.handoff:
+            # hand-off requests pin their affinity at requeue time, to
+            # the DECODE home — never to the transient prefill replica
             self._set_affinity(req.prefix_key, idx)
 
     def cancel(self, request_id: str, reason: str = "cancelled") -> bool:
         idx = self._route.pop(request_id, None)
         if idx is None:
             return False
+        # A request parked in an engine's hand-off list (prefill done,
+        # ship + requeue pending) is in NEITHER engine's _requests — an
+        # engine-level cancel would return False and the next step's
+        # drain would resurrect the cancelled stream as an orphan
+        # decoding into the void.  Retire it here: its pages free and
+        # the hand-off never completes.
+        for e in self.engines:
+            for pair in e.handoffs:
+                if pair[0].request_id == request_id:
+                    e.handoffs.remove(pair)
+                    req = pair[0]
+                    if req.seq is not None:
+                        e.pool.free_sequence(req.seq)
+                        req.seq = None
+                    req.state = FINISHED
+                    req.finish_reason = reason
+                    return True
         return self.engines[idx].cancel(request_id, reason=reason)
 
     def step(self) -> List[TokenEvent]:
@@ -491,15 +747,195 @@ class DataParallelEngines:
                     self._pre_failure_events = events
                     self._note_failure(i)
                     raise
+        # Prefill-and-hand-off completions (disaggregated serving): ship
+        # each finished prefill's page run to its decode home and requeue
+        # the thread there.  The first token emits as an ordinary
+        # (non-terminal) event — the client stream continues seamlessly
+        # on the decode pool.  Drained for EVERY engine, routable or not
+        # (a replica quarantined after producing a hand-off must not
+        # strand the thread).
+        for i, e in enumerate(self.engines):
+            if e.handoffs:
+                pending, e.handoffs = e.handoffs, []
+                for req, tok in pending:
+                    # the ENGINE OBJECT rides along: a quarantine-
+                    # escalation rebuild inside _complete_handoff's own
+                    # health refresh can swap engines[i] mid-drain, and
+                    # the ship must gather from the pool that actually
+                    # holds the request's pages
+                    events.append(self._complete_handoff(i, e, req, tok))
         for ev in events:
             if ev.finished:
                 self._route.pop(ev.request_id, None)
         return events
 
+    # -- disaggregated prefill/decode (ISSUE 12) -------------------------
+
+    def _complete_handoff(self, src: int, src_e: InferenceEngine,
+                          req: GenRequest, token: int) -> TokenEvent:
+        """Steer a finished prefill-and-hand-off to its decode home:
+        ship the page run, requeue the request there (preemption-style
+        resume — the re-prefill's sampled token is the deterministic
+        duplicate of `token` and is dropped), and emit the first token.
+        Every failure path degrades to re-prefill on the destination,
+        never to a lost stream or partial KV."""
+        self.disagg.handoffs += 1
+        routable = self._routable_indices()
+        decode_routable = [i for i in routable if i in self._decode_set]
+        cands = (
+            decode_routable
+            or [i for i in routable if i != src]
+            or routable
+        )
+        dst = self._pick_within(req, cands)
+        attrs: Dict[str, Any] = {"shipped": False}
+        if self.engines[dst] is src_e:
+            # sole-survivor fallback: the local store in the engine's
+            # hand-off path already cached the run here — the resume
+            # hits it as an ordinary own-thread prefix, zero re-prefill
+            self.disagg.ship_skips += 1
+        elif req.seq is not None:
+            attrs = self._ship_run(src_e, dst, req)
+        if req.seq is not None:
+            # cache retains (local store + shipped registration) keep
+            # every shared page alive; the sequence's own references go
+            # back to the source pool
+            src_e.pool.free_sequence(req.seq)
+            req.seq = None
+        add_event(req.trace, "handoff",
+                  {"from_replica": src, "to_replica": dst, **attrs})
+        req.handoff = False
+        req.resumed = True
+        req.prefill_ids = req.prompt_ids + req.output_ids[:-1]
+        req.prefill_allowed = None
+        self.engines[dst].adopt(req)
+        self._route[req.request_id] = dst
+        if req.prefix_key is not None:
+            self._set_affinity(req.prefix_key, dst)
+        return TokenEvent(req.request_id, token)
+
+    def _ship_run(self, src_e: InferenceEngine, dst: int, req: GenRequest,
+                  ) -> Dict[str, Any]:
+        """Move the hand-off's whole-page run from replica `src` into
+        replica `dst`'s pool and register it in dst's radix prefix cache
+        (cache_source="shipped").  Returns the handoff event attrs.
+
+        Delta shipping: pages the destination already caches (the shared
+        fan-out head) are skipped — store() descends the matched runs
+        without touching the dummy page entries passed for them.  The
+        probe is exact (same thread, no tree mutation in between), but a
+        destination KV tier counts HOST-RESIDENT runs as matched while
+        store() would ADOPT the incoming page ids for them, so the delta
+        path is gated on the destination having no tier.
+
+        Torn-copy semantics: ship() raising leaves the destination pages
+        partially written — they are freed in full (freshly allocated,
+        shared with nobody: complete cleanup), the failure is counted in
+        disagg_ship_failures, and the thread re-prefills on the decode
+        replica.  Never partial KV."""
+        from .kv_tier import CrossReplicaPageShipper
+
+        dst_e = self.engines[dst]
+        cache = dst_e.prefix_cache
+        ps = src_e.ecfg.page_size
+        tokens = (req.prompt_ids + req.output_ids)[: req.seq.length]
+        n_full = min(len(req.seq.pages), len(tokens) // ps)
+        if cache is None or n_full == 0 or req.prefix_key is None:
+            self.disagg.ship_skips += 1
+            return {"shipped": False}
+
+        def probe_skip() -> int:
+            if dst_e.kv_tier is not None:
+                return 0
+            return min(cache.match_tokens(tokens) // ps, n_full)
+
+        skip = probe_skip()
+        if skip >= n_full:
+            # destination already warm (shared prefix): nothing to copy
+            self.disagg.ship_skips += 1
+            return {"shipped": False, "already_cached_pages": n_full}
+        n_ship = n_full - skip
+        if dst_e.pool.free_pages < n_ship:
+            cache.reclaim(n_ship)
+            # reclaim may have evicted the very runs the skip was
+            # measured against — the dummy page entries below stand in
+            # for runs store() DESCENDS, so the skip must only shrink to
+            # match what is still present (a grown n_ship that no longer
+            # fits simply fails the alloc and degrades to re-prefill)
+            skip = min(skip, probe_skip())
+            n_ship = n_full - skip
+        try:
+            dest = dst_e.pool.alloc(n_ship)
+        except OutOfPagesError:
+            self.disagg.ship_skips += 1
+            return {"shipped": False, "dest_pages_short": n_ship}
+        shipper = CrossReplicaPageShipper(src_e, dst_e, ps)
+        t0 = time.monotonic()
+        try:
+            nbytes = shipper.ship(req.seq.pages[skip:n_full], dest)
+        except Exception as e:
+            dst_e.pool.release(dest)
+            self.disagg.ship_failures += 1
+            logger.warning(
+                "cross-replica ship of %d pages (%s -> replica %d) "
+                "failed: %s — degrading to re-prefill", n_ship,
+                req.request_id, dst, e,
+            )
+            return {"shipped": False, "ship_error": str(e)}
+        dur = time.monotonic() - t0
+        # register, then drop the alloc reference: the cache's retains
+        # keep the registered suffix alive; duplicate pages (runs the
+        # store walk matched after all) free here
+        cache.store(req.prefix_key, tokens[:n_full * ps],
+                    [-1] * skip + list(dest), shipped=True)
+        dst_e.pool.release(dest)
+        self.disagg.record_ship(n_ship, nbytes, dur)
+        return {
+            "shipped": True,
+            "shipped_pages": n_ship,
+            "shipped_bytes": nbytes,
+            "already_cached_pages": skip,
+        }
+
+    def warmup_disagg(self) -> None:
+        """Compile the cross-replica ship (gather/scatter) programs
+        outside serving — without this the first hand-off pays an XLA
+        compile on the scheduler thread.  Warmed against the trash page
+        on both ends (gathers read garbage, scatters write garbage INTO
+        the destination trash page — its contract; no pool state
+        changes).  Gathers compile per SOURCE replica and scatters per
+        DESTINATION replica, so one pass over each pool edge covers
+        every (prefill, decode) pair.  No-op without role pools."""
+        if not self._prefill_pool:
+            return
+        from .kv_tier import SHIP_BUCKETS, CrossReplicaPageShipper
+
+        d0, p0 = self._decode_pool[0], self._prefill_pool[0]
+        pairs = [(p, d0) for p in self._prefill_pool] + [
+            (p0, d) for d in self._decode_pool
+        ]
+        ps = self.engines[0].ecfg.page_size
+        for s, d in pairs:
+            shipper = CrossReplicaPageShipper(
+                self.engines[s], self.engines[d], ps
+            )
+            for b in SHIP_BUCKETS:
+                shipper.ship([0] * b, [0] * b)  # TRASH_PAGE both ends
+
     def run_to_completion(self) -> Dict[str, GenRequest]:
-        done: Dict[str, GenRequest] = {}
+        """Drain all requests (testing/bench convenience) — driven
+        through the ROUTER's step loop, not per-engine draining:
+        supervision and hand-off completion only run here, and a
+        prefill-and-hand-off drained engine-by-engine would strand its
+        continuation."""
+        registry: Dict[str, GenRequest] = {}
         for e in self.engines:
-            done.update(e.run_to_completion())
+            registry.update(e._requests)
+        done: Dict[str, GenRequest] = {}
+        while self.has_work:
+            for ev in self.step():
+                if ev.finished and ev.request_id in registry:
+                    done[ev.request_id] = registry[ev.request_id]
         return done
 
     def recover_from_failure(self) -> List[TokenEvent]:
@@ -550,7 +986,7 @@ class DataParallelEngines:
         Started lanes own device state that cannot move across engines."""
         self.validate_dp(dp)
         for i, e in enumerate(self.engines):
-            if e.num_active or e.parked or e._pending:
+            if e.num_active or e.parked or e._pending or e.handoffs:
                 raise RuntimeError(
                     f"cannot rebuild: replica {i} still holds started "
                     "work (drain or cancel it first)"
@@ -565,7 +1001,16 @@ class DataParallelEngines:
         self._route.clear()
         self._probe_memo.clear()
         for req in sorted(pending, key=lambda r: r.submit_time):
-            j = min(range(dp), key=lambda t: len(self.engines[t].waiting))
+            cands: List[int] = list(range(dp))
+            if self._prefill_pool:
+                # role pools survive the resize (re-derived for the new
+                # dp by _assign_roles): hand-offs requeue on the prefill
+                # pool, everything else on its decode home pool
+                cands = (self._prefill_pool if req.handoff
+                         else self._decode_pool)
+            elif req.handoff:
+                req.handoff = False  # pools dissolved in the resize
+            j = min(cands, key=lambda t: len(self.engines[t].waiting))
             self.engines[j].adopt(req)
             self._route[req.request_id] = j
             if req.prefix_key is not None:
@@ -856,9 +1301,62 @@ class _AggregateMetrics:
             agg["flight"] = {
                 k: sum(f[k] for f in flights) for k in flights[0]
             }
+        # Disaggregated prefill/decode (ISSUE 12, DISAGG_METRIC_KEYS):
+        # router-owned ship counters + the ship-latency histogram,
+        # reported once (one router per process), plus a per-pool section
+        # (role, replica ids, queue/occupancy, per-kind MFU/HBM-BW) so
+        # the autoscaler can size the pools independently.  Absent when
+        # role pools are not configured — the colocated exposition is
+        # byte-identical to before.
+        router = self._router
+        if router._prefill_pool:
+            pools: List[Dict[str, Any]] = []
+            for role, idxs in (("prefill", router._prefill_pool),
+                               ("decode", router._decode_pool)):
+                rows = [snaps[i] for i in idxs if i < len(snaps)]
+                util: Dict[str, Any] = {}
+                for kind in UTILIZATION_KINDS:
+                    krs = [r["utilization"][kind] for r in rows
+                           if "utilization" in r]
+                    fl = sum(x["flops"] for x in krs)
+                    hb = sum(x["hbm_bytes"] for x in krs)
+                    bs = sum(x["busy_s"] for x in krs)
+                    w1f = sum(x["window_1m"]["flops"] for x in krs)
+                    w1b = sum(x["window_1m"]["hbm_bytes"] for x in krs)
+                    w1s = sum(x["window_1m"]["busy_s"] for x in krs)
+                    util[kind] = {
+                        # per-chip ratios over the pool's replica-seconds
+                        "mfu": round(fl / (bs * peak_f), 4)
+                        if bs > 0 and peak_f else 0.0,
+                        "hbm_bw_util": round(hb / (bs * peak_b), 4)
+                        if bs > 0 and peak_b else 0.0,
+                        "mfu_1m": round(w1f / (w1s * peak_f), 4)
+                        if w1s > 0 and peak_f else 0.0,
+                        "hbm_bw_util_1m": round(w1b / (w1s * peak_b), 4)
+                        if w1s > 0 and peak_b else 0.0,
+                    }
+                occ = [r["decode"]["batch_occupancy"] for r in rows
+                       if "decode" in r]
+                pools.append({
+                    "role": role,
+                    "replicas": list(idxs),
+                    "queue_depth": sum(
+                        len(router.engines[i].waiting) for i in idxs
+                    ),
+                    "active": sum(
+                        router.engines[i].num_active for i in idxs
+                    ),
+                    "parked": sum(
+                        len(router.engines[i].parked) for i in idxs
+                    ),
+                    "batch_occupancy": round(
+                        sum(occ) / len(occ), 3
+                    ) if occ else 0.0,
+                    "utilization": util,
+                })
+            agg["disagg"] = {**router.disagg.snapshot(), "pools": pools}
         # replica-lifecycle observability: per-replica health gauges +
         # the supervisor counter family (quarantine/re-admit/migration)
-        router = self._router
         agg["replica_supervisor"] = {
             "health": [h.gauge() for h in router.health],
             "states": [h.state for h in router.health],

@@ -329,6 +329,24 @@ class GenRequest:
     # (runtime/kv_tier.py) rather than found in HBM — rides out on the
     # engine.prefill span so a resume-without-re-prefill is provable.
     promoted_tokens: int = 0
+    # The FIRST admission's radix share, frozen at the first prefill
+    # start (usage.prompt_tokens_details.cached_tokens reads this).
+    # cached_tokens above tracks the LATEST attach — a preemption or
+    # disaggregated-hand-off resume re-attaches the whole materialized
+    # prefix, which is scheduler bookkeeping, not compute the client
+    # saved: without the split, every shipped thread would bill ~its
+    # entire prompt as "cached" on a cold first turn.
+    usage_cached_tokens: Optional[int] = None
+    # Disaggregated prefill/decode (ISSUE 12): a prefill-and-hand-off
+    # request terminates at its FIRST token with its pages kept — the
+    # engine parks (request, token) on `engine.handoffs` instead of
+    # emitting a terminal event, and the DP router ships the page run to
+    # a decode-pool replica and requeues the request there (preemption-
+    # style resume: the re-prefill's sampled token is the deterministic
+    # duplicate of the already-emitted first token and is dropped).
+    # Only the router sets this, and only for prefix-keyed requests —
+    # the radix cache is what names the shipped run at the destination.
+    handoff: bool = False
     # Off-slot (parked) admission: the prefill's sampled token as a device
     # scalar, held until a decode slot frees and seeds _d_last at seating.
     # None for resumed parked lanes — their pending token is host-known
@@ -808,6 +826,12 @@ class InferenceEngine:
         # complete output_ids while unconstrained lanes stay pipelined.
         self._constrained_fetch: Optional[_Fetch] = None
         self._out_events: List[TokenEvent] = []
+        # Prefill-and-hand-off completions (disaggregated serving):
+        # (request, first_token) pairs whose prefill finished with their
+        # pages retained, awaiting the DP router's ship + requeue.  The
+        # router drains this every step; a single engine never populates
+        # it (GenRequest.handoff is router-set only).
+        self.handoffs: List[Tuple[GenRequest, int]] = []
         if (
             self.ecfg.prefix_cache_pages is not None
             and self.ecfg.prefix_cache_pages < 0
@@ -1553,6 +1577,13 @@ class InferenceEngine:
             # it can wrap the JSON up before tokens run out
             req.logits_mask_fn.set_budget(req.max_new_tokens)
         req.prefill_ids = list(req.prompt_ids)
+        if req.handoff and (
+            req.prefix_key is None or self.prefix_cache is None
+        ):
+            # a hand-off run is named by the radix cache at both ends;
+            # without a key (or cache) there is nothing to register —
+            # serve the request in place instead
+            req.handoff = False
         if (
             self.ecfg.speculative_k > 0
             and (req.logits_mask_fn is None or req.grammar is not None)
@@ -1820,8 +1851,17 @@ class InferenceEngine:
             self._dispatch_decode()
             self._drain(block=False)
         if not self.num_active and not self.waiting and self._pending:
-            # nothing left to dispatch: flush the pipeline
-            self._drain(block=True)
+            # Nothing left to dispatch: flush the pipeline — EXCEPT when
+            # the pending work is a prefill-and-hand-off.  The DP router
+            # drives every replica from ONE thread, and a prefill-pool
+            # replica blocking here would stall every other replica's
+            # dispatch cadence for the full chunk compute — exactly the
+            # interference disaggregation exists to remove.  Hand-off
+            # entries drain non-blocking on a later step (has_work spans
+            # them, so the drive loop keeps coming back).
+            if not any(r.handoff and r.state == DRAINING
+                       for r in self._requests.values()):
+                self._drain(block=True)
         if not self.num_active:
             self.metrics.mark_idle()  # idle gaps are not TPOT
             self._last_ready_t = None  # measured-latency chain restarts
@@ -2343,6 +2383,26 @@ class InferenceEngine:
         else:
             self._out_events.append(TokenEvent(req.request_id, token))
             return
+        if reason == "handoff":
+            # Prefill-and-hand-off (disaggregated serving): the request
+            # leaves this engine with its pages intact — the DP router
+            # ships the run to a decode replica and requeues the request
+            # there, so no terminal event and no SLO verdict here (the
+            # decode replica finalizes with the true finish).  The run IS
+            # stored into this replica's radix cache first: a fan-out
+            # shared prefix stays warm on the prefill pool, and the
+            # cache's retains keep the pages alive through the ship even
+            # after the router frees the sequence.
+            req.state = FINISHED
+            if req.seq is not None and self.prefix_cache is not None:
+                self.prefix_cache.store(
+                    req.prefix_key,
+                    (req.prompt_ids + req.output_ids)[: req.seq.length],
+                    req.seq.pages,
+                )
+            self._requests.pop(req.request_id, None)
+            self.handoffs.append((req, token))
+            return
         req.finish_reason = reason
         req.state = FINISHED
         self._finalize_slo(req, reason)
@@ -2595,12 +2655,23 @@ class InferenceEngine:
                     req.t_prefill_start - req.submit_time,
                     attrs=self._tattrs(depth=len(self.waiting)),
                 )
+        elif req.trace is not None:
+            # re-prefill after preemption or a disaggregated hand-off: an
+            # instant event carrying the radix-cache share, so a shipped
+            # thread's zero-re-prefill admission (cache_source="shipped")
+            # is provable from its trace
+            add_event(req.trace, "resume", self._prefill_attrs(req))
         req.seq = req.seq or SequencePages(seq_id=req.request_id)
         self.pool.ensure_capacity(req.seq, len(req.prefill_ids) + 1)
         if req.cached_tokens and self.prefix_cache is not None:
             # the attach survived the page gate: NOW the hit counts (a
             # blocked head's repeated lookups never did — see commit_hit)
             self.prefix_cache.commit_hit(req.cached_tokens, req.cache_source)
+        if req.usage_cached_tokens is None:
+            # freeze the FIRST admission's share for usage reporting —
+            # resume re-attaches (preemption / hand-off) must not bill
+            # the re-attached prefix as client-saved compute
+            req.usage_cached_tokens = req.cached_tokens
         # constrained decoding: the mask depends only on output_ids, which
         # is constant across prefill chunks — build it once.  Grammar
         # lanes derive the row from the compiled table (identical to the
@@ -2962,6 +3033,11 @@ class InferenceEngine:
             return "length"
         if req.seq is not None and req.seq.length + 1 >= self.ecfg.max_window:
             return "length"
+        if req.handoff:
+            # prefill-and-hand-off: terminate at the first token (checked
+            # AFTER the genuine limits — a 1-token request finishes for
+            # real and never pays a ship)
+            return "handoff"
         return None
 
     def _to_draining(self, req: GenRequest) -> None:

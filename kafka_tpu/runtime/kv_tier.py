@@ -342,6 +342,89 @@ class LocalPageShipper(PageShipper):
         return total
 
 
+class CrossReplicaPageShipper:
+    """Ship a page run from one replica's PagePool into another's
+    (disaggregated prefill/decode, ISSUE 12).
+
+    Same bucketed gather/scatter programs as the local tier copies, with
+    the handoff HOST-STAGED: each chunk is gathered out of the source
+    pool, materialized on host (the D2H resolve blocks), and scattered
+    into the destination pool (H2D) — the seam stays transport-agnostic,
+    so an ICI/DMA transport can replace the host staging without touching
+    any caller.  Both pools' scatters donate, so ship() must run on the
+    thread that owns dispatch for BOTH replicas (the DP router's worker
+    thread drives every replica, so this holds by construction).
+
+    Chunks are padded to SHIP_BUCKETS with trash-page slots on both
+    sides: padded gather rows are garbage read out of the source trash
+    page, and their scatter writes land INSIDE the destination trash
+    page, which is garbage by contract.
+
+    Failure semantics: the ``kv.ship`` failpoint fires once per chunk, so
+    an ``error:nth=2`` rule on a multi-chunk run produces a genuinely
+    torn copy — earlier chunks already scattered into the destination.
+    ship() raising means the destination pages are PARTIAL; the caller
+    (dp_router._ship_run) frees every destination page (they were
+    freshly allocated and shared with nobody, so the cleanup is
+    complete) and the thread degrades to re-prefill.
+    """
+
+    def __init__(self, src_owner: Any, dst_owner: Any, page_size: int):
+        self.src = src_owner
+        self.dst = dst_owner
+        self.page_size = page_size
+
+    def bytes_per_page(self) -> int:
+        ps = self.page_size
+        total = 0
+        for pool in (self.src.k_pool, self.src.v_pool):
+            for a in jax.tree.leaves(pool):
+                per_slot = int(np.prod(a.shape[2:])) if a.ndim > 2 else 1
+                total += a.shape[0] * ps * per_slot * a.dtype.itemsize
+        return total
+
+    def ship(self, src_pages: Sequence[int],
+             dest_pages: Sequence[int]) -> int:
+        """Copy `src_pages` (source pool) into `dest_pages` (destination
+        pool), chunk by chunk.  Returns the real (unpadded) bytes moved.
+        Raises on a torn chunk — see class docstring for the cleanup
+        contract."""
+        if len(src_pages) != len(dest_pages):
+            raise ShipError(
+                f"ship of {len(src_pages)} pages into "
+                f"{len(dest_pages)} destination pages"
+            )
+        ps = self.page_size
+        treedef_k = jax.tree.structure(self.dst.k_pool)
+        treedef_v = jax.tree.structure(self.dst.v_pool)
+        off = 0
+        nbytes = 0
+        for padded in _bucketize(len(src_pages)):
+            failpoint("kv.ship")
+            real = min(padded, len(src_pages) - off)
+            sidx = _flat_slots(src_pages[off:off + real], ps, padded)
+            k_rows, v_rows = _gather_rows(
+                self.src.k_pool, self.src.v_pool, jnp.asarray(sidx)
+            )
+            # host staging: materialize the PADDED rows (pad rows are
+            # source-trash garbage that lands in the destination trash
+            # page below), then scatter device-side on the destination
+            k_leaves = [np.asarray(a) for a in jax.tree.leaves(k_rows)]
+            v_leaves = [np.asarray(a) for a in jax.tree.leaves(v_rows)]
+            frac = real / padded
+            nbytes += int(sum(
+                a.nbytes * frac for a in (*k_leaves, *v_leaves)
+            ))
+            didx = _flat_slots(dest_pages[off:off + real], ps, padded)
+            self.dst.k_pool, self.dst.v_pool = _scatter_jit(
+                self.dst.k_pool, self.dst.v_pool, jnp.asarray(didx),
+                jax.tree.unflatten(treedef_k, k_leaves),
+                jax.tree.unflatten(treedef_v, v_leaves),
+            )
+            off += real
+        return nbytes
+
+
 # ---------------------------------------------------------------------------
 # host + disk tiers
 # ---------------------------------------------------------------------------

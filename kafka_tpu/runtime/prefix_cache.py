@@ -93,7 +93,7 @@ class _Node:
     walk still matches through it."""
 
     __slots__ = ("tokens", "pages", "children", "parent", "keys",
-                 "host_run")
+                 "host_run", "shipped")
 
     def __init__(
         self,
@@ -113,6 +113,12 @@ class _Node:
         self.keys: "OrderedDict[str, None]" = OrderedDict()
         # KV-tier run id when demoted (host/disk resident), else None
         self.host_run: Optional[str] = None
+        # True while this run's pages arrived via cross-replica page
+        # shipping (disaggregated prefill/decode) and no local thread
+        # has stored through it yet: the first lookup crossing it
+        # classifies as cache_source="shipped" (the zero-re-prefill
+        # proof), and a normal store() descending it clears the marker.
+        self.shipped = False
 
     def n_pages(self, page_size: int) -> int:
         """Run length in pages regardless of residency."""
@@ -172,6 +178,7 @@ class PrefixCache:
         self.tokens_reused = 0
         self.cross_thread_hits = 0  # hits whose deepest node another thread wrote
         self.host_tier_hits = 0  # hits that promoted at least one tier run
+        self.shipped_hits = 0  # hits crossing a cross-replica-shipped run
         self.evictions = 0  # nodes evicted under pressure (leaf-LRU + budget)
         self.pages_evicted = 0
         self.probes = 0  # read-only match_tokens walks (router memo tests)
@@ -320,6 +327,7 @@ class PrefixCache:
         ps = self.pool.page_size
         pages: List[int] = []
         promoted = 0
+        shipped_any = False
         last_node: Optional[_Node] = None
         # nodes of this walk must not be evicted by promotion's reclaim —
         # their pages are in `pages` but not yet retained by the caller
@@ -332,6 +340,8 @@ class PrefixCache:
                 if not self._promote_node(node, protect):
                     break
                 promoted += take * ps
+            if node.shipped:
+                shipped_any = True
             pages.extend(node.pages[:take])
             last_node = node
         if last_node is None:
@@ -343,7 +353,12 @@ class PrefixCache:
         self._touch(last_node)
         self.pool.retain(pages)
         cached = len(pages) * ps
-        if promoted:
+        if shipped_any:
+            # runs shipped from a prefill-pool replica: the thread's
+            # zero-re-prefill admission on the decode pool is provable
+            # from this classification (disaggregated serving)
+            source = "shipped"
+        elif promoted:
             source = "host_tier"
         elif key is not None and key in last_node.keys:
             source = "own"
@@ -405,16 +420,29 @@ class PrefixCache:
             self.cross_thread_hits += 1
         elif source == "host_tier":
             self.host_tier_hits += 1
+        elif source == "shipped":
+            self.shipped_hits += 1
 
     # -- store -----------------------------------------------------------
 
-    def store(self, key: str, tokens: Sequence[int], pages: Sequence[int]) -> None:
+    def store(self, key: str, tokens: Sequence[int], pages: Sequence[int],
+              shipped: bool = False) -> None:
         """Insert a finished sequence's materialized tokens along its path.
 
         Only whole pages are stored (`tokens` must count exactly the
         materialized KV slots — the engine drops the final sampled token,
         whose KV is never written).  Matched runs keep the cache's
         existing pages; only the unmatched suffix's pages are retained.
+
+        ``shipped=True`` registers a run arriving via cross-replica page
+        shipping (dp_router._ship_run): newly-inserted nodes carry the
+        shipped marker so the thread's first lookup classifies as
+        ``cache_source="shipped"``; a later normal store descending them
+        (the thread's own finish on this replica) clears it.  Matched
+        runs along a shipped registration are NOT re-marked — they are
+        this replica's pre-existing content, and the duplicate shipped
+        pages for them are simply not retained (the caller releases its
+        alloc reference afterwards, freeing them).
         """
         ps = self.pool.page_size
         n_full = min(len(pages), len(tokens) // ps)
@@ -429,6 +457,7 @@ class PrefixCache:
                 self._retain_pages(run_pages)
                 self.generation += 1
                 new = _Node(run_tokens, run_pages, node)
+                new.shipped = shipped
                 self._claim(new, key)
                 node.children[pkey] = new
                 self._n_nodes += 1
@@ -475,6 +504,10 @@ class PrefixCache:
                 self._host_nodes -= 1
                 if not child.children:
                     self._leaves[child] = None
+            if child.shipped and not shipped:
+                # the thread's own finish stored through the shipped run:
+                # it is ordinary cache content from here on
+                child.shipped = False
             self._claim(child, key)
             self._touch(child)
             node = child
@@ -497,6 +530,7 @@ class PrefixCache:
                 return False
             front_run, back_run = parts
         suffix = _Node(node.tokens[take * ps:], node.pages[take:], node)
+        suffix.shipped = node.shipped  # both halves are the shipped run
         suffix.children = node.children
         for c in suffix.children.values():
             c.parent = suffix

@@ -196,6 +196,11 @@ def build_tpu_provider(cfg: ServingConfig) -> LLMProvider:
 
         params = quantize_params(params, model_cfg)
 
+    if cfg.dp_roles and cfg.dp_size <= 1:
+        raise ValueError(
+            "KAFKA_TPU_DP_ROLES needs dp_size > 1: role pools split the "
+            "DP fleet into prefill and decode replicas"
+        )
     if cfg.dp_size > 1:
         if cfg.pp_size > 1:
             raise ValueError(
@@ -217,6 +222,11 @@ def build_tpu_provider(cfg: ServingConfig) -> LLMProvider:
             ep=cfg.ep_size,
             devices=local,
             quarantine_threshold=cfg.replica_quarantine_threshold,
+            rebuild_threshold=cfg.replica_rebuild_threshold,
+            # disaggregated prefill/decode pools (README "Disaggregated
+            # prefill/decode"); None = colocated, byte-identical
+            dp_roles=cfg.dp_roles,
+            disagg_min_prefill_tokens=cfg.disagg_min_prefill_tokens,
         )
     else:
         mesh = None
@@ -342,6 +352,13 @@ def build_tpu_provider(cfg: ServingConfig) -> LLMProvider:
             # demotion/promotion pays copy latency, not an XLA compile on
             # the scheduler thread (no-op when the tier is off)
             e.warmup_kv_tier()
+        # cross-replica ship programs (KAFKA_TPU_DP_ROLES): compile the
+        # per-bucket gather/scatter pairs across the pool edges so the
+        # first prefill-and-hand-off pays copy latency, not an XLA
+        # compile on the scheduler thread (no-op without role pools)
+        warm_disagg = getattr(engine, "warmup_disagg", None)
+        if warm_disagg is not None:
+            warm_disagg()
         engine.run_to_completion()
         engine_cfg.max_waiting = _admission_bound
         for e in engines:

@@ -77,6 +77,18 @@ class ServingConfig:
     pp_size: int = 1
     dp_size: int = 1
     ep_size: int = 1
+    # Disaggregated prefill/decode (KAFKA_TPU_DP_ROLES, README
+    # "Disaggregated prefill/decode"): "prefill:P,decode:D" splits the dp
+    # fleet into role-specialized pools — long prefills run on the
+    # prefill pool and their KV pages ship to a decode-pool replica at
+    # first-token time, protecting decode-lane TPOT from prefill
+    # interference (DistServe/Mooncake).  P+D must equal dp_size.  None
+    # (default) = colocated serving, byte-identical to before.
+    dp_roles: Optional[str] = None
+    # Prompts whose UNCACHED prefill span is below this many tokens
+    # prefill in place on the decode pool (shipping must never cost more
+    # than it saves).  KAFKA_TPU_DISAGG_MIN_PREFILL_TOKENS.
+    disagg_min_prefill_tokens: int = 512
     # long-context CP strategy when sp>1: "ring" or "ulysses"
     cp_strategy: str = "ring"
     # Request-lifecycle hardening (runtime/failpoints.py chaos-tests these
@@ -102,6 +114,11 @@ class ServingConfig:
     #       KAFKA_TPU_SANDBOX_MAX_RESTARTS (sandbox/process.py) — no
     #       config field here, the server never constructs that factory.
     replica_quarantine_threshold: int = 3
+    #   replica_rebuild_threshold — quarantine escalation: after this many
+    #       quarantine TRIPS the supervisor rebuilds the replica's engine
+    #       at window expiry (DataParallelEngines._rebuild_replica)
+    #       instead of re-admitting it forever (0 disables).
+    replica_rebuild_threshold: int = 3
     # Observability (README "Observability"):
     #   trace_sample — fraction of requests traced end to end (span tree in
     #       the /debug/trace ring).  1.0 traces everything (the sampling-
@@ -236,6 +253,11 @@ class ServingConfig:
             pp_size=get_axis("PP", cls.pp_size),
             dp_size=get_axis("DP", cls.dp_size),
             ep_size=get_axis("EP", cls.ep_size),
+            dp_roles=get("DP_ROLES", None),
+            disagg_min_prefill_tokens=get(
+                "DISAGG_MIN_PREFILL_TOKENS",
+                cls.disagg_min_prefill_tokens,
+                lambda v: max(1, int(v))),
             cp_strategy=get("CP_STRATEGY", cls.cp_strategy),
             max_ttft_s=get("MAX_TTFT_S", None, float),
             request_timeout_s=get("REQUEST_TIMEOUT_S", None, float),
@@ -245,6 +267,11 @@ class ServingConfig:
             replica_quarantine_threshold=get(
                 "REPLICA_QUARANTINE_THRESHOLD",
                 cls.replica_quarantine_threshold, int),
+            # clamp negatives to 0 = disabled, same policy as the caches
+            replica_rebuild_threshold=get(
+                "REPLICA_REBUILD_THRESHOLD",
+                cls.replica_rebuild_threshold,
+                lambda v: max(0, int(v))),
             trace_sample=get("TRACE_SAMPLE", cls.trace_sample, float),
             trace_ring=get("TRACE_RING", cls.trace_ring, int),
             slow_ttft_ms=get("SLOW_TTFT_MS", None, float),

@@ -534,15 +534,15 @@ def render_prometheus(snap: Dict[str, Any]) -> str:
         w.family("kafka_tpu_prefix_cache_total", "counter",
                  "Prefix-cache events by kind.")
         for kind in ("hits", "misses", "tokens_reused",
-                     "cross_thread_hits", "host_tier_hits", "evictions",
-                     "pages_evicted"):
+                     "cross_thread_hits", "host_tier_hits",
+                     "shipped_hits", "evictions", "pages_evicted"):
             if kind in pc:
                 w.sample("kafka_tpu_prefix_cache_total", pc[kind],
                          {"kind": kind})
         for idx, rpc in replica_pcs:
             for kind in ("hits", "misses", "tokens_reused",
                          "cross_thread_hits", "host_tier_hits",
-                         "evictions", "pages_evicted"):
+                         "shipped_hits", "evictions", "pages_evicted"):
                 if kind in rpc:
                     w.sample("kafka_tpu_prefix_cache_total", rpc[kind],
                              {"replica": idx, "kind": kind})
@@ -596,6 +596,77 @@ def render_prometheus(snap: Dict[str, Any]) -> str:
             if key in tier:
                 w.sample("kafka_tpu_kv_tier_bytes_total", tier[key],
                          {"dir": label})
+
+    # Disaggregated prefill/decode (runtime/metrics.DISAGG_METRIC_KEYS —
+    # the registry a static test enforces in both files; present only
+    # when KAFKA_TPU_DP_ROLES configures role pools).  Ship counters by
+    # direction-less kind, the torn-copy failure counter the chaos
+    # acceptance keys on, fallback counters, the ship-latency histogram,
+    # and per-pool occupancy gauges the pool-sizing autoscaler reads.
+    disagg = snap.get("disagg") or {}
+    if disagg:
+        for name, key, help_text in (
+            ("kafka_tpu_disagg_shipped_runs_total", "disagg_shipped_runs",
+             "Page runs shipped from prefill-pool to decode-pool "
+             "replicas."),
+            ("kafka_tpu_disagg_shipped_pages_total",
+             "disagg_shipped_pages", "KV pages shipped across replicas."),
+            ("kafka_tpu_disagg_shipped_bytes_total",
+             "disagg_shipped_bytes",
+             "Bytes shipped across replicas (real, unpadded)."),
+            ("kafka_tpu_disagg_ship_failures_total",
+             "disagg_ship_failures",
+             "Torn/failed cross-replica ships (thread degraded to "
+             "re-prefill; never partial KV)."),
+        ):
+            if key in disagg:
+                w.family(name, "counter", help_text)
+                w.sample(name, disagg[key])
+        w.family("kafka_tpu_disagg_fallback_total", "counter",
+                 "Hand-off fallbacks by kind: prefill_in_place = short "
+                 "prompts served colocated on the decode pool; "
+                 "ship_skip = hand-offs completed without a copy "
+                 "(destination warm / no pages / sole survivor).")
+        for key, kind in (("disagg_prefill_in_place", "prefill_in_place"),
+                          ("disagg_ship_skips", "ship_skip")):
+            if key in disagg:
+                w.sample("kafka_tpu_disagg_fallback_total", disagg[key],
+                         {"kind": kind})
+        if "disagg_handoffs" in disagg:
+            w.family("kafka_tpu_disagg_handoffs_total", "counter",
+                     "Prefill-and-hand-off completions (shipped or "
+                     "degraded).")
+            w.sample("kafka_tpu_disagg_handoffs_total",
+                     disagg["disagg_handoffs"])
+        if "ship_ms" in disagg:
+            w.histogram_family(
+                "kafka_tpu_disagg_ship_milliseconds",
+                "Cross-replica page-run ship latency (host-staged "
+                "gather+scatter, per run).",
+                [({}, disagg["ship_ms"])],
+            )
+        pools = disagg.get("pools") or []
+        if pools:
+            # one pass per family so each sample name stays a single
+            # contiguous group (exposition rule, enforced by the parser)
+            w.family("kafka_tpu_disagg_pool_replicas", "gauge",
+                     "Replicas per role pool.")
+            for pool in pools:
+                w.sample("kafka_tpu_disagg_pool_replicas",
+                         len(pool.get("replicas") or []),
+                         {"role": pool.get("role", "")})
+            w.family("kafka_tpu_disagg_pool_queue_depth", "gauge",
+                     "Waiting-queue depth per role pool.")
+            for pool in pools:
+                w.sample("kafka_tpu_disagg_pool_queue_depth",
+                         pool.get("queue_depth", 0),
+                         {"role": pool.get("role", "")})
+            w.family("kafka_tpu_disagg_pool_occupancy", "gauge",
+                     "Mean busy decode slots per step, per role pool.")
+            for pool in pools:
+                w.sample("kafka_tpu_disagg_pool_occupancy",
+                         pool.get("batch_occupancy", 0),
+                         {"role": pool.get("role", "")})
 
     # Flight-recorder anomaly detectors (runtime/metrics.ANOMALY_METRIC_
     # KEYS — the registry a static test enforces in both files).  The
@@ -656,7 +727,8 @@ def render_prometheus(snap: Dict[str, Any]) -> str:
         w.family("kafka_tpu_replica_supervisor_total", "counter",
                  "Replica supervision events.")
         for kind in ("quarantines", "readmits", "waiting_migrated",
-                     "affinity_resteered", "rebuilds"):
+                     "affinity_resteered", "rebuilds",
+                     "replica_rebuilds"):
             if kind in sup:
                 w.sample("kafka_tpu_replica_supervisor_total", sup[kind],
                          {"event": kind})

@@ -108,6 +108,13 @@ EVENTS = (
     "engine.recover",  # engine failure terminated the request
     "anomaly",         # a flight-recorder detector fired on the request's
                        # engine (attrs: kind, detail — flight_recorder.py)
+    "resume",          # re-prefill admission after preemption or a
+                       # disaggregated hand-off; attrs: tokens plus the
+                       # radix share (cached_tokens / cache_source —
+                       # "shipped" proves zero-re-prefill) (engine)
+    "handoff",         # dp_router shipped the thread's prefilled pages to
+                       # a decode replica; attrs: from_replica, to_replica,
+                       # shipped_pages, shipped_bytes, shipped (bool)
 )
 
 

@@ -104,6 +104,9 @@ _HEAVY_TAIL = (
     "test_constrained.py",
     "test_server.py",
     "test_dp_router.py",
+    # disaggregated prefill/decode shares test_dp_router's dp=2 tiny
+    # model and adds cross-replica ship compiles on top
+    "test_disagg.py",
     "test_engine.py",
     # after test_engine: the tier tests share its tiny-model shapes, and
     # running them first would pre-warm the XLA cache under test_engine's

@@ -492,7 +492,10 @@ class TestProbeMemoization:
         dp.submit(GenRequest(request_id="c1", prompt_ids=common + [9] * 8,
                              max_new_tokens=2, prefix_key="t-b"))
         dp.run_to_completion()  # finish -> store new node -> generation bump
-        warm = dp._route["c1"]
+        # routes retire with their requests (run_to_completion drives the
+        # router's own step loop since ISSUE 12); the affinity pin is the
+        # durable record of where the thread landed
+        warm = dp._affinity["t-b"]
         probes0 = dp.engines[warm].prefix_cache.probes
         dp.submit(GenRequest(request_id="c2", prompt_ids=common + [11],
                              max_new_tokens=2, prefix_key="t-c"))
@@ -515,7 +518,7 @@ class TestProbeMemoization:
         dp.submit(GenRequest(request_id="s", prompt_ids=common + deep + [3],
                              max_new_tokens=2, prefix_key="t-s"))
         dp.run_to_completion()  # warm tree: [common p0, common p1, deep]
-        warm = dp._route["s"]
+        warm = dp._affinity["t-s"]
         # diverges at page 3 -> memo records match == run length (16)
         dp.submit(GenRequest(request_id="x",
                              prompt_ids=common + [7] * 8 + [4],
@@ -542,7 +545,7 @@ class TestProbeMemoization:
         dp.submit(GenRequest(request_id="a", prompt_ids=a + [2],
                              max_new_tokens=2, prefix_key="t-a"))
         dp.run_to_completion()
-        warm = dp._route["a"]
+        warm = dp._affinity["t-a"]
         probes0 = sum(e.prefix_cache.probes for e in dp.engines)
         dp.submit(GenRequest(request_id="b", prompt_ids=b + [2],
                              max_new_tokens=2, prefix_key="t-b"))

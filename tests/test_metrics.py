@@ -602,7 +602,7 @@ class TestMetricsEndpoint:
                 s = await client.get("/admin/signals")
                 assert s.status == 200
                 sig = await s.json()
-                assert sig["version"] == 2
+                assert sig["version"] == 3
                 assert sig["dp"] == 1
                 assert set(sig["queue"]) >= {"depth", "peak",
                                              "trend_per_s"}
@@ -637,6 +637,20 @@ class TestMetricsEndpoint:
                     "model_skew",
                 }
                 assert rep["anomalies_active"] == 0
+                # version 3 (ISSUE 12): per-pool section — one
+                # "colocated" pool when KAFKA_TPU_DP_ROLES is unset, so
+                # the contract shape is role-independent
+                assert sig["disagg"] is None
+                (pool,) = sig["pools"]
+                assert pool["role"] == "colocated"
+                assert pool["replicas"] == [0]
+                for key in ("queue_depth", "active", "batch_occupancy"):
+                    assert key in pool, key
+                assert set(pool["utilization"]) == {"prefill", "decode",
+                                                    "verify"}
+                assert set(pool["utilization"]["decode"]) == {
+                    "mfu", "mfu_1m", "hbm_bw_util", "hbm_bw_util_1m",
+                }
                 assert sig["draining"] is False
                 assert sig["admission"]["max_queue_depth"] == 256
             finally:
