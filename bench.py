@@ -1061,6 +1061,260 @@ def disagg_phase(cfg, params, n_chatty: int = 4, n_long: int = 4,
     }
 
 
+def traffic_ramp_phase(cfg, params, n_warm: int = 3, n_ramp: int = 12,
+                       n_post: int = 5, prompt_len: int = 32,
+                       gen_len: int = 28, page_size: int = 8,
+                       seed: int = 23, poll_every_steps: int = 8,
+                       max_steps: int = 20000) -> dict:
+    """Open-loop traffic ramp with the autoscaler loop CLOSED (ISSUE 13)
+    — the ROADMAP's missing proof that the control loop reacts mid-run.
+
+    Timeline: a warm trickle establishes the served TTFT baseline (the
+    SLO target is set at 3x its median, so the target scales with the
+    host instead of hard-coding a wall-clock number); then an open-loop
+    burst arrives faster than one replica can serve — the queue deepens,
+    TTFT blows through the target, and 1m window attainment collapses.
+    The controller (act mode, polled at the driver's cadence — the bench
+    drives the loop inline so the single-writer engine rule holds)
+    observes the collapse through the REAL provider signals contract and
+    scales dp 1 -> 2 through the real rebuild seam: queued requests ride
+    through the rebuild and the post-ramp arrivals meet the target
+    again.  Reported: the decision trace, the attainment timeline the
+    controller saw, and per-arrival-segment attainment computed from
+    client-observed TTFT (warm / ramp / post-action) — the recovery
+    proof is post > ramp.
+
+    The rebuild's XLA compile stall on the fresh replicas is charged to
+    whatever is queued when it happens (honest: that is what a real
+    scale-out costs) — the post-action segment starts only after the
+    resize returns, so its attainment measures the new topology, not
+    the transition."""
+    import jax as _jax
+
+    from kafka_tpu.llm.tpu_provider import TPULLMProvider
+    from kafka_tpu.runtime import EngineConfig, GenRequest
+    from kafka_tpu.runtime.autoscaler import (
+        SCALE_OUT,
+        AutoscalerConfig,
+        AutoscalerController,
+    )
+    from kafka_tpu.runtime.dp_router import DataParallelEngines
+    from kafka_tpu.runtime.metrics import EngineMetrics, configure_slo
+
+    if len(_jax.devices()) < 2:
+        return {"skipped": "traffic_ramp needs >= 2 devices for the "
+                           "dp 1 -> 2 scale-out"}
+
+    rng = random.Random(seed)
+    win_pages = max(4, -(-(prompt_len + gen_len + 2 * page_size)
+                         // page_size))
+    ecfg = EngineConfig(
+        max_batch=2,
+        page_size=page_size,
+        max_pages_per_seq=win_pages,
+        num_pages=(n_warm + n_ramp + n_post + 2) * win_pages + 8,
+        prefill_buckets=(16, max(32, prompt_len)),
+        multi_step=1,
+        fetch_wait_s=0.01,
+        # parked off-slot prefill hides queue wait from TTFT until
+        # max_parked exhausts — at production scale the ramp exhausts
+        # it, at smoke scale disabling it reaches the same overload
+        # regime (queue wait surfaces in TTFT) with 10 requests
+        max_parked=0,
+    )
+    dp = DataParallelEngines(cfg, params, ecfg, dp=1, tp=1)
+
+    class _SignalShim:
+        """The provider's signals()/replica surface over a bare router —
+        the bench drives engines directly (no worker thread), but the
+        controller must consume the REAL /admin/signals contract."""
+
+        autoscaler = None
+
+        def __init__(self, router):
+            self.engine = router
+
+        _replicas = TPULLMProvider._replicas
+        signals = TPULLMProvider.signals
+
+    # -- compile everything the measured run dispatches, outside it ----
+    e0 = dp.engines[0]
+    for j, blen in enumerate((prompt_len, 8)):
+        e0.submit(GenRequest(request_id=f"__w{j}", prompt_ids=[3] * blen,
+                             max_new_tokens=2))
+        e0.run_to_completion()
+    for i in range(2):
+        e0.submit(GenRequest(request_id=f"__wb{i}",
+                             prompt_ids=[3 + i] * prompt_len,
+                             max_new_tokens=3))
+    e0.run_to_completion()
+
+    # -- SLO target: 3x the warm-path TTFT median ----------------------
+    probe_ttfts = []
+    for i in range(2):
+        r = GenRequest(request_id=f"__p{i}",
+                       prompt_ids=make_prompt(rng, prompt_len,
+                                              cfg.vocab_size),
+                       max_new_tokens=4)
+        e0.submit(r)
+        e0.run_to_completion()
+        probe_ttfts.append(r.first_token_time - r.submit_time)
+    target_s = max(0.02, 3.0 * statistics.median(probe_ttfts))
+    configure_slo(ttft_ms=target_s * 1e3)
+    for e in dp.engines:
+        e.metrics = EngineMetrics()
+
+    shim = _SignalShim(dp)
+    events_sink: list = []
+
+    def started(e) -> bool:
+        return bool(e.num_active or e.parked or e._pending or e.handoffs)
+
+    resize_log: list = []
+
+    def resize_fn(dp_target, roles):
+        # the provider's resize_dp drains started lanes with the worker
+        # parked; the bench driver IS the single writer, so the same
+        # drain runs inline at step cadence — waiting requests ride
+        # through the rebuild untouched, exactly the serving-path
+        # semantics
+        deadline = time.monotonic() + 60.0
+        while any(started(e) for e in dp.engines):
+            events_sink.extend(dp.step())
+            if time.monotonic() > deadline:
+                raise RuntimeError("ramp resize drain did not converge")
+        dp.rebuild(dp=dp_target)
+        # warm the fresh engines the way server boot warmup does (the
+        # rebuild built cold engines; an XLA compile mid-serving would
+        # charge the transition cost to the post-action segment and
+        # measure the compiler, not the topology).  run_to_completion
+        # also serves the queued ramp backlog that rode through the
+        # rebuild — those verdicts stay in the ramp segment, where the
+        # overload that delayed them belongs.
+        for n, e in enumerate(dp.engines):
+            for i in range(2):
+                e.submit(GenRequest(
+                    request_id=f"__rw{n}_{i}",
+                    prompt_ids=[3 + i] * prompt_len, max_new_tokens=3,
+                ))
+        dp.run_to_completion()
+        resize_log.append({"dp": dp_target, "t": time.monotonic()})
+        return True
+
+    acfg = AutoscalerConfig(
+        mode="act", interval_s=0.05, min_dp=1, max_dp=2,
+        attain_out=0.9, attain_in=0.98, trend_out=0.5,
+        sustain_out=2, sustain_in=10 ** 6,   # no scale-in mid-phase
+        cooldown_out_s=120.0, cooldown_in_s=10 ** 6,
+        ladder_cooldown_s=10 ** 6, min_window_requests=2,
+    )
+    ctl = AutoscalerController(shim, acfg, resize_fn=resize_fn)
+
+    # -- arrival schedule (open loop, step-indexed) --------------------
+    def mk(i, seg):
+        return GenRequest(
+            request_id=f"{seg}{i}",
+            prompt_ids=make_prompt(rng, prompt_len, cfg.vocab_size),
+            max_new_tokens=gen_len,
+        ), seg
+
+    ramp_start = 12 * n_warm + 6
+    schedule = {}
+    for i in range(n_warm):
+        schedule[12 * i] = mk(i, "warm")
+    for i in range(n_ramp):
+        # one arrival per scheduler step: an open-loop burst well past
+        # one replica's service rate, so queue wait (not service time)
+        # dominates the late arrivals' TTFT
+        schedule[ramp_start + i] = mk(i, "ramp")
+
+    reqs: list = []
+    timeline: list = []
+    step = 0
+    post_scheduled = False
+    from kafka_tpu.runtime.engine import AdmissionError
+
+    while step < max_steps:
+        if step in schedule:
+            req, seg = schedule.pop(step)
+            try:
+                dp.submit(req)
+                reqs.append((req, seg))
+            except AdmissionError:
+                # ladder rung 1 tightened the bound mid-phase: shed
+                # arrivals are part of the story, count them as missed
+                reqs.append((req, seg))
+        if dp.has_work:
+            events_sink.extend(dp.step())
+        step += 1
+        if step >= ramp_start and step % poll_every_steps == 0:
+            d = ctl.poll_once()
+            timeline.append({
+                "step": step,
+                "dp": len(dp.engines),
+                "action": d.action,
+                "cause": d.cause,
+                "attainment_1m": d.inputs.get("attainment_1m"),
+                "queue_depth": d.inputs.get("queue_depth"),
+            })
+        if resize_log and not post_scheduled:
+            post_scheduled = True
+            for i in range(n_post):
+                schedule[step + 4 + 18 * i] = mk(i, "post")
+        if not schedule and not dp.has_work:
+            break
+
+    def seg_attain(seg):
+        rows = [r for r, s in reqs if s == seg]
+        met = [
+            r for r in rows
+            if r.first_token_time is not None
+            and (r.first_token_time - r.submit_time) <= target_s
+        ]
+        return (round(len(met) / len(rows), 3) if rows else None,
+                len(rows))
+
+    warm_a, warm_n = seg_attain("warm")
+    ramp_a, ramp_n = seg_attain("ramp")
+    post_a, post_n = seg_attain("post")
+    acted = ctl.counters["autoscaler_scale_outs"] >= 1
+    decisions = [
+        {k: v for k, v in e.items() if k != "inputs"}
+        for e in ctl.snapshot()["decisions"]
+    ]
+    out = {
+        "acted": acted,
+        "dp": {"before": 1, "after": len(dp.engines)},
+        "resizes": ctl.counters["autoscaler_scale_outs"],
+        "slo_ttft_target_ms": round(target_s * 1e3, 1),
+        "attainment_by_segment": {
+            "warm": {"attainment": warm_a, "requests": warm_n},
+            "ramp_overload": {"attainment": ramp_a, "requests": ramp_n},
+            "post_action": {"attainment": post_a, "requests": post_n},
+        },
+        "final_signals_attainment_1m": (
+            timeline[-1]["attainment_1m"] if timeline else None
+        ),
+        "ladder_final": ctl.state.ladder,
+        "decisions": decisions,
+        "timeline": timeline,
+        "note": ("open-loop ramp on dp=1, act-mode controller polled at "
+                 "driver cadence; scale-out through the real rebuild "
+                 "seam; segment attainment from client-observed TTFT "
+                 "vs a 3x-warm-median target"),
+    }
+    assert acted, f"controller never scaled out: {decisions}"
+    assert ctl.counters["autoscaler_scale_outs"] == 1, \
+        "more than one resize within the cooldown window"
+    assert len(dp.engines) == 2
+    if post_a is not None and ramp_a is not None:
+        assert post_a > ramp_a, (
+            f"attainment did not recover after the controller acted "
+            f"(ramp {ramp_a} -> post {post_a})"
+        )
+    return out
+
+
 def serving_phase(cfg, params, args, quick: bool):
     """Measure the SERVED path end to end: real aiohttp app, real SSE
     clients, agent loop + constrained tool calls (VERDICT r3 next #1;
@@ -1511,14 +1765,17 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("scenario", nargs="?", default="all",
                     choices=("all", "speculative", "constrained", "kv_tier",
-                             "disagg"),
+                             "disagg", "autoscale"),
                     help="'speculative' runs ONLY the speculative-decoding "
                          "A/B phase; 'constrained' runs ONLY the on-device "
                          "grammar FSM vs host-mask A/B; 'kv_tier' runs ONLY "
                          "the tiered-KV cold-resume A/B (promote vs "
                          "re-prefill); 'disagg' runs ONLY the disaggregated "
                          "prefill/decode A/B (colocated vs "
-                         "prefill:1,decode:1 under mixed open-loop traffic)")
+                         "prefill:1,decode:1 under mixed open-loop traffic); "
+                         "'autoscale' runs ONLY the traffic-ramp phase with "
+                         "the autoscaler control loop closed (dp 1 -> 2 "
+                         "mid-run)")
     ap.add_argument("--model", default="llama-3.2-1b")
     ap.add_argument("--quick", action="store_true",
                     help="tiny model + short runs (CI smoke)")
@@ -1537,7 +1794,7 @@ def main() -> None:
                     help="skip the 1B-int8/3B/8B model-scale phase")
     args = ap.parse_args()
 
-    if args.scenario == "disagg":
+    if args.scenario in ("disagg", "autoscale"):
         # dp=2 replicas need 2 devices; on a CPU host force the device
         # count BEFORE jax initializes (the flag only affects the host
         # platform — real TPU device sets are untouched)
@@ -1674,6 +1931,29 @@ def main() -> None:
             "metric": f"disagg_decode_tpot_p99_improvement_{cfg.name}",
             "value": out["decode_tpot_p99_ms"]["improvement"],
             "unit": "x",
+            "extras": out,
+        }))
+        return
+
+    if args.scenario == "autoscale":
+        # bench.py autoscale: ONLY the closed-loop traffic-ramp phase
+        out = traffic_ramp_phase(
+            cfg, params,
+            n_ramp=8 if args.quick else 12,
+            prompt_len=24 if args.quick else 48,
+            gen_len=20 if args.quick else 32,
+            page_size=8 if args.quick else 16,
+        )
+        seg = out.get("attainment_by_segment") or {}
+        log(f"autoscale: acted={out.get('acted')} dp "
+            f"{out.get('dp', {}).get('before')} -> "
+            f"{out.get('dp', {}).get('after')}, attainment ramp "
+            f"{(seg.get('ramp_overload') or {}).get('attainment')} -> "
+            f"post {(seg.get('post_action') or {}).get('attainment')}")
+        print(json.dumps({
+            "metric": f"autoscale_ramp_post_action_attainment_{cfg.name}",
+            "value": (seg.get("post_action") or {}).get("attainment"),
+            "unit": "frac",
             "extras": out,
         }))
         return
@@ -1831,6 +2111,24 @@ def main() -> None:
             f"({disagg['decode_tpot_p99_ms']['improvement']}x)")
     else:
         log("disagg: skipped (needs >= 2 devices for dp=2 pools)")
+
+    # ---- autoscaler: closed-loop traffic ramp (ISSUE 13) -----------------
+    autoscale = None
+    if len(jax.devices()) >= 2:
+        autoscale = traffic_ramp_phase(
+            cfg, params,
+            n_ramp=8 if args.quick else 12,
+            prompt_len=24 if args.quick else 48,
+            gen_len=20 if args.quick else 32,
+            page_size=8 if args.quick else 16,
+        )
+        _seg = autoscale.get("attainment_by_segment") or {}
+        log(f"autoscale: acted={autoscale.get('acted')} dp 1 -> "
+            f"{autoscale.get('dp', {}).get('after')}, attainment ramp "
+            f"{(_seg.get('ramp_overload') or {}).get('attainment')} -> "
+            f"post {(_seg.get('post_action') or {}).get('attainment')}")
+    else:
+        log("autoscale: skipped (needs >= 2 devices for dp 1 -> 2)")
 
     # ---- speculative decoding: tool-echo A/B (spec on vs off) ------------
     speculative = speculative_phase(
@@ -2059,6 +2357,7 @@ def main() -> None:
             "shared_prefix": shared_prefix,
             "kv_tier": kv_tier,
             "disagg": disagg,
+            "autoscale": autoscale,
             "speculative": speculative,
             "batch_sweep": sweep,
             "fused_depth_ablation": depth_ablation,

@@ -1353,11 +1353,20 @@ def _compile_into_cache(tok, mask_fn, vocab_size: int, key) -> Optional[Compiled
 
 def _defer_worker() -> None:
     import logging
+    import time as _time
+
+    from ..runtime.autoscaler import background_deferred
 
     log = logging.getLogger("kafka_tpu.constrained")
     while True:
         tok, mask_fn, vocab_size, key = _DEFER_QUEUE.get()
         try:
+            # overload degradation (autoscaler ladder rung 3): a grammar
+            # compile is tens of seconds of host CPU the serving threads
+            # need more — hold the queue until the overload clears (the
+            # affected requests keep serving through the host mask path)
+            while background_deferred():
+                _time.sleep(0.25)
             _compile_into_cache(tok, mask_fn, vocab_size, key)
         except Exception as e:
             log.warning("deferred grammar compile failed: %s", e)

@@ -50,6 +50,11 @@ from .worker import EngineWorker
 
 logger = logging.getLogger("kafka_tpu.llm.tpu")
 
+# resize_dp `roles` default: KEEP the current role-pool spec (re-derived
+# for the new dp by the router, today's behavior).  Distinct from None,
+# which explicitly dissolves the pools back to colocated serving.
+_ROLES_KEEP = object()
+
 
 def _torn_items(d) -> list:
     """Snapshot a dict the engine thread mutates concurrently.
@@ -132,6 +137,9 @@ class TPULLMProvider(LLMProvider):
         # gates the next resize (see _resize_locked)
         self._rebuild_owns_resume = False
         self._orphan_rebuild: Optional[Any] = None
+        # the autoscaler control loop (runtime/autoscaler.py) attaches
+        # itself here; /admin/signals v4 echoes its state when present
+        self.autoscaler: Optional[Any] = None
         # Vision tower params (models/vision.py) — present iff the model
         # config has a VisionConfig; image requests 400 otherwise.
         self.vision_params = vision_params
@@ -290,6 +298,13 @@ class TPULLMProvider(LLMProvider):
           them.  The ``utilization`` section also carries the measured
           dispatch timing (``measured_busy_s``/``modeled_busy_s``/
           ``model_skew``) calibrating the modeled MFU/HBM-BW figures.
+        * ``autoscaler`` (version 4, ISSUE 13): the in-process control
+          loop's state when one runs (mode, degradation-ladder rung,
+          resize cooldowns, last decision) — null when
+          KAFKA_TPU_AUTOSCALE is off.  Version 4 also adds
+          ``slo.window_1m_requests`` (how many MET/MISSED verdicts back
+          the 1m attainment gauge, so a reader can tell "1.0 because
+          everything met" from "1.0 because nothing finished").
 
         Everything is read torn-tolerantly from the engine thread's
         single-writer metrics; no locks, safe at scrape frequency.
@@ -377,15 +392,30 @@ class TPULLMProvider(LLMProvider):
                     for kind in ("prefill", "decode", "verify")
                 },
             }]
+        # SLO section: the raw window dicts stay internal to /metrics,
+        # but the controller needs to know whether the 1m attainment
+        # gauge rests on enough verdicts to act on — version 4 exports
+        # that one scalar (met + missed in the 60s window)
+        slo_src = snap.get("slo") or {}
+        slo_out = {
+            k: v for k, v in slo_src.items()
+            if not k.startswith("window_")
+        }
+        w1 = slo_src.get("window_1m") or {}
+        slo_out["window_1m_requests"] = int(
+            (w1.get("met") or 0) + (w1.get("missed") or 0)
+        )
+        scaler = self.autoscaler
         return {
-            # version 3 (ISSUE 12): + pools section (per-role replica
-            # ids, queue depth, occupancy, per-kind MFU/HBM-BW) and the
-            # disagg ship counters.  Version 2 (ISSUE 11) added the
-            # anomalies section, per-replica anomalies_active, and the
-            # measured-utilization fields under utilization.*
-            # (measured_busy_s / modeled_busy_s / model_skew /
-            # measured_dispatches).
-            "version": 3,
+            # version 4 (ISSUE 13): + autoscaler section (control-loop
+            # mode, degradation-ladder rung, cooldowns, last decision —
+            # null when KAFKA_TPU_AUTOSCALE is off) and
+            # slo.window_1m_requests (verdict count behind the 1m
+            # attainment gauge).  Version 3 (ISSUE 12) added the pools
+            # section and disagg ship counters; version 2 (ISSUE 11)
+            # the anomalies section, per-replica anomalies_active, and
+            # the measured-utilization fields under utilization.*.
+            "version": 4,
             "dp": len(replicas),
             "queue": dict(snap.get("queue") or {}),
             "anomalies": anomalies,
@@ -394,6 +424,9 @@ class TPULLMProvider(LLMProvider):
                 k: v for k, v in disagg.items()
                 if k not in ("pools", "ship_ms")
             } or None,
+            "autoscaler": (
+                scaler.signals_section() if scaler is not None else None
+            ),
             "batch": {
                 "occupancy": occupancy,
                 "occupancy_frac": round(occupancy / max_batch, 4)
@@ -402,10 +435,7 @@ class TPULLMProvider(LLMProvider):
                 "max_batch": max_batch,
                 "slots_total": max_batch * len(replicas),
             },
-            "slo": {
-                k: v for k, v in (snap.get("slo") or {}).items()
-                if not k.startswith("window_")
-            },
+            "slo": slo_out,
             "utilization": snap.get("utilization") or {},
             "replicas": per_replica,
             "supervisor": {
@@ -448,9 +478,18 @@ class TPULLMProvider(LLMProvider):
                 await asyncio.sleep(0.02)
         return not leftover
 
-    async def resize_dp(self, dp: int, drain_timeout_s: float = 30.0) -> bool:
+    async def resize_dp(self, dp: int, drain_timeout_s: float = 30.0,
+                        roles: Any = _ROLES_KEEP) -> bool:
         """Rebuild the DP replica set at a new dp count (replica loss /
         scale-down) while WAITING requests survive the rebuild.
+
+        `roles` (ISSUE 13 satellite) optionally re-shapes the role pools
+        in the same rebuild: a "prefill:P,decode:D" spec validated by the
+        same parse_dp_roles rules (P + D must equal `dp`), None/""
+        dissolves the pools back to colocated serving, and the default
+        keeps the current spec (re-derived for the new dp, today's
+        behavior) — the autoscaler and /admin/resize operators share
+        this one path.
 
         The drain/restart topology story (ISSUE 2): started lanes own
         device state that cannot move across engines, so they get
@@ -478,6 +517,12 @@ class TPULLMProvider(LLMProvider):
         validate = getattr(self.engine, "validate_dp", None)
         if validate is not None:
             validate(dp)
+        if roles is not _ROLES_KEEP:
+            # validate the role spec BEFORE draining too: a bad spec
+            # must fail up front, not after in-flight work was cancelled
+            from ..runtime.dp_router import validate_roles_spec
+
+            validate_roles_spec(roles, dp)
         async with self._resize_lock:
             if self._orphan_rebuild is not None:
                 # a previous resize was cancelled mid-rebuild: its thread
@@ -496,7 +541,7 @@ class TPULLMProvider(LLMProvider):
                 self._orphan_rebuild = None
             try:
                 return await self._resize_locked(
-                    rebuild, dp, drain_timeout_s
+                    rebuild, dp, drain_timeout_s, roles
                 )
             finally:
                 # a cancelled resize (client timeout mid-drain) must never
@@ -509,7 +554,8 @@ class TPULLMProvider(LLMProvider):
                     self.worker.resume()
 
     async def _resize_locked(self, rebuild, dp: int,
-                             drain_timeout_s: float) -> bool:
+                             drain_timeout_s: float,
+                             roles: Any = _ROLES_KEEP) -> bool:
         def _started(e) -> bool:
             # pending disaggregated hand-offs are started work too: their
             # pages + un-emitted first token complete at step cadence, so
@@ -557,7 +603,10 @@ class TPULLMProvider(LLMProvider):
         # other handler) stays responsive during the rebuild instead of
         # blocking behind it.
         fut = asyncio.get_running_loop().run_in_executor(
-            None, lambda: rebuild(dp=dp)
+            None, lambda: (
+                rebuild(dp=dp) if roles is _ROLES_KEEP
+                else rebuild(dp=dp, roles=roles)
+            )
         )
         try:
             await asyncio.shield(fut)

@@ -6,6 +6,7 @@ from .distributed import (
     barrier,
     guarded_collective,
     init_distributed,
+    reform_topology,
 )
 from .expert import (
     init_moe_params,
@@ -42,6 +43,7 @@ __all__ = [
     "DistributedStepError",
     "barrier",
     "guarded_collective",
+    "reform_topology",
     "init_distributed",
     "init_moe_params",
     "moe_mlp_reference",

@@ -53,6 +53,18 @@ _INITIALIZED = False
 # collective is milliseconds-to-seconds; only a dead peer spends 60s.
 GUARD_TIMEOUT_ENV = "KAFKA_TPU_DIST_STEP_TIMEOUT_S"
 
+# Topology re-formation (ISSUE 13 satellite, PR 2 follow-up): after a
+# guarded collective misses its deadline, attempt ONE barrier-coordinated
+# rendezvous over the coordination service before fail-stop.
+# KAFKA_TPU_DIST_REFORM=0 disables; the barrier gets
+# KAFKA_TPU_DIST_REFORM_TIMEOUT_S (default 5s) to settle.
+REFORM_ENV = "KAFKA_TPU_DIST_REFORM"
+REFORM_TIMEOUT_ENV = "KAFKA_TPU_DIST_REFORM_TIMEOUT_S"
+_REFORM_EPOCH = 0
+# counters for tests/postmortems (module-aggregated like the sandbox
+# supervision counters)
+reform_stats = {"attempts": 0, "successes": 0}
+
 
 class DistributedStepError(RuntimeError):
     """A guarded multi-host collective missed its deadline — a peer
@@ -84,11 +96,109 @@ def barrier(name: str, timeout_s: float = 60.0) -> bool:
     return True
 
 
+def reform_topology(label: str = "collective",
+                    timeout_s: Optional[float] = None) -> bool:
+    """One barrier-coordinated topology re-formation attempt after a
+    missed collective deadline (ISSUE 13 satellite, PR 2 follow-up).
+
+    A missed watchdog deadline means a peer's contribution never
+    arrived — but "never arrived within the budget" covers two different
+    worlds: a DEAD peer (killed process, unreachable host) and a
+    merely-WEDGED one (GC pause, page-in storm, a transient network
+    partition that healed).  Before fail-stopping the process, every
+    survivor rendezvouses once at a fresh coordination-service barrier:
+
+    * all peers arrive within the (short) re-formation window — the
+      topology still holds, the stall was transient, and the caller may
+      retry the collective ONCE over the re-formed topology;
+    * the barrier itself fails (deadline, lost coordination client) —
+      the peer really is gone, re-formation is impossible without a
+      coordinator restart, and the original fail-stop path proceeds:
+      the existing dist.step=exit chaos kill matrix covers exactly this
+      branch (survivor terminates cleanly, never hangs).
+
+    Epoch-numbered barrier names keep repeated attempts from colliding
+    with a slow peer still parked at a previous one.  Returns False
+    (never raises) when disabled, single-process, or the rendezvous
+    fails."""
+    global _REFORM_EPOCH
+    if os.environ.get(REFORM_ENV, "1") in ("0", "false", "False"):
+        return False
+    if not _INITIALIZED:
+        return False
+    if timeout_s is None:
+        try:
+            timeout_s = float(os.environ.get(REFORM_TIMEOUT_ENV, "5"))
+        except ValueError:
+            timeout_s = 5.0
+    _REFORM_EPOCH += 1
+    reform_stats["attempts"] += 1
+    logger.warning(
+        "distributed %s missed its deadline; attempting topology "
+        "re-formation (barrier epoch %d, %.1fs window)",
+        label, _REFORM_EPOCH, timeout_s,
+    )
+    try:
+        ok = barrier(f"kafka-reform-{_REFORM_EPOCH}", timeout_s=timeout_s)
+    except Exception as e:
+        logger.error(
+            "topology re-formation failed (%s): %s — the peer is dead; "
+            "fail-stop", label, e,
+        )
+        return False
+    if ok:
+        reform_stats["successes"] += 1
+        logger.warning(
+            "topology re-formed: every peer reached barrier epoch %d — "
+            "the stall was transient, retrying %s once",
+            _REFORM_EPOCH, label,
+        )
+    return ok
+
+
+class _Attempt:
+    """One in-flight guarded collective: the daemon thread running `fn`
+    plus its result slot.  The SAME attempt is waited on by both the
+    first watchdog window and the single post-re-formation grace window
+    — a runtime collective cannot be cancelled, so re-EXECUTING `fn`
+    while the wedged original is still inside it would enter the
+    collective twice locally against peers participating once (corrupt
+    pairing, double-applied host side effects)."""
+
+    def __init__(self, fn: Callable[..., Any], args: tuple, label: str):
+        self.result: dict = {}
+
+        def run() -> None:
+            try:
+                self.result["value"] = fn(*args)
+            except BaseException as e:  # surfaced to the caller in wait()
+                self.result["error"] = e
+
+        self.thread = threading.Thread(
+            target=run, name=f"kafka-tpu-dist-{label}", daemon=True
+        )
+        self.thread.start()
+
+    def wait(self, timeout_s: float, label: str) -> Any:
+        self.thread.join(timeout_s)
+        if self.thread.is_alive():
+            raise DistributedStepError(
+                f"distributed {label} did not complete within "
+                f"{timeout_s:.0f}s — a peer process is dead or "
+                "unreachable; this process must not keep serving from a "
+                "broken mesh"
+            )
+        if "error" in self.result:
+            raise self.result["error"]
+        return self.result.get("value")
+
+
 def guarded_collective(
     fn: Callable[..., Any],
     *args: Any,
     timeout_s: Optional[float] = None,
     label: str = "collective",
+    reform: bool = True,
 ) -> Any:
     """Run `fn(*args)` (a device computation containing cross-process
     collectives) under a watchdog; raise DistributedStepError if it does
@@ -103,32 +213,31 @@ def guarded_collective(
     runtime collective) and the caller decides process fate — the
     surviving workers of a killed peer typically log the terminal error
     and exit rather than serve from a half-dead mesh.
+
+    `reform` (default on; KAFKA_TPU_DIST_REFORM=0 disables globally):
+    before surfacing the terminal error, attempt ONE barrier-coordinated
+    re-formation over the survivors (see reform_topology) and, if every
+    peer answers, grant the ORIGINAL in-flight attempt one more watchdog
+    window to materialize — a transient stall (partition healed, GC
+    pause ended) completes the already-dispatched collective in place; a
+    genuinely dead peer still fail-stops exactly as before.  The wedged
+    attempt is never re-executed: the daemon thread is still inside the
+    runtime collective, and entering it a second time locally would pair
+    the extra op against peers participating once.
     """
     failpoint("dist.step")
     if timeout_s is None:
         timeout_s = float(os.environ.get(GUARD_TIMEOUT_ENV, "60"))
-    result: dict = {}
-
-    def run() -> None:
-        try:
-            result["value"] = fn(*args)
-        except BaseException as e:  # surfaced to the caller below
-            result["error"] = e
-
-    t = threading.Thread(
-        target=run, name=f"kafka-tpu-dist-{label}", daemon=True
-    )
-    t.start()
-    t.join(timeout_s)
-    if t.is_alive():
-        raise DistributedStepError(
-            f"distributed {label} did not complete within {timeout_s:.0f}s "
-            "— a peer process is dead or unreachable; this process must "
-            "not keep serving from a broken mesh"
-        )
-    if "error" in result:
-        raise result["error"]
-    return result.get("value")
+    attempt = _Attempt(fn, args, label)
+    try:
+        return attempt.wait(timeout_s, label)
+    except DistributedStepError:
+        if reform and reform_topology(label):
+            # one grace window against the SAME attempt, no further
+            # re-formation: a second miss against a topology that just
+            # proved alive is terminal
+            return attempt.wait(timeout_s, label)
+        raise
 
 
 def init_distributed(

@@ -1,5 +1,6 @@
 """Serving runtime: paged KV pool, continuous-batching engine."""
 
+from .autoscaler import AutoscalerConfig, AutoscalerController
 from .dp_router import DataParallelEngines
 from .engine import (
     AdmissionError,
@@ -14,6 +15,8 @@ from .kv_cache import OutOfPagesError, PagePool, SequencePages, TRASH_PAGE
 from .kv_tier import KVTierManager, LocalPageShipper, PageShipper
 
 __all__ = [
+    "AutoscalerConfig",
+    "AutoscalerController",
     "FlightRecorder",
     "KVTierManager",
     "LocalPageShipper",

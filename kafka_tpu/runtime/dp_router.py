@@ -82,6 +82,10 @@ MIN_PREFILL_ENV = "KAFKA_TPU_DISAGG_MIN_PREFILL_TOKENS"
 
 HEALTHY, PROBATION, QUARANTINED = "healthy", "probation", "quarantined"
 
+# rebuild() `roles` default: keep the current role spec (re-derived for
+# the new dp).  Distinct from None = dissolve the pools (colocated).
+_ROLES_KEEP = object()
+
 
 def parse_dp_roles(spec: Optional[str]) -> Optional[Tuple[int, int]]:
     """Parse ``KAFKA_TPU_DP_ROLES`` ("prefill:2,decode:6") into
@@ -110,6 +114,20 @@ def parse_dp_roles(spec: Optional[str]) -> Optional[Tuple[int, int]]:
             f"{spec!r} needs at least one prefill and one decode replica"
         )
     return counts["prefill"], counts["decode"]
+
+
+def validate_roles_spec(roles: Optional[str],
+                        dp: int) -> Optional[Tuple[int, int]]:
+    """parse_dp_roles plus the P + D == dp rule — the ONE validation
+    both resize_dp's pre-drain check and rebuild() apply, so the early
+    check can never pass a spec the rebuild later rejects (which would
+    fail only after in-flight work was cancelled)."""
+    spec = parse_dp_roles(roles or None)
+    if spec is not None and sum(spec) != dp:
+        raise ValueError(
+            f"roles {roles!r} names {sum(spec)} replicas but dp={dp}"
+        )
+    return spec
 
 
 @dataclasses.dataclass
@@ -975,22 +993,44 @@ class DataParallelEngines:
                 f"have {len(self._devices)}"
             )
 
-    def rebuild(self, dp: int) -> None:
+    def rebuild(self, dp: int, roles: Any = _ROLES_KEEP) -> None:
         """Re-create the replica set at a new dp count; WAITING requests
         survive the rebuild (re-queued onto the new replicas in submit
         order, with routes and affinity rewritten).
+
+        `roles` (ISSUE 13 satellite) re-shapes the role pools in the
+        same rebuild: a "prefill:P,decode:D" spec (parse_dp_roles rules,
+        P + D must equal `dp` — validated BEFORE any work is touched),
+        None/"" dissolves the pools back to colocated, and the default
+        keeps the current spec re-derived for the new dp (the pre-ISSUE
+        behavior, which could only flex the decode pool).
 
         Precondition: no replica holds STARTED work (active lanes, parked
         lanes, in-flight fetches) — the caller drains or cancels those
         first (llm/tpu_provider.resize_dp does, with the worker paused).
         Started lanes own device state that cannot move across engines."""
         self.validate_dp(dp)
+        new_spec: Any = _ROLES_KEEP
+        if roles is not _ROLES_KEEP:
+            new_spec = validate_roles_spec(roles, dp)  # raises on bad spec
+            if new_spec is not None and self.engines[0].prefix_cache is None:
+                # same degrade rule as construction: shipped runs have
+                # nowhere to register without a radix cache
+                logger.warning(
+                    "resize roles %r ignored: the prefix cache is "
+                    "disabled; serving colocated", roles,
+                )
+                new_spec = None
         for i, e in enumerate(self.engines):
             if e.num_active or e.parked or e._pending or e.handoffs:
                 raise RuntimeError(
                     f"cannot rebuild: replica {i} still holds started "
                     "work (drain or cancel it first)"
                 )
+        if new_spec is not _ROLES_KEEP:
+            # committed only after the started-work check: a refused
+            # rebuild must not leave a half-applied role spec behind
+            self._role_spec = new_spec
         pending: List[GenRequest] = []
         for e in self.engines:
             pending.extend(e.take_waiting())

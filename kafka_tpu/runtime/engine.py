@@ -878,6 +878,13 @@ class InferenceEngine:
             FlightRecorder(self.ecfg.flight_ring)
             if self.ecfg.flight_ring > 0 else None
         )
+        # Autoscaler degradation ladder (runtime/autoscaler.py rung 2):
+        # None = unthrottled (the default — proposals honor
+        # ecfg.speculative_k exactly, byte-identical paths); an integer
+        # clamps per-lane speculative proposals (0 pauses speculation;
+        # in-flight verify entries still drain).  Written cross-thread by
+        # the controller as one GIL-atomic attribute store.
+        self.spec_k_cap: Optional[int] = None
         # completion time of the previously-observed fetch: the baseline
         # the measured-dispatch-latency derivation subtracts from (in-
         # order device execution — a dispatch starts when its predecessor
@@ -3335,6 +3342,13 @@ class InferenceEngine:
         """
         ecfg = self.ecfg
         K = ecfg.speculative_k
+        cap = self.spec_k_cap
+        if cap is not None:
+            # overload degradation (autoscaler ladder rung 2): proposals
+            # throttled; 0 = paused entirely, plain decode dispatches
+            K = min(K, cap)
+            if K <= 0:
+                return False
         proposals: Dict[int, List[int]] = {}
         for s in lanes:
             if (

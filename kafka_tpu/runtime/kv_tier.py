@@ -517,6 +517,14 @@ class KVTierManager:
         The gather is enqueued before the caller releases the pages, so
         in-order device execution reads them pre-overwrite; only the host
         materialization is deferred (see drain())."""
+        from .autoscaler import background_deferred
+
+        if background_deferred():
+            # overload degradation (autoscaler ladder rung 3): demotion
+            # is background D2H work — refuse, the caller falls back to
+            # plain eviction (a dropped cold run re-prefills later; a
+            # D2H copy competes with serving NOW)
+            return None
         est = self.bytes_for_pages(len(pages))
         if est > self.host_budget_bytes:
             return None  # a run larger than the whole tier never fits

@@ -457,7 +457,7 @@ async def create_app(
         tracing_middleware(),
         auth_middleware(cfg.api_token),
     ])
-    app[STATE_KEY] = {
+    state = {
         "cfg": cfg,
         "db": db,
         "llm": llm_provider,
@@ -465,7 +465,37 @@ async def create_app(
         "mcp_servers": list(mcp_servers or []),
         "kafka": kafka,
         "draining": False,
+        "autoscaler": None,
     }
+    app[STATE_KEY] = state
+    # Autoscaler control loop (ISSUE 13, README "Autoscaler"): built only
+    # when KAFKA_TPU_AUTOSCALE asks for it AND the provider emits the
+    # signals contract — the off default constructs NOTHING, so every
+    # serving path stays byte-identical to a controller-less build.  The
+    # thread starts on the running loop (on_startup) because act-mode
+    # resizes schedule provider.resize_dp onto it.
+    from ..runtime.autoscaler import MODE_OFF, parse_mode
+
+    if (parse_mode(cfg.autoscale) != MODE_OFF
+            and getattr(llm_provider, "signals", None) is not None):
+        from ..runtime.autoscaler import (
+            AutoscalerConfig,
+            AutoscalerController,
+        )
+
+        scaler = AutoscalerController(
+            llm_provider,
+            AutoscalerConfig.from_env(mode=parse_mode(cfg.autoscale)),
+            is_draining=lambda: bool(state.get("draining")),
+        )
+        state["autoscaler"] = scaler
+
+        async def _start_autoscaler(app: web.Application) -> None:
+            import asyncio as _asyncio
+
+            scaler.start(loop=_asyncio.get_running_loop())
+
+        app.on_startup.append(_start_autoscaler)
     _add_routes(app)
     app.on_shutdown.append(_drain_on_shutdown)
     app.on_cleanup.append(_cleanup)
@@ -498,6 +528,18 @@ async def _drain_on_shutdown(app: web.Application) -> None:
 
 async def _cleanup(app: web.Application) -> None:
     state = app[STATE_KEY]
+    scaler = state.get("autoscaler")
+    if scaler is not None:
+        # before the provider closes: a poll racing teardown would read
+        # a dying engine, and stop() also climbs any applied ladder
+        # rungs.  In an executor because stop() joins a thread that may
+        # be blocked on a resize_dp coroutine scheduled onto THIS loop —
+        # joining inline would deadlock the loop against its own resize
+        import asyncio as _asyncio
+
+        await _asyncio.get_running_loop().run_in_executor(
+            None, scaler.stop
+        )
     await state["kafka"].cleanup()
     await state["db"].close()
     await state["llm"].aclose()
@@ -529,7 +571,7 @@ def cors_middleware(origins: str):
 # surface itself (incl. the autoscaler's ~1 Hz signal scrape) would
 # otherwise churn the ring with noise
 _TRACE_SKIP = ("/health", "/metrics", "/playground", "/debug",
-               "/admin/signals")
+               "/admin/signals", "/admin/autoscaler")
 
 
 def _incoming_trace(request: web.Request):
@@ -663,6 +705,7 @@ def _add_routes(app: web.Application) -> None:
     r.add_get("/health", health)
     r.add_get("/metrics", metrics)
     r.add_get("/admin/signals", admin_signals)
+    r.add_get("/admin/autoscaler", admin_autoscaler)
     r.add_post("/admin/resize", resize_topology)
     r.add_post("/debug/profile", capture_profile)
     r.add_get("/debug/traces", debug_traces)
@@ -1245,6 +1288,12 @@ async def metrics(request: web.Request) -> web.Response:
     snap["tracing"] = tracing.counters()
     if isinstance(snap.get("requests"), dict):
         snap["requests"]["slow"] = tracing.slow_count()
+    # autoscaler control-loop counters (AUTOSCALER_METRIC_KEYS): one
+    # controller per process, merged here like the sandbox/tracing
+    # sections (absent when KAFKA_TPU_AUTOSCALE is off)
+    scaler = _state(request).get("autoscaler")
+    if scaler is not None:
+        snap["autoscaler"] = scaler.metrics_section()
     if request.query.get("format") == "prometheus":
         from .prometheus import render_prometheus
 
@@ -1285,15 +1334,38 @@ async def admin_signals(request: web.Request) -> web.Response:
     return web.json_response(payload)
 
 
+async def admin_autoscaler(request: web.Request) -> web.Response:
+    """The autoscaler control loop's bounded decision log + live state
+    (ISSUE 13, README "Autoscaler"): mode, config, degradation-ladder
+    rung, cooldowns, and every recorded decision (cause, condensed
+    inputs snapshot, action, vetoes, outcome; consecutive identical
+    holds collapse into one counted entry).  Read-only — same token
+    policy as /admin/signals (works without a configured token, honors
+    the bearer gate when one is set).  404 when KAFKA_TPU_AUTOSCALE is
+    off: no controller runs, so there is nothing to report."""
+    scaler = _state(request).get("autoscaler")
+    if scaler is None:
+        return web.json_response(
+            {"error": "autoscaler not running (KAFKA_TPU_AUTOSCALE is "
+                      "off, or this deployment emits no signals)"},
+            status=404,
+        )
+    return web.json_response(scaler.snapshot())
+
+
 async def resize_topology(request: web.Request) -> web.Response:
     """Rebuild the DP replica set at a new dp count (replica loss or
     scale-down) while queued requests survive: body {"dp": N, optional
-    "drain_timeout_s": S}.  Started requests get the drain budget to
-    finish; leftovers are cancelled with terminal events (reported as
-    "clean": false).  Unlike serving endpoints, this one is
-    operator-destructive (it cancels whatever cannot drain), so the
-    open-if-no-token dev default does NOT apply: without a configured
-    KAFKA_TPU_API_TOKEN the endpoint refuses outright."""
+    "drain_timeout_s": S, optional "roles": "prefill:P,decode:D"}.
+    Started requests get the drain budget to finish; leftovers are
+    cancelled with terminal events (reported as "clean": false).  When
+    "roles" is present it re-shapes the prefill/decode pools in the same
+    rebuild (validated by the parse_dp_roles rules, P + D == dp; "" or
+    null dissolves the pools back to colocated); absent keeps the
+    current spec re-derived for the new dp.  Unlike serving endpoints,
+    this one is operator-destructive (it cancels whatever cannot
+    drain), so the open-if-no-token dev default does NOT apply: without
+    a configured KAFKA_TPU_API_TOKEN the endpoint refuses outright."""
     if not _state(request)["cfg"].api_token:
         return web.json_response(
             {"error": "admin endpoints require KAFKA_TPU_API_TOKEN to "
@@ -1316,20 +1388,31 @@ async def resize_topology(request: web.Request) -> web.Response:
             body.get("drain_timeout_s",
                      _state(request)["cfg"].drain_timeout_s)
         )
+        roles_given = "roles" in body
+        roles = body.get("roles")
+        if roles_given and roles is not None and not isinstance(roles, str):
+            raise TypeError("roles must be a string or null")
     except Exception:
         return web.json_response(
-            {"error": 'body must be {"dp": N[, "drain_timeout_s": S]}'},
+            {"error": 'body must be {"dp": N[, "drain_timeout_s": S]'
+                      '[, "roles": "prefill:P,decode:D"|null]}'},
             status=400,
         )
     if dp < 1:
         return web.json_response({"error": "dp must be >= 1"}, status=400)
+    kwargs = {"drain_timeout_s": drain_timeout_s}
+    if roles_given:
+        kwargs["roles"] = roles
     try:
-        clean = await resize(dp, drain_timeout_s=drain_timeout_s)
+        clean = await resize(dp, **kwargs)
     except ValueError as e:
         return web.json_response({"error": str(e)}, status=400)
     except RuntimeError as e:
         return web.json_response({"error": str(e)}, status=409)
-    return web.json_response({"dp": dp, "clean": clean})
+    out = {"dp": dp, "clean": clean}
+    if roles_given:
+        out["roles"] = roles or None
+    return web.json_response(out)
 
 
 async def debug_traces(request: web.Request) -> web.Response:

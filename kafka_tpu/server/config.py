@@ -119,6 +119,16 @@ class ServingConfig:
     #       at window expiry (DataParallelEngines._rebuild_replica)
     #       instead of re-admitting it forever (0 disables).
     replica_rebuild_threshold: int = 3
+    # Autoscaler control loop (KAFKA_TPU_AUTOSCALE, README "Autoscaler",
+    # ISSUE 13): "off" (default — no controller built, every dispatch and
+    # admission path byte-identical to before), "recommend" (full
+    # decision loop + GET /admin/autoscaler log, no action taken — the
+    # dry-run to watch before handing over the keys), or "act" (closes
+    # the loop: scale-out/in through /admin/resize's seam, degradation
+    # ladder under overload).  Poll cadence, hysteresis bands, cooldowns
+    # and dp bounds come from KAFKA_TPU_AUTOSCALE_* (runtime/
+    # autoscaler.AutoscalerConfig.from_env).
+    autoscale: str = "off"
     # Observability (README "Observability"):
     #   trace_sample — fraction of requests traced end to end (span tree in
     #       the /debug/trace ring).  1.0 traces everything (the sampling-
@@ -272,6 +282,7 @@ class ServingConfig:
                 "REPLICA_REBUILD_THRESHOLD",
                 cls.replica_rebuild_threshold,
                 lambda v: max(0, int(v))),
+            autoscale=get("AUTOSCALE", cls.autoscale),
             trace_sample=get("TRACE_SAMPLE", cls.trace_sample, float),
             trace_ring=get("TRACE_RING", cls.trace_ring, int),
             slow_ttft_ms=get("SLOW_TTFT_MS", None, float),

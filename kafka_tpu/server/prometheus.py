@@ -711,6 +711,37 @@ def render_prometheus(snap: Dict[str, Any]) -> str:
         w.sample("kafka_tpu_flight_postmortems_total",
                  fl.get("flight_postmortems", 0))
 
+    # Autoscaler control loop (runtime/metrics.AUTOSCALER_METRIC_KEYS —
+    # the registry tests/test_autoscaler.py enforces in both files;
+    # present only when KAFKA_TPU_AUTOSCALE runs a controller).  Event
+    # counters under one family; the ladder rung and last-observed dp
+    # are gauges a dashboard alerts on directly.
+    scaler = snap.get("autoscaler") or {}
+    if scaler:
+        w.family("kafka_tpu_autoscaler_events_total", "counter",
+                 "Autoscaler control-loop events by kind.")
+        for key, event in (
+            ("autoscaler_polls", "poll"),
+            ("autoscaler_scale_outs", "scale_out"),
+            ("autoscaler_scale_ins", "scale_in"),
+            ("autoscaler_resize_failures", "resize_failure"),
+            ("autoscaler_degrades", "degrade"),
+            ("autoscaler_recovers", "recover"),
+            ("autoscaler_vetoes", "veto"),
+        ):
+            if key in scaler:
+                w.sample("kafka_tpu_autoscaler_events_total",
+                         scaler[key], {"event": event})
+        if "autoscaler_ladder_level" in scaler:
+            w.family("kafka_tpu_autoscaler_ladder_level", "gauge",
+                     "Current degradation-ladder rung (0 = normal).")
+            w.sample("kafka_tpu_autoscaler_ladder_level",
+                     scaler["autoscaler_ladder_level"])
+        if "autoscaler_dp" in scaler:
+            w.family("kafka_tpu_autoscaler_dp", "gauge",
+                     "dp at the controller's last signal poll.")
+            w.sample("kafka_tpu_autoscaler_dp", scaler["autoscaler_dp"])
+
     sandbox = snap.get("sandbox") or {}
     if sandbox:
         w.family("kafka_tpu_sandbox_total", "counter",

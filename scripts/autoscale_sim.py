@@ -1,0 +1,186 @@
+#!/usr/bin/env python
+"""Replay signals snapshots through the autoscaler decision table.
+
+The controller's decision function (runtime/autoscaler.decide) is pure
+over (snapshot, state, config, clock) — this tool drives the EXACT same
+function the live control loop runs, in dry-run, and prints the decision
+trace.  Two input modes:
+
+* **Recorded**: one or more JSON files of /admin/signals snapshots — a
+  single object, a JSON array, or JSON-lines (one snapshot per line).
+  Snapshots replay at a synthetic clock (`--interval` seconds apart), so
+  a captured incident replays in milliseconds and a threshold change
+  shows its decision diff immediately.
+
+      python scripts/autoscale_sim.py captured_signals.jsonl
+
+* **Live** (`--url`): poll a running server's GET /admin/signals at
+  `--interval` for `--polls` rounds and trace what a controller WOULD
+  do — the recommend-mode shadow run without touching the server's own
+  config.  `--token` / $KAFKA_TPU_API_TOKEN authenticates against a
+  token-gated deployment.
+
+      python scripts/autoscale_sim.py --url http://localhost:8000 \
+          --polls 30 --interval 2
+
+All KAFKA_TPU_AUTOSCALE_* knobs (hysteresis bands, sustain windows,
+cooldowns, dp bounds — see README "Autoscaler") apply, so operators tune
+thresholds against a recording before enabling the live loop.  Tier-1
+smoke-tests this script end to end (tests/test_autoscaler.py), so
+decision-table drift is caught without hardware.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kafka_tpu.runtime.autoscaler import (  # noqa: E402
+    HOLD,
+    AutoscalerConfig,
+    AutoscalerController,
+    ControllerState,
+)
+
+
+def load_snapshots(path: str) -> list:
+    """One JSON object, a JSON array, or JSON-lines -> list of dicts."""
+    with open(path) as f:
+        text = f.read().strip()
+    if not text:
+        return []
+    try:
+        data = json.loads(text)
+        if isinstance(data, list):
+            return data
+        return [data]
+    except json.JSONDecodeError:
+        out = []
+        for i, line in enumerate(text.splitlines()):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError as e:
+                raise SystemExit(f"{path}:{i + 1}: bad JSON line: {e}")
+        return out
+
+
+def fetch_signals(url: str, token: str = "") -> dict:
+    import urllib.request
+
+    req = urllib.request.Request(url.rstrip("/") + "/admin/signals")
+    if token:
+        req.add_header("Authorization", f"Bearer {token}")
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return json.loads(resp.read().decode())
+
+
+def fmt_decision(entry, as_json: bool = False) -> str:
+    if as_json:
+        return json.dumps(entry)
+    d = entry
+    parts = [f"[{d['seq']:>4}]", f"{d['action']:<9}", d.get("cause", "")]
+    if d.get("dp_target") is not None:
+        parts.append(f"dp {d['dp']}->{d['dp_target']}")
+    if d.get("roles_target"):
+        parts.append(f"roles={d['roles_target']}")
+    if d.get("ladder_target") is not None:
+        parts.append(f"ladder->{d['ladder_target']}")
+    if d.get("intended"):
+        parts.append(f"(held: would {d['intended']}; "
+                     f"veto {','.join(d.get('vetoes') or [])})")
+    inp = d.get("inputs") or {}
+    bits = []
+    if inp.get("attainment_1m") is not None:
+        bits.append(f"attain_1m={inp['attainment_1m']}")
+    if inp.get("queue_depth") is not None:
+        bits.append(f"q={inp['queue_depth']}")
+    if inp.get("queue_trend_per_s") is not None:
+        bits.append(f"trend={inp['queue_trend_per_s']}")
+    if inp.get("anomalies_active"):
+        bits.append(f"anomalies={inp['anomalies_active']}")
+    if bits:
+        parts.append("| " + " ".join(bits))
+    return " ".join(str(p) for p in parts)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="replay signals snapshots through the autoscaler "
+                    "decision table (dry-run)")
+    ap.add_argument("files", nargs="*",
+                    help="recorded /admin/signals JSON (object, array, "
+                         "or JSON-lines)")
+    ap.add_argument("--url", help="poll a live server instead of files")
+    ap.add_argument("--token",
+                    default=os.environ.get("KAFKA_TPU_API_TOKEN", ""),
+                    help="bearer token for --url "
+                         "(default: $KAFKA_TPU_API_TOKEN)")
+    ap.add_argument("--polls", type=int, default=30,
+                    help="live-mode poll rounds (default 30)")
+    ap.add_argument("--interval", type=float, default=None,
+                    help="seconds between polls / synthetic replay step "
+                         "(default: KAFKA_TPU_AUTOSCALE_INTERVAL_S)")
+    ap.add_argument("--json", action="store_true",
+                    help="print full decision entries as JSON lines")
+    ap.add_argument("--quiet-holds", action="store_true",
+                    help="print only non-hold decisions and vetoed holds")
+    args = ap.parse_args(argv)
+    if bool(args.files) == bool(args.url):
+        ap.error("pass snapshot files OR --url (exactly one)")
+
+    cfg = AutoscalerConfig.from_env(mode="recommend")
+    if args.interval:
+        cfg.interval_s = args.interval
+    ctl = AutoscalerController(provider=None, cfg=cfg)
+    printed = 0
+
+    def emit() -> None:
+        nonlocal printed
+        # the controller collapses identical holds; print anything new
+        for entry in list(ctl.decisions)[printed:]:
+            if args.quiet_holds and entry["action"] == HOLD \
+                    and not entry.get("vetoes"):
+                printed += 1
+                continue
+            print(fmt_decision(entry, as_json=args.json))
+            printed += 1
+
+    if args.url:
+        now = time.monotonic()
+        for i in range(args.polls):
+            try:
+                snap = fetch_signals(args.url, args.token)
+            except Exception as e:
+                print(f"# poll {i}: fetch failed: {e}", file=sys.stderr)
+                time.sleep(cfg.interval_s)
+                continue
+            ctl.poll_once(now=time.monotonic(), snap=snap)
+            emit()
+            if i + 1 < args.polls:
+                time.sleep(cfg.interval_s)
+        _ = now
+    else:
+        snaps = []
+        for path in args.files:
+            snaps.extend(load_snapshots(path))
+        if not snaps:
+            raise SystemExit("no snapshots found")
+        ctl.replay(snaps)
+        emit()
+
+    state: ControllerState = ctl.state
+    print(f"# {ctl._seq} decision(s), ladder level {state.ladder}, "
+          f"counters: " + ", ".join(
+              f"{k.replace('autoscaler_', '')}={v}"
+              for k, v in ctl.counters.items() if v))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -103,6 +103,9 @@ _HEAVY_TAIL = (
     "test_pallas_kernels.py",
     "test_constrained.py",
     "test_server.py",
+    # autoscaler chaos e2e builds dp routers over the tiny model and
+    # smoke-runs the bench traffic-ramp phase (compile-heavy rebuilds)
+    "test_autoscaler.py",
     "test_dp_router.py",
     # disaggregated prefill/decode shares test_dp_router's dp=2 tiny
     # model and adds cross-replica ship compiles on top

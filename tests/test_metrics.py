@@ -602,8 +602,14 @@ class TestMetricsEndpoint:
                 s = await client.get("/admin/signals")
                 assert s.status == 200
                 sig = await s.json()
-                assert sig["version"] == 3
+                assert sig["version"] == 4
                 assert sig["dp"] == 1
+                # version 4 (ISSUE 13): the autoscaler echo (null when
+                # KAFKA_TPU_AUTOSCALE is off — the default here) and
+                # the 1m-window verdict count behind the attainment
+                # gauge
+                assert sig["autoscaler"] is None
+                assert isinstance(sig["slo"]["window_1m_requests"], int)
                 assert set(sig["queue"]) >= {"depth", "peak",
                                              "trend_per_s"}
                 # version 2 (ISSUE 11): flight-recorder anomaly state is
@@ -621,9 +627,12 @@ class TestMetricsEndpoint:
                 for key in ("slo_attainment_1m", "slo_attainment_5m",
                             "goodput_tok_s", "slo_ttft_target_ms"):
                     assert key in sig["slo"], key
-                # raw window sections stay internal to /metrics
-                assert not any(k.startswith("window_")
-                               for k in sig["slo"])
+                # raw window SECTIONS stay internal to /metrics (the
+                # version-4 window_1m_requests scalar is the one
+                # deliberate exception)
+                assert not any(isinstance(v, dict)
+                               for v in sig["slo"].values())
+                assert "window_1m" not in sig["slo"]
                 assert set(sig["utilization"]) >= {"prefill", "decode",
                                                    "verify"}
                 rep = sig["replicas"][0]

@@ -159,6 +159,131 @@ class TestGuardedCollectiveSingleProcess:
                 guarded_collective(lambda: 1, timeout_s=5)
 
 
+class TestTopologyReformation:
+    """ISSUE 13 satellite (PR 2 follow-up): a missed collective deadline
+    attempts ONE barrier-coordinated re-formation over the survivors
+    before fail-stop — a transient stall (peer alive, merely wedged)
+    completes the ORIGINAL in-flight collective inside one post-reform
+    grace window (never a second execution: the wedged daemon thread is
+    still inside the runtime collective, and re-entering it locally
+    would pair an extra op against peers participating once); a dead
+    peer still surfaces the clean DistributedStepError (the
+    dist.step=exit chaos kill matrix exercises that branch across real
+    processes)."""
+
+    def test_transient_stall_reforms_and_completes_in_place(
+            self, monkeypatch):
+        from kafka_tpu.parallel import distributed as dist
+
+        monkeypatch.setattr(dist, "_INITIALIZED", True)
+        barriers = []
+        gate = threading.Event()
+
+        def healing_barrier(name, timeout_s=60.0):
+            barriers.append(name)
+            gate.set()  # the stall heals while the survivors rendezvous
+            return True
+
+        monkeypatch.setattr(dist, "barrier", healing_barrier)
+        calls = []
+
+        def fn():
+            calls.append(1)
+            gate.wait()  # wedges past the first watchdog window
+            return 42
+
+        before = dict(dist.reform_stats)
+        try:
+            assert dist.guarded_collective(fn, timeout_s=0.2,
+                                           label="psum") == 42
+        finally:
+            gate.set()
+        assert len(calls) == 1  # the original attempt, never re-executed
+        assert len(barriers) == 1 and barriers[0].startswith("kafka-reform-")
+        assert dist.reform_stats["attempts"] == before["attempts"] + 1
+        assert dist.reform_stats["successes"] == before["successes"] + 1
+
+    def test_reformed_but_still_stuck_fail_stops(self, monkeypatch):
+        """Every peer answers the barrier but the collective still never
+        materializes: the grace window expires and the process
+        fail-stops — one re-formation, never a loop."""
+        from kafka_tpu.parallel import DistributedStepError
+        from kafka_tpu.parallel import distributed as dist
+
+        monkeypatch.setattr(dist, "_INITIALIZED", True)
+        barriers = []
+        monkeypatch.setattr(
+            dist, "barrier",
+            lambda name, timeout_s=60.0: barriers.append(name) or True,
+        )
+        gate = threading.Event()
+        calls = []
+
+        def fn():
+            calls.append(1)
+            gate.wait()
+
+        try:
+            with pytest.raises(DistributedStepError, match="peer process"):
+                dist.guarded_collective(fn, timeout_s=0.2, label="psum")
+        finally:
+            gate.set()
+        assert len(calls) == 1
+        assert len(barriers) == 1
+
+    def test_dead_peer_barrier_failure_fail_stops(self, monkeypatch):
+        from kafka_tpu.parallel import DistributedStepError
+        from kafka_tpu.parallel import distributed as dist
+
+        monkeypatch.setattr(dist, "_INITIALIZED", True)
+
+        def dead_barrier(name, timeout_s=60.0):
+            raise RuntimeError("DEADLINE_EXCEEDED: barrier timed out")
+
+        monkeypatch.setattr(dist, "barrier", dead_barrier)
+        gate = threading.Event()
+        calls = []
+
+        def fn():
+            calls.append(1)
+            gate.wait()
+
+        try:
+            with pytest.raises(DistributedStepError, match="peer process"):
+                dist.guarded_collective(fn, timeout_s=0.2, label="psum")
+        finally:
+            gate.set()
+        assert len(calls) == 1  # no retry against a dead topology
+
+    def test_single_process_never_reforms(self):
+        """_INITIALIZED False (no multi-host): the pre-existing behavior
+        is untouched — straight to the terminal error, no barrier."""
+        from kafka_tpu.parallel import DistributedStepError
+        from kafka_tpu.parallel import distributed as dist
+
+        gate = threading.Event()
+        before = dict(dist.reform_stats)
+        try:
+            with pytest.raises(DistributedStepError, match="peer process"):
+                dist.guarded_collective(gate.wait, timeout_s=0.2,
+                                        label="psum")
+        finally:
+            gate.set()
+        assert dist.reform_stats == before
+
+    def test_env_disable(self, monkeypatch):
+        from kafka_tpu.parallel import distributed as dist
+
+        monkeypatch.setattr(dist, "_INITIALIZED", True)
+        monkeypatch.setenv("KAFKA_TPU_DIST_REFORM", "0")
+
+        def must_not_run(name, timeout_s=60.0):  # pragma: no cover
+            raise AssertionError("reform barrier ran while disabled")
+
+        monkeypatch.setattr(dist, "barrier", must_not_run)
+        assert dist.reform_topology("psum") is False
+
+
 # Worker for the kill matrix: both processes run guarded steps in
 # lockstep — each step is a local psum plus a coordination-service
 # rendezvous (the cross-process sync point a multi-host decode step

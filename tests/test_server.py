@@ -728,3 +728,60 @@ class TestResizeEndpoint:
                 await client.close()
 
         asyncio.run(go())
+
+    def test_resize_roles_passthrough(self, tmp_path):
+        """ISSUE 13 satellite: an optional "roles" spec rides the same
+        endpoint into resize_dp (absent = today's keep-current
+        behavior, ""/null dissolves the pools)."""
+        calls = []
+        llm = FakeLLM([])
+
+        class FakeEngine:
+            def rebuild(self, dp, roles=None):
+                pass
+
+        async def resize_dp(dp, drain_timeout_s=30.0, **kw):
+            calls.append((dp, kw.get("roles", "<absent>")))
+            return True
+
+        llm.engine = FakeEngine()
+        llm.resize_dp = resize_dp
+        db = LocalDBClient(str(tmp_path / "rr.db"))
+
+        async def go():
+            app = await create_app(
+                cfg=ServingConfig(db_path=str(tmp_path / "rr.db"),
+                                  api_token="admin-secret"),
+                llm_provider=llm, db=db, tools=[],
+            )
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                r = await client.post(
+                    "/admin/resize",
+                    json={"dp": 3, "roles": "prefill:1,decode:2"},
+                    headers=self.ADMIN,
+                )
+                assert r.status == 200
+                assert (await r.json()) == {
+                    "dp": 3, "clean": True,
+                    "roles": "prefill:1,decode:2",
+                }
+                r = await client.post("/admin/resize",
+                                      json={"dp": 2, "roles": None},
+                                      headers=self.ADMIN)
+                assert r.status == 200
+                assert (await r.json())["roles"] is None
+                r = await client.post("/admin/resize",
+                                      json={"dp": 2, "roles": 7},
+                                      headers=self.ADMIN)
+                assert r.status == 400
+                r = await client.post("/admin/resize", json={"dp": 2},
+                                      headers=self.ADMIN)
+                assert "roles" not in (await r.json())
+                assert calls == [(3, "prefill:1,decode:2"), (2, None),
+                                 (2, "<absent>")]
+            finally:
+                await client.close()
+
+        asyncio.run(go())
