@@ -811,6 +811,195 @@ def kv_tier_phase(cfg, params, n_churn: int = 3, prompt_len: int = 2048,
     }
 
 
+def sleep_wake_phase(cfg, params, n_threads: int = 4, common_len: int = 512,
+                     suffix_len: int = 64, gen_len: int = 16,
+                     page_size: int = 16, seed: int = 41,
+                     object_dir=None) -> dict:
+    """Object-store sleep/wake proof (ISSUE 14): N threads with a shared
+    system prefix go dormant PAST the disk tier (replica drained to the
+    shared object store), then wake on a DIFFERENT replica — a fresh
+    engine that never served them, standing in for any host mounting the
+    same store after the original was torn down.
+
+    Measures the two things the tier exists for:
+      * cold-resume TTFT A/B — waking from the object store (fetch +
+        H2D import + suffix-only prefill, ``cache_source="object_tier"``)
+        vs the full re-prefill a storeless fresh replica pays, with
+        0 prompt tokens recomputed inside the woken span;
+      * the store itself — put/get MB/s and the cross-host dedupe ratio
+        (the wake replica re-drained: its archive of the shared prefix
+        must find every object already present).
+
+    Outputs are asserted token-identical against a never-slept reference
+    engine serving the same two turns — the portability proof: moving a
+    thread across hosts changes WHERE it decodes, never WHAT.
+
+    Importable by the tier-1 CPU smoke (tests/test_object_tier.py): the
+    wake < re-prefill TTFT ordering holds by construction — an object
+    fetch + page import vs a full-prompt prefill."""
+    import shutil
+    import tempfile
+
+    from kafka_tpu.runtime import EngineConfig, GenRequest, InferenceEngine
+
+    rng = random.Random(seed)
+    own_dir = object_dir is None
+    if own_dir:
+        object_dir = tempfile.mkdtemp(prefix="kafka-kv-object-")
+    total = common_len + suffix_len + 2 * gen_len
+    win_pages = max(4, -(-(total + 2 * page_size) // page_size))
+
+    def mk(with_store: bool):
+        ecfg = EngineConfig(
+            max_batch=2, page_size=page_size,
+            max_pages_per_seq=win_pages,
+            num_pages=(n_threads + 2) * win_pages + 2,
+            prefill_buckets=(16, 64, 256, 512, 1024, 2048),
+            kv_host_tier_mb=256,
+            kv_object_dir=object_dir if with_store else None,
+        )
+        return InferenceEngine(cfg, params, ecfg)
+
+    common = make_prompt(rng, common_len, cfg.vocab_size)
+    suffixes = [make_prompt(rng, suffix_len, cfg.vocab_size)
+                for _ in range(n_threads)]
+    tails = [make_prompt(rng, max(4, gen_len // 2), cfg.vocab_size)
+             for _ in range(n_threads)]
+
+    def serve_first_turns(eng):
+        outs = []
+        for i, sfx in enumerate(suffixes):
+            r = GenRequest(request_id=f"sw-{i}", prompt_ids=common + sfx,
+                           max_new_tokens=gen_len, prefix_key=f"sw-t{i}")
+            eng.submit(r)
+            eng.run_to_completion()
+            outs.append(list(r.output_ids))
+        return outs
+
+    def warm_compiles(eng):
+        # compile the buckets + decode + the tier's ship programs
+        # outside any measured resume (the classic bench pollution):
+        # the wake path prefills only the short post-wake suffix, so its
+        # small bucket needs compiling too
+        for n in (total, 32, max(4, gen_len // 2)):
+            eng.generate(make_prompt(rng, n, cfg.vocab_size),
+                         max_new_tokens=2)
+        eng.warmup_kv_tier()
+
+    # ---- replica A: serve, then drain to the store ----------------------
+    a_eng = mk(with_store=True)
+    warm_compiles(a_eng)
+    first_outputs = serve_first_turns(a_eng)
+    t0 = time.monotonic()
+    sleep_stats = a_eng.sleep_to_object()
+    sleep_s = time.monotonic() - t0
+    obj_a = a_eng.kv_tier.object
+    put_bytes = obj_a.object_bytes_put
+    del a_eng  # replica A is gone (autoscaler scale-in / host loss)
+
+    # ---- replica B: fresh engine, same store — wake ---------------------
+    def resume_all(eng, label):
+        rows = []
+        for i in range(n_threads):
+            prompt = common + suffixes[i] + first_outputs[i] + tails[i]
+            r = GenRequest(request_id=f"{label}-{i}", prompt_ids=prompt,
+                           max_new_tokens=gen_len, prefix_key=f"sw-t{i}")
+            eng.submit(r)
+            eng.run_to_completion()
+            rows.append(r)
+        return rows
+
+    b_eng = mk(with_store=True)
+    warm_compiles(b_eng)
+    t0 = time.monotonic()
+    woken = resume_all(b_eng, "wake")
+    wake_s = time.monotonic() - t0
+    obj_b = b_eng.kv_tier.object
+    got_bytes = obj_b.object_bytes_got
+    # stored whole-page history per thread (what a wake can cover)
+    ps = page_size
+    recomputed = 0
+    for i, r in enumerate(woken):
+        # the final sampled token's KV is never materialized (it is the
+        # pending decode input), so the storable history is one short
+        stored = common_len + suffix_len + len(first_outputs[i]) - 1
+        coverable = min((stored // ps) * ps,
+                        ((len(r.prompt_ids) - 1) // ps) * ps)
+        recomputed += max(0, coverable - r.cached_tokens)
+    wake_ttft_ms = [round((r.first_token_time - r.submit_time) * 1e3, 2)
+                    for r in woken]
+    # cross-host dedupe: replica B drains too — every shared-prefix
+    # object must already be present (one object per run fleet-wide).
+    # Deltas, not lifetime counters: organic archive activity on B
+    # before this drain must not skew the drain's own ratio.
+    dedupe0 = obj_b.dedupe_hits
+    puts0 = obj_b.object_puts
+    b_eng.sleep_to_object()
+    dedupe = obj_b.dedupe_hits - dedupe0
+    tried = (obj_b.object_puts - puts0) + dedupe
+
+    # ---- baseline: fresh storeless replica = full re-prefill ------------
+    c_eng = mk(with_store=False)
+    warm_compiles(c_eng)
+    cold = resume_all(c_eng, "cold")
+    cold_ttft_ms = [round((r.first_token_time - r.submit_time) * 1e3, 2)
+                    for r in cold]
+
+    # ---- reference: never-slept engine, token-exactness -----------------
+    ref_eng = mk(with_store=False)
+    ref_first = serve_first_turns(ref_eng)
+    ref = resume_all(ref_eng, "ref")
+    outputs_match = (
+        ref_first == first_outputs
+        and all(list(ref[i].output_ids) == list(woken[i].output_ids)
+                for i in range(n_threads))
+        and all(list(ref[i].output_ids) == list(cold[i].output_ids)
+                for i in range(n_threads))
+    )
+
+    snap_obj = obj_b.snapshot()
+    if own_dir:
+        shutil.rmtree(object_dir, ignore_errors=True)
+    # The A/B is the FIRST resume on each fresh replica: it alone pays
+    # the full cold cost (object wake vs full-history re-prefill).  Once
+    # it lands, the shared prefix is LOCAL on both sides — later threads
+    # compare tail-resume vs tail-resume, which measures the radix
+    # cache, not the store (their figures ride along as the lists).
+    return {
+        "n_threads": n_threads,
+        "common_prefix_tokens": common_len,
+        "wake_ttft_ms": wake_ttft_ms,
+        "reprefill_ttft_ms": cold_ttft_ms,
+        "cold_resume_ttft_ms": {
+            "object_wake": wake_ttft_ms[0],
+            "reprefill": cold_ttft_ms[0],
+        },
+        "speedup": round(cold_ttft_ms[0] / wake_ttft_ms[0], 2)
+        if wake_ttft_ms[0] else None,
+        "cache_sources": [r.cache_source for r in woken],
+        "object_tokens": [r.object_tokens for r in woken],
+        "prompt_tokens_recomputed": recomputed,
+        "sleep": sleep_stats,
+        "store_put_mb_s": round(put_bytes / sleep_s / 1e6, 1)
+        if sleep_s else None,
+        "store_get_mb_s": round(got_bytes / wake_s / 1e6, 1)
+        if wake_s else None,
+        "cross_host_dedupe_hits": dedupe,
+        "cross_host_dedupe_ratio": round(
+            dedupe / tried, 3) if tried else 0.0,
+        "wake_threads": snap_obj["wake_threads"],
+        "store_bytes": snap_obj["store_bytes"],
+        "store_objects": snap_obj["store_objects"],
+        "outputs_match": outputs_match,
+        "note": ("N threads drained past disk into the shared object "
+                 "store by replica A wake on a FRESH replica B "
+                 "(cache_source=object_tier, 0 coverable prompt tokens "
+                 "recomputed) vs a storeless replica's full re-prefill; "
+                 "replica B's own drain dedupes against A's objects "
+                 "(content-addressed prefixes, one object fleet-wide)"),
+    }
+
+
 def disagg_phase(cfg, params, n_chatty: int = 4, n_long: int = 4,
                  chatty_prompt: int = 48, chatty_gen: int = 96,
                  long_prompt: int = 1025, long_gen: int = 8,
@@ -1765,12 +1954,15 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("scenario", nargs="?", default="all",
                     choices=("all", "speculative", "constrained", "kv_tier",
-                             "disagg", "autoscale"),
+                             "sleep_wake", "disagg", "autoscale"),
                     help="'speculative' runs ONLY the speculative-decoding "
                          "A/B phase; 'constrained' runs ONLY the on-device "
                          "grammar FSM vs host-mask A/B; 'kv_tier' runs ONLY "
                          "the tiered-KV cold-resume A/B (promote vs "
-                         "re-prefill); 'disagg' runs ONLY the disaggregated "
+                         "re-prefill); 'sleep_wake' runs ONLY the "
+                         "object-store sleep/wake A/B (drain replica A, "
+                         "wake on a fresh replica B vs full re-prefill); "
+                         "'disagg' runs ONLY the disaggregated "
                          "prefill/decode A/B (colocated vs "
                          "prefill:1,decode:1 under mixed open-loop traffic); "
                          "'autoscale' runs ONLY the traffic-ramp phase with "
@@ -1903,6 +2095,32 @@ def main() -> None:
         print(json.dumps({
             "metric": f"kv_tier_cold_resume_speedup_{cfg.name}",
             "value": out["resume_ttft_ms"]["speedup"],
+            "unit": "x",
+            "extras": out,
+        }))
+        return
+
+    if args.scenario == "sleep_wake":
+        # bench.py sleep_wake: ONLY the object-store sleep/wake A/B
+        out = sleep_wake_phase(
+            cfg, params,
+            n_threads=3 if args.quick else 4,
+            common_len=496 if args.quick else 512,
+            suffix_len=16 if args.quick else 64,
+            gen_len=8 if args.quick else 16,
+            page_size=8 if args.quick else 16,
+        )
+        log(f"sleep_wake: cold-resume TTFT object-wake "
+            f"{out['cold_resume_ttft_ms']['object_wake']}ms vs "
+            f"re-prefill {out['cold_resume_ttft_ms']['reprefill']}ms "
+            f"({out['speedup']}x), {out['prompt_tokens_recomputed']} "
+            f"prompt tokens recomputed, store put/get "
+            f"{out['store_put_mb_s']}/{out['store_get_mb_s']} MB/s, "
+            f"dedupe ratio {out['cross_host_dedupe_ratio']}, "
+            f"outputs_match {out['outputs_match']}")
+        print(json.dumps({
+            "metric": f"sleep_wake_cross_host_resume_speedup_{cfg.name}",
+            "value": out["speedup"],
             "unit": "x",
             "extras": out,
         }))
@@ -2089,6 +2307,21 @@ def main() -> None:
         f"{kv_tier['resume_ttft_ms']['promote']}ms vs re-prefill "
         f"{kv_tier['resume_ttft_ms']['reprefill']}ms "
         f"({kv_tier['resume_ttft_ms']['speedup']}x)")
+
+    # ---- sleep_wake: object-store cross-host resume (ISSUE 14) ----------
+    sleep_wake = sleep_wake_phase(
+        cfg, params,
+        n_threads=3 if args.quick else 4,
+        common_len=496 if args.quick else 512,
+        suffix_len=16 if args.quick else 64,
+        gen_len=8 if args.quick else 16,
+        page_size=8 if args.quick else 16,
+    )
+    log(f"sleep_wake: cold-resume TTFT object-wake "
+        f"{sleep_wake['cold_resume_ttft_ms']['object_wake']}ms vs "
+        f"re-prefill {sleep_wake['cold_resume_ttft_ms']['reprefill']}ms "
+        f"({sleep_wake['speedup']}x), dedupe ratio "
+        f"{sleep_wake['cross_host_dedupe_ratio']}")
 
     # ---- disaggregated prefill/decode: colocated vs role pools ----------
     disagg = None
@@ -2356,6 +2589,7 @@ def main() -> None:
             },
             "shared_prefix": shared_prefix,
             "kv_tier": kv_tier,
+            "sleep_wake": sleep_wake,
             "disagg": disagg,
             "autoscale": autoscale,
             "speculative": speculative,

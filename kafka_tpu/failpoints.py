@@ -76,6 +76,16 @@ SITES = (
     # page and the thread degrades to re-prefill on the decode replica,
     # never partial KV; `delay` simulates a slow inter-replica link.
     "kv.ship",
+    # Object-store KV tier (runtime/object_tier.py): fired once per OBJECT
+    # (run payload or sleep manifest).  `error` on a put = torn write
+    # discarded before the ref/manifest commit (atomic rename; no partial
+    # object, no dangling reference — the archive degrades to plain
+    # eviction and a sleep entry is skipped); `error` on a get = miss —
+    # the whole wake aborts with ALL partially-promoted pages freed and
+    # the request degrades to the disk-tier/local hit or a plain
+    # re-prefill; `delay` simulates a slow store.
+    "kv.object_put",
+    "kv.object_get",
     "worker.dispatch",
     "sandbox.exec",
     "sandbox.boot",

@@ -305,6 +305,12 @@ class TPULLMProvider(LLMProvider):
           ``slo.window_1m_requests`` (how many MET/MISSED verdicts back
           the 1m attainment gauge, so a reader can tell "1.0 because
           everything met" from "1.0 because nothing finished").
+        * ``object_tier`` (version 5, ISSUE 14): the shared object
+          store's occupancy, cross-host dedupe ratio, and sleep-manifest
+          wake counts — with the tier mounted, scale-in is
+          drain-then-shrink (warm state survives the removed replica),
+          so a controller can shrink more aggressively.  Null when
+          KAFKA_TPU_KV_OBJECT_DIR is unset.
 
         Everything is read torn-tolerantly from the engine thread's
         single-writer metrics; no locks, safe at scrape frequency.
@@ -406,20 +412,42 @@ class TPULLMProvider(LLMProvider):
             (w1.get("met") or 0) + (w1.get("missed") or 0)
         )
         scaler = self.autoscaler
+        # Object-store tier (version 5, ISSUE 14): shared-store occupancy,
+        # the cross-host dedupe ratio, and wake counts — the autoscaler's
+        # "drain-then-shrink is cheap here" signal.  Null when
+        # KAFKA_TPU_KV_OBJECT_DIR is unset.
+        obj = snap.get("object_tier") or None
+        object_section = None
+        if obj:
+            tried = (obj.get("object_puts", 0)
+                     + obj.get("dedupe_hits", 0))
+            object_section = {
+                "store_bytes": obj.get("store_bytes", 0),
+                "store_objects": obj.get("store_objects", 0),
+                "dedupe_ratio": round(
+                    obj.get("dedupe_hits", 0) / tried, 4
+                ) if tried else 0.0,
+                "wake_threads": obj.get("wake_threads", 0),
+                "wake_tokens": obj.get("wake_tokens", 0),
+            }
         return {
-            # version 4 (ISSUE 13): + autoscaler section (control-loop
-            # mode, degradation-ladder rung, cooldowns, last decision —
-            # null when KAFKA_TPU_AUTOSCALE is off) and
-            # slo.window_1m_requests (verdict count behind the 1m
-            # attainment gauge).  Version 3 (ISSUE 12) added the pools
-            # section and disagg ship counters; version 2 (ISSUE 11)
-            # the anomalies section, per-replica anomalies_active, and
-            # the measured-utilization fields under utilization.*.
-            "version": 4,
+            # version 5 (ISSUE 14): + object_tier section (shared-store
+            # bytes/objects, dedupe ratio, wake counts — null without
+            # KAFKA_TPU_KV_OBJECT_DIR).  Version 4 (ISSUE 13) added the
+            # autoscaler section (control-loop mode, degradation-ladder
+            # rung, cooldowns, last decision — null when
+            # KAFKA_TPU_AUTOSCALE is off) and slo.window_1m_requests
+            # (verdict count behind the 1m attainment gauge).  Version 3
+            # (ISSUE 12) added the pools section and disagg ship
+            # counters; version 2 (ISSUE 11) the anomalies section,
+            # per-replica anomalies_active, and the
+            # measured-utilization fields under utilization.*.
+            "version": 5,
             "dp": len(replicas),
             "queue": dict(snap.get("queue") or {}),
             "anomalies": anomalies,
             "pools": pools,
+            "object_tier": object_section,
             "disagg": {
                 k: v for k, v in disagg.items()
                 if k not in ("pools", "ship_ms")
@@ -641,6 +669,61 @@ class TPULLMProvider(LLMProvider):
             if not self._rebuild_owns_resume:
                 self.worker.resume()
         return clean
+
+    async def drain_replica(self, replica: int) -> Dict[str, Any]:
+        """Flush one replica's warm KV state into the shared object
+        store (POST /admin/drain/{replica}, ISSUE 14): every cached
+        radix run archived content-addressed + every thread's sleep
+        manifest written, so a subsequent scale-in removing the replica
+        discards no warm conversation — dormant threads wake on the
+        survivors (cache_source="object_tier") instead of
+        re-prefilling.  Non-destructive and idempotent (re-archiving
+        present content is a reference-only dedupe).
+
+        Runs with the worker PARKED (the flush gathers pool pages and
+        walks the radix tree — both single-writer engine state) and
+        serialized against resizes via the same lock, so a drain can
+        never race the rebuild that follows it."""
+        return (await self.drain_replicas([replica]))[0]
+
+    async def drain_replicas(self, indices) -> List[Dict[str, Any]]:
+        """drain_replica over several replicas under ONE worker pause —
+        the autoscaler's pre-scale-in drain covers the whole fleet (the
+        rebuild recreates every engine), and one pause/flush cycle per
+        replica would stall serving N times for N flushes."""
+        indices = list(indices)
+        async with self._resize_lock:
+            # resolve the replicas UNDER the lock: a resize rebuilds the
+            # replica list wholesale, and a pre-lock snapshot could pass
+            # a stale bounds check and then flush a torn-down engine
+            replicas = self._replicas()
+            sleeps = []
+            for i in indices:
+                if not 0 <= i < len(replicas):
+                    raise ValueError(
+                        f"replica {i} out of range (dp={len(replicas)})"
+                    )
+                sleep = getattr(replicas[i], "sleep_to_object", None)
+                if sleep is None:
+                    raise ValueError(
+                        "this engine cannot drain to an object store"
+                    )
+                sleeps.append(sleep)
+            if not await asyncio.to_thread(self.worker.pause):
+                self.worker.resume()
+                raise RuntimeError("engine worker did not pause")
+            try:
+                # the tree walks + D2H gathers can take seconds on warm
+                # replicas: run off the event loop so /health stays live
+                # (sequential inside one executor job — the flushes
+                # mutate device state under the single-writer contract)
+                all_stats = await asyncio.get_running_loop(
+                ).run_in_executor(None, lambda: [s() for s in sleeps])
+            finally:
+                self.worker.resume()
+        for i, stats in zip(indices, all_stats):
+            stats["replica"] = i
+        return all_stats
 
     def get_model_info(self, model: Optional[str] = None) -> Dict[str, Any]:
         return {

@@ -124,6 +124,7 @@ COUNTER_KEYS = (
     "autoscaler_degrades",
     "autoscaler_recovers",
     "autoscaler_vetoes",
+    "autoscaler_drains",
 )
 
 
@@ -751,7 +752,64 @@ class AutoscalerController:
             return f"error:{e}"
         return "applied"
 
+    def _object_tier_enabled(self) -> bool:
+        if self.ladder is None:
+            return False
+        try:
+            for e in self.ladder._engines():
+                tier = getattr(e, "kv_tier", None)
+                if tier is not None and getattr(tier, "object",
+                                                None) is not None:
+                    return True
+        except Exception:  # pragma: no cover - provider shim variance
+            pass
+        return False
+
+    def _drain_before_shrink(self) -> None:
+        """Drain-then-shrink (ISSUE 14): before a scale-in, flush EVERY
+        replica's warm KV state to the shared object store — the rebuild
+        recreates the whole replica set, so survivors' radix trees are
+        discarded too, not just the removed tail's; dormant threads then
+        wake on the new topology instead of re-prefilling.  Scale-OUT
+        deliberately skips the drain: it fires under overload, where
+        adding capacity NOW beats preserving warm state behind a parked
+        worker (organic archives still cover whatever the ladder had
+        already pushed past disk).  Best-effort — a failed drain must
+        never block the resize the attainment math asked for (the cost
+        is warm state, not correctness)."""
+        drain = getattr(self.provider, "drain_replicas", None)
+        if (
+            drain is None or self._loop is None
+            or not self._object_tier_enabled()
+        ):
+            return
+        import asyncio
+
+        try:
+            fut = asyncio.run_coroutine_threadsafe(
+                drain(range(self._last_dp)), self._loop
+            )
+            all_stats = fut.result(
+                timeout=self.cfg.resize_drain_s + 60.0
+            )
+            self.counters["autoscaler_drains"] += len(all_stats)
+            logger.warning(
+                "autoscaler drained %d replica(s) to the object store "
+                "before scale-in (%s)", len(all_stats), all_stats,
+            )
+        except Exception:
+            logger.exception(
+                "pre-scale-in drain failed; shrinking anyway (warm "
+                "state re-prefills)",
+            )
+
     def _resize(self, dp: int, roles: Optional[str]) -> Any:
+        if (
+            self.provider is not None
+            and self._last_dp
+            and dp < self._last_dp
+        ):
+            self._drain_before_shrink()
         if self._resize_fn is not None:
             return self._resize_fn(dp, roles)
         if self.provider is None or self._loop is None:

@@ -589,8 +589,12 @@ class DataParallelEngines:
             return home
         # memoized probe (shared with _pick_within's routing probe): a
         # warm fan-out head costs O(1) here instead of a second full
-        # radix walk per submit on the engine thread
+        # radix walk per submit on the engine thread.  A sleep-manifest
+        # match counts too: the decode home can WAKE those tokens from
+        # the object store, so shipping a fresh prefill of them would
+        # only duplicate KV the store already holds.
         cached = self._probe_matches([home], req.prompt_ids)[home]
+        cached = max(cached, self._object_match(req))
         if len(req.prompt_ids) - cached < self.min_prefill_tokens:
             self.disagg.prefill_in_place += 1
             return home
@@ -632,7 +636,8 @@ class DataParallelEngines:
                         return pin
             match = self._probe_matches(routable, req.prompt_ids)
             best = max(match.values())
-            if best > 0:
+            obj_match = self._object_match(req)
+            if best > 0 and best >= obj_match:
                 cands = [i for i in routable if match[i] == best]
                 if pin in cands:
                     return pin
@@ -644,9 +649,36 @@ class DataParallelEngines:
                 # least-loaded routable (it warms its own copy on this
                 # prefill) — NOT the pin, which may be deeper still
                 return min(routable, key=self._load)
+            if obj_match > 0:
+                # The shared object store matches deeper than any local
+                # cache: EVERY routable replica can wake the thread from
+                # its sleep manifest, so affinity is a hint, not a
+                # constraint (ISSUE 14) — keep the pin while its load is
+                # reasonable, otherwise let load decide outright.
+                if pin is not None:
+                    floor_load = min(self._load(i) for i in routable)
+                    if self._load(pin) - floor_load <= self.ecfg.max_batch:
+                        return pin
+                return min(routable, key=self._load)
         if pin is not None:
             return pin
         return min(routable, key=self._load)
+
+    def _object_match(self, req: GenRequest) -> int:
+        """Longest sleep-manifest-covered prefix of the request's prompt
+        in the SHARED object store (0 without an object tier).  Cheap:
+        one cached manifest read keyed by the thread's prefix key."""
+        if req.prefix_key is None:
+            return 0
+        tier = getattr(self.engines[0], "kv_tier", None)
+        obj = getattr(tier, "object", None) if tier is not None else None
+        if obj is None:
+            return 0
+        try:
+            return obj.manifest_match_tokens(req.prefix_key,
+                                             req.prompt_ids)
+        except Exception:  # pragma: no cover - store flake
+            return 0
 
     def _probe_matches(
         self, routable: List[int], prompt_ids: List[int]
@@ -841,10 +873,12 @@ class DataParallelEngines:
         Delta shipping: pages the destination already caches (the shared
         fan-out head) are skipped — store() descends the matched runs
         without touching the dummy page entries passed for them.  The
-        probe is exact (same thread, no tree mutation in between), but a
-        destination KV tier counts HOST-RESIDENT runs as matched while
-        store() would ADOPT the incoming page ids for them, so the delta
-        path is gated on the destination having no tier.
+        probe is exact (same thread, no tree mutation in between), and
+        the skip is keyed on run CONTENT (match_tokens matches by token
+        runs; store()'s host-run adoption requires real page ids, so a
+        tier-resident matched run keeps its tier copy instead of
+        capturing a dummy entry) — tiered destinations delta-ship like
+        untiered ones (PR 12 follow-up, ISSUE 14).
 
         Torn-copy semantics: ship() raising leaves the destination pages
         partially written — they are freed in full (freshly allocated,
@@ -863,8 +897,6 @@ class DataParallelEngines:
             return {"shipped": False}
 
         def probe_skip() -> int:
-            if dst_e.kv_tier is not None:
-                return 0
             return min(cache.match_tokens(tokens) // ps, n_full)
 
         skip = probe_skip()
@@ -1319,6 +1351,19 @@ class _AggregateMetrics:
             agg["kv_tier"] = {
                 k: sum(t[k] for t in tier_snaps)
                 for k in tier_snaps[0]
+            }
+        # Object-store KV tier (ISSUE 14, OBJECT_TIER_METRIC_KEYS):
+        # per-owner counters sum; the store gauges describe the ONE
+        # SHARED store every replica mounts, so they report once,
+        # unsummed (summing would multiply by dp)
+        obj_snaps = [s["object_tier"] for s in snaps
+                     if "object_tier" in s]
+        if obj_snaps:
+            shared = ("store_bytes", "store_objects")
+            agg["object_tier"] = {
+                k: (obj_snaps[0][k] if k in shared
+                    else sum(t[k] for t in obj_snaps))
+                for k in obj_snaps[0]
             }
         # Flight recorder + anomaly detectors (ISSUE 11): counters sum;
         # each active anomaly carries the replica it fires on so the

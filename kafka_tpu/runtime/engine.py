@@ -54,6 +54,7 @@ import contextlib
 import dataclasses
 import itertools
 import logging
+import os
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -175,6 +176,20 @@ class EngineConfig:
     # instead of dropping them; the tracing span ring persists alongside.
     # None/"" = drop on host-tier overflow.
     kv_disk_tier_dir: Optional[str] = None
+    # Object-store KV tier (KAFKA_TPU_KV_OBJECT_DIR, README "Object-store
+    # KV tier", ISSUE 14): a SHARED store below host+disk that makes
+    # thread state portable across hosts — runs the local ladder would
+    # drop archive there content-addressed (identical prefixes dedupe
+    # across hosts), per-thread sleep manifests let a dormant thread wake
+    # on ANY replica (cache_source="object_tier" instead of re-prefill),
+    # and POST /admin/drain/{replica} flushes a replica's warm state
+    # before the autoscaler shrinks it away.  None/"" (default) =
+    # disabled; every dispatch/eviction path is byte-identical to before.
+    kv_object_dir: Optional[str] = None
+    # Byte budget (MiB) on the object-store references THIS replica
+    # holds (second-chance LRU; dropping the last reference deletes the
+    # object).  0 = unbounded.  KAFKA_TPU_KV_OBJECT_MB.
+    kv_object_mb: int = 0
     # Context-parallel strategy for sp>1 chunked prefill: "ring" (KV shards
     # rotate over ICI — bandwidth-optimal, any head count) or "ulysses"
     # (all_to_all to head-sharded layout — needs heads/tp % sp == 0).
@@ -324,11 +339,15 @@ class GenRequest:
     # prior turn or another thread's shared prefix.  Rides out on the
     # engine.prefill span and usage.prompt_tokens_details.cached_tokens.
     cached_tokens: int = 0
-    cache_source: Optional[str] = None  # "own" | "cross" | "host_tier"
+    # "own" | "cross" | "host_tier" | "object_tier" | "shipped"
+    cache_source: Optional[str] = None
     # Tokens of the hit re-materialized from the host/disk KV tier
     # (runtime/kv_tier.py) rather than found in HBM — rides out on the
     # engine.prefill span so a resume-without-re-prefill is provable.
     promoted_tokens: int = 0
+    # Tokens of the hit woken from the shared OBJECT store (runtime/
+    # object_tier.py): the cross-host resume-without-re-prefill proof.
+    object_tokens: int = 0
     # The FIRST admission's radix share, frozen at the first prefill
     # start (usage.prompt_tokens_details.cached_tokens reads this).
     # cached_tokens above tracks the LATEST attach — a preemption or
@@ -844,6 +863,10 @@ class InferenceEngine:
             raise ValueError(
                 "kv_host_tier_mb must be >= 0 (0 disables the host tier)"
             )
+        if self.ecfg.kv_object_mb < 0:
+            raise ValueError(
+                "kv_object_mb must be >= 0 (0 = unbounded references)"
+            )
         self.prefix_cache: Optional[PrefixCache] = (
             PrefixCache(self.pool, max_pages=self.ecfg.prefix_cache_pages)
             if self.ecfg.prefix_cache_entries > 0
@@ -855,7 +878,9 @@ class InferenceEngine:
         # exists (the radix tree is what names demotable runs); with the
         # knob unset every eviction/dispatch path is byte-identical.
         self.kv_tier = None
-        if self.prefix_cache is not None and self.ecfg.kv_host_tier_mb > 0:
+        if self.prefix_cache is not None and (
+            self.ecfg.kv_host_tier_mb > 0 or self.ecfg.kv_object_dir
+        ):
             from .kv_tier import KVTierManager, LocalPageShipper
 
             self.kv_tier = KVTierManager(
@@ -865,6 +890,21 @@ class InferenceEngine:
                 page_size=ps,
             )
             self.prefix_cache.tier = self.kv_tier
+            if self.ecfg.kv_object_dir:
+                # Object-store tier (ISSUE 14): mounted under the tier
+                # manager (which may run host-budget-0 as a pure mount
+                # point when only the object knob is set — the full
+                # ladder wants both).  The content-address fingerprint
+                # covers the pool geometry + model name, so incompatible
+                # pools can never exchange KV through a shared store.
+                from .object_tier import LocalFSObjectStore, ObjectTier
+
+                self.kv_tier.attach_object(ObjectTier(
+                    LocalFSObjectStore(self.ecfg.kv_object_dir),
+                    budget_bytes=self.ecfg.kv_object_mb * 1024 * 1024,
+                    fingerprint=self._object_fingerprint(),
+                    page_size=ps,
+                ))
         if self.ecfg.flight_ring < 0:
             raise ValueError(
                 "flight_ring must be >= 0 (0 disables the flight recorder)"
@@ -1057,6 +1097,8 @@ class InferenceEngine:
             kw["cache_source"] = req.cache_source
             if req.promoted_tokens:
                 kw["promoted_tokens"] = req.promoted_tokens
+            if req.object_tokens:
+                kw["object_tokens"] = req.object_tokens
         return self._tattrs(**kw)
 
     def _dispatch_scope(self, members: Sequence[Optional["GenRequest"]]):
@@ -1699,6 +1741,36 @@ class InferenceEngine:
             pending = ship.export_run([TRASH_PAGE] * b)
             k_leaves, v_leaves = ship.resolve(pending)
             ship.import_run(k_leaves, v_leaves, b, [TRASH_PAGE] * b)
+
+    def _object_fingerprint(self) -> str:
+        """The object tier's content-address fingerprint: model name +
+        page geometry + per-slot pool layout (+ an operator namespace,
+        KAFKA_TPU_KV_OBJECT_NAMESPACE — bump it when weights change
+        under an unchanged config, since the hash cannot see weights).
+        Two engines agreeing on this can exchange KV runs byte-for-byte
+        through a shared store; any mismatch partitions the store."""
+        leaves = jax.tree.leaves(self.k_pool) + jax.tree.leaves(self.v_pool)
+        geo = ",".join(
+            f"{a.dtype}:{a.shape[0]}x{tuple(a.shape[2:])}" for a in leaves
+        )
+        ns = os.environ.get("KAFKA_TPU_KV_OBJECT_NAMESPACE", "")
+        return f"{self.cfg.name}|ps{self.ecfg.page_size}|{geo}|{ns}"
+
+    def sleep_to_object(self) -> Dict[str, Any]:
+        """Flush this engine's warm KV state (every cached radix run +
+        per-thread sleep manifests) into the shared object store — the
+        POST /admin/drain/{replica} seam, used by the autoscaler's
+        drain-then-shrink scale-in.  Non-destructive; see
+        PrefixCache.sleep_to_object for the contract.  Must run with the
+        scheduler quiesced (single-writer: the provider parks the
+        worker first)."""
+        if (
+            self.prefix_cache is None
+            or self.kv_tier is None
+            or self.kv_tier.object is None
+        ):
+            return {"enabled": False}
+        return self.prefix_cache.sleep_to_object()
 
     def take_waiting(self) -> List[GenRequest]:
         """Remove and return every WAITING request (they own no device
@@ -2472,8 +2544,10 @@ class InferenceEngine:
         req.cached_tokens = 0
         req.cache_source = None
         req.promoted_tokens = 0
+        req.object_tokens = 0
         if self.kv_tier is not None:
-            # kv.promote spans inside the lookup attach to this request
+            # kv.promote / kv.object_get / thread.wake spans inside the
+            # lookup attach to this request
             self.kv_tier.trace_ctx = req.trace
         try:
             hit = self.prefix_cache.lookup(req.prefix_key, req.prefill_ids)
@@ -2486,6 +2560,7 @@ class InferenceEngine:
             req.cached_tokens = hit.tokens
             req.cache_source = hit.source
             req.promoted_tokens = hit.promoted_tokens
+            req.object_tokens = hit.object_tokens
 
     def _reclaim_cache(self, pages_needed: int,
                        req: Optional[GenRequest] = None) -> bool:
@@ -2514,6 +2589,7 @@ class InferenceEngine:
         req.cached_tokens = 0
         req.cache_source = None
         req.promoted_tokens = 0
+        req.object_tokens = 0
 
     def _admit(self) -> None:
         # Strict submit-order FIFO across BOTH queues: each free slot goes

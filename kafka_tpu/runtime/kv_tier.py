@@ -170,6 +170,44 @@ def _storable(arr: np.ndarray) -> Tuple[np.ndarray, str]:
         return arr.view(width), name
 
 
+def encode_run_npz(k_leaves: Sequence[np.ndarray],
+                   v_leaves: Sequence[np.ndarray], n_pages: int) -> bytes:
+    """ONE wire format for persisted page runs — the disk tier's spill
+    files and the object tier's payloads both use exactly this (meta
+    json + k{i}/v{i} arrays, ml_dtypes stored as same-width uints), so
+    a dtype/layout fix cannot drift between them."""
+    import io
+
+    arrays: Dict[str, np.ndarray] = {}
+    meta: Dict[str, Any] = {"n_pages": n_pages, "k": [], "v": []}
+    for side, leaves in (("k", k_leaves), ("v", v_leaves)):
+        for i, a in enumerate(leaves):
+            stored, dtype_name = _storable(np.ascontiguousarray(a))
+            arrays[f"{side}{i}"] = stored
+            meta[side].append(dtype_name)
+    buf = io.BytesIO()
+    np.savez(buf, meta=json.dumps(meta), **arrays)
+    return buf.getvalue()
+
+
+def decode_run_npz(
+    data: bytes,
+) -> Tuple[List[np.ndarray], List[np.ndarray], int]:
+    import io
+
+    with np.load(io.BytesIO(data), allow_pickle=False) as z:
+        meta = json.loads(str(z["meta"]))
+        k_leaves = [
+            z[f"k{i}"].view(_np_dtype(name))
+            for i, name in enumerate(meta["k"])
+        ]
+        v_leaves = [
+            z[f"v{i}"].view(_np_dtype(name))
+            for i, name in enumerate(meta["v"])
+        ]
+    return k_leaves, v_leaves, int(meta["n_pages"])
+
+
 class PageShipper:
     """Transport seam for page runs: export to a portable payload, import
     a payload into destination pages.  Local tier copies implement it with
@@ -436,6 +474,7 @@ class HostRun:
     __slots__ = (
         "run_id", "n_pages", "nbytes", "location", "pending",
         "k_leaves", "v_leaves", "ref_bit", "discarded",
+        "path_runs", "threads", "object_key",
     )
 
     def __init__(self, run_id: str, n_pages: int, nbytes: int,
@@ -444,13 +483,24 @@ class HostRun:
         self.n_pages = n_pages
         self.nbytes = nbytes
         # "pending" (D2H still materializing) -> "host" -> "spilling"
-        # -> "disk"
+        # -> "disk"; "object" = archived into the shared object store
+        # (runtime/object_tier.py) — payload-less locally, fetched back
+        # on promote
         self.location = "pending"
         self.pending = pending
         self.k_leaves: Optional[List[np.ndarray]] = None
         self.v_leaves: Optional[List[np.ndarray]] = None
         self.ref_bit = False  # second-chance LRU
         self.discarded = False
+        # Content-address context (object tier): the per-node token runs
+        # of the radix path from the root THROUGH this run, and the
+        # prefix keys claiming the node at demotion time.  A run's KV
+        # depends on its whole prefix, so only the full path names its
+        # content; None = demoted before the object tier existed / by a
+        # caller that cannot supply it (such runs never archive).
+        self.path_runs: Optional[List[List[int]]] = None
+        self.threads: Tuple[str, ...] = ()
+        self.object_key: Optional[str] = None
 
 
 class KVTierManager:
@@ -502,6 +552,19 @@ class KVTierManager:
         self.disk_loads = 0
         self._spill_q: "queue.Queue[Optional[HostRun]]" = queue.Queue()
         self._spill_thread: Optional[threading.Thread] = None
+        # Object-store tier below host+disk (runtime/object_tier.py,
+        # ISSUE 14): when attached, a run the local ladder would DROP is
+        # archived into the shared store instead (content-addressed, so
+        # identical prefixes dedupe across hosts) and stays promotable.
+        # None = the pre-object ladder, byte-identical.
+        self.object = None
+
+    def attach_object(self, obj: Any) -> None:
+        """Mount the object tier (engine construction).  The tier reads
+        this manager's trace context so kv.object_* spans attach to the
+        request whose pressure or wake drives them."""
+        self.object = obj
+        obj.manager = self
 
     # -- sizing ----------------------------------------------------------
 
@@ -510,13 +573,20 @@ class KVTierManager:
 
     # -- demote ----------------------------------------------------------
 
-    def demote(self, pages: Sequence[int]) -> Optional[str]:
+    def demote(self, pages: Sequence[int],
+               path_runs: Optional[List[List[int]]] = None,
+               threads: Sequence[str] = ()) -> Optional[str]:
         """Copy `pages` D2H and admit them as a host run.  Returns the run
         id, or None when the copy failed or the run cannot fit — the
         caller then falls back to plain eviction (pages are simply freed).
         The gather is enqueued before the caller releases the pages, so
         in-order device execution reads them pre-overwrite; only the host
-        materialization is deferred (see drain())."""
+        materialization is deferred (see drain()).
+
+        `path_runs` / `threads` carry the radix-path content context the
+        OBJECT tier needs (root->run token runs + claiming prefix keys):
+        with them, a run this tier would later drop archives into the
+        shared store under its content address instead (see _archive)."""
         from .autoscaler import background_deferred
 
         if background_deferred():
@@ -541,6 +611,8 @@ class KVTierManager:
             self._next_id += 1
             run = HostRun(f"{self._uid}.r{self._next_id}", len(pages),
                           nbytes, pending)
+            run.path_runs = path_runs
+            run.threads = tuple(threads)
             self._runs[run.run_id] = run
             self.host_bytes += nbytes
         dur = time.monotonic() - t0
@@ -613,10 +685,19 @@ class KVTierManager:
             logger.warning("kv split of run %s failed: %s", run_id, e)
             return None
         cut = front_pages * self.page_size
+        # content-address context splits at the same boundary: the front
+        # piece's path ends at the cut, the back piece's path carries
+        # both halves — losing it here would make every split run
+        # permanently ineligible for the object archive
+        front_path = back_path = None
+        if run.path_runs:
+            head, last = run.path_runs[:-1], run.path_runs[-1]
+            front_path = head + [last[:cut]]
+            back_path = head + [last[:cut], last[cut:]]
         ids: List[str] = []
-        for lo, hi, n in (
-            (0, cut, front_pages),
-            (cut, None, run.n_pages - front_pages),
+        for lo, hi, n, path in (
+            (0, cut, front_pages, front_path),
+            (cut, None, run.n_pages - front_pages, back_path),
         ):
             k_part = [np.ascontiguousarray(a[:, lo:hi]) for a in k_leaves]
             v_part = [np.ascontiguousarray(a[:, lo:hi]) for a in v_leaves]
@@ -627,6 +708,8 @@ class KVTierManager:
                                 nbytes, None)
                 piece.location = "host"
                 piece.k_leaves, piece.v_leaves = k_part, v_part
+                piece.path_runs = path
+                piece.threads = run.threads
                 self._runs[piece.run_id] = piece
                 self.host_bytes += nbytes
             ids.append(piece.run_id)
@@ -641,10 +724,34 @@ class KVTierManager:
                 run.ref_bit = True
 
     def discard(self, run_id: str) -> None:
-        """Drop a run (node invalidated, or its pages were re-adopted)."""
+        """Drop a run (node invalidated, or its pages were re-adopted).
+        An object-archived run also drops this owner's store reference —
+        the object itself survives while any other host references it."""
         run = self._take(run_id, load=False)
         if run is not None:
             run.discarded = True
+            if (run.location == "object" and run.object_key is not None
+                    and self.object is not None):
+                self.object.release(run.object_key)
+
+    def peek(
+        self, run_id: str
+    ) -> Optional[Tuple[List[np.ndarray], List[np.ndarray]]]:
+        """Read-only materialization for the sleep path: the run's host
+        leaves wherever it lives, WITHOUT removing it from the tier.
+        None for object-archived runs (already in the store) and on any
+        load failure (the sleep entry is skipped)."""
+        with self._lock:
+            run = self._runs.get(run_id)
+        if run is None or run.location == "object":
+            return None
+        try:
+            if run.location == "disk":
+                return self._disk_load(run)
+            return self._materialize(run)
+        except Exception as e:
+            logger.warning("kv peek of run %s failed: %s", run_id, e)
+            return None
 
     # -- background resolution & spill -----------------------------------
 
@@ -697,6 +804,21 @@ class KVTierManager:
         """Resolve a run to host numpy leaves wherever it currently lives."""
         if run.k_leaves is not None:
             return run.k_leaves, run.v_leaves
+        if run.location == "object":
+            if self.object is None or run.object_key is None:
+                raise ShipError(f"run {run.run_id} archived but no "
+                                "object tier is attached")
+            got = self.object.get_run(run.object_key)
+            if got is None or got[2] != run.n_pages:
+                # a lost object OR a payload of the wrong span (content
+                # keys include the start boundary, so this should be
+                # unreachable — but importing mismatched KV would be
+                # silent corruption, so it is a hard miss regardless)
+                raise ShipError(
+                    f"object tier lost run {run.run_id} "
+                    f"(key {run.object_key})"
+                )
+            return got[0], got[1]
         if run.location == "disk":
             k_leaves, v_leaves = self._disk_load(run)
             self.disk_loads += 1
@@ -723,6 +845,8 @@ class KVTierManager:
             if run.location == "disk":
                 self.disk_bytes -= run.nbytes
                 self.disk_runs -= 1
+            elif run.location == "object":
+                pass  # archived runs charge nothing locally
             else:
                 self.host_bytes -= run.nbytes
         if run.location == "disk":
@@ -740,7 +864,13 @@ class KVTierManager:
 
     def _readmit(self, run: HostRun) -> None:
         # a taken disk run's file is already unlinked and its payload (if
-        # any) loaded — it re-enters as a host-resident run
+        # any) loaded — it re-enters as a host-resident run.  An archived
+        # run re-enters as-is: its payload still lives in the store and
+        # it charges nothing locally.
+        if run.location == "object":
+            with self._lock:
+                self._runs[run.run_id] = run
+            return
         if run.location == "disk":
             run.location = "host"
         with self._lock:
@@ -794,9 +924,40 @@ class KVTierManager:
                 with self._lock:
                     victim.location = "spilling"
                 self._spill(victim)
+            elif self._archive(victim):
+                pass  # demoted past disk into the object store
             else:
                 self._take(victim.run_id)
                 self.host_evictions += 1
+
+    def _archive(self, run: HostRun) -> bool:
+        """Demotion past disk: archive a run the local ladder would drop
+        into the shared object store (content-addressed — an identical
+        prefix already archived by any host dedupes to a reference), and
+        refresh its claimants' sleep manifests.  The run stays registered
+        (payload-less, zero local bytes) so a later promote fetches it
+        back transparently.  False = no object tier / no path context /
+        torn put — the caller drops the run as before."""
+        if (
+            self.object is None
+            or run.k_leaves is None
+            or not run.path_runs
+        ):
+            return False
+        flat = [t for seg in run.path_runs for t in seg]
+        key = self.object.put_run(flat, run.k_leaves, run.v_leaves,
+                                  run.n_pages)
+        if key is None:
+            return False
+        with self._lock:
+            run.location = "object"
+            run.object_key = key
+            run.k_leaves = run.v_leaves = None
+            run.pending = None
+            self.host_bytes -= run.nbytes
+        if run.threads:
+            self.object.note_archive(run.threads, run.path_runs)
+        return True
 
     # -- disk tier -------------------------------------------------------
 
@@ -827,17 +988,11 @@ class KVTierManager:
     def _spill_one(self, run: HostRun) -> None:
         if run.discarded:
             return
-        arrays: Dict[str, np.ndarray] = {}
-        meta = {"n_pages": run.n_pages, "k": [], "v": []}
-        for side, leaves in (("k", run.k_leaves), ("v", run.v_leaves)):
-            for i, a in enumerate(leaves):
-                stored, dtype_name = _storable(a)
-                arrays[f"{side}{i}"] = stored
-                meta[side].append(dtype_name)
+        data = encode_run_npz(run.k_leaves, run.v_leaves, run.n_pages)
         path = self._disk_path(run.run_id)
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
-            np.savez(f, meta=json.dumps(meta), **arrays)
+            f.write(data)
         os.replace(tmp, path)
         with self._lock:
             if run.discarded or run.run_id not in self._runs:
@@ -856,16 +1011,8 @@ class KVTierManager:
     def _disk_load(self, run: HostRun) -> Tuple[List[np.ndarray], List[np.ndarray]]:
         path = self._disk_path(run.run_id)
         try:
-            with np.load(path, allow_pickle=False) as z:
-                meta = json.loads(str(z["meta"]))
-                k_leaves = [
-                    z[f"k{i}"].view(_np_dtype(name))
-                    for i, name in enumerate(meta["k"])
-                ]
-                v_leaves = [
-                    z[f"v{i}"].view(_np_dtype(name))
-                    for i, name in enumerate(meta["v"])
-                ]
+            with open(path, "rb") as f:
+                k_leaves, v_leaves, _ = decode_run_npz(f.read())
         except (OSError, KeyError, ValueError) as e:
             raise ShipError(f"disk tier lost run {run.run_id}: {e}")
         return k_leaves, v_leaves
@@ -876,7 +1023,8 @@ class KVTierManager:
         """The /metrics "kv_tier" section (KV_TIER_METRIC_KEYS)."""
         with self._lock:
             host_runs = sum(
-                1 for r in self._runs.values() if r.location != "disk"
+                1 for r in self._runs.values()
+                if r.location not in ("disk", "object")
             )
         return {
             "host_budget_bytes": self.host_budget_bytes,

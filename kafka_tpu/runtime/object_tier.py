@@ -1,0 +1,661 @@
+"""Object-store KV tier: portable thread state below the host/disk tiers.
+
+At "millions of users" scale (PAPER.md L2/L6) almost every server-side
+*thread* is dormant, and a dormant thread's warm KV must outlive any single
+host: PR 8's tier ladder stops at per-host disk, so a host drain (autoscaler
+scale-in, deploy, crash) discards every conversation it was keeping warm.
+This module adds the missing rung — a shared object store (S3/GCS-shaped
+interface, local-filesystem default) mounted under
+:class:`~kafka_tpu.runtime.kv_tier.KVTierManager` — and makes thread state
+*portable*:
+
+* **Content addressing.**  Run objects are keyed by a hash of the FULL
+  token path from the radix root through the run (plus a pool-geometry
+  fingerprint): a KV page's values depend on its entire prefix, so the
+  prefix-inclusive hash is what makes two hosts' runs interchangeable.
+  Identical prefixes (the fan-out system prompt) therefore deduplicate
+  across hosts — the second host's put finds the object present and only
+  adds a reference.
+* **Refcount / ownership manifest.**  Every owner (one ObjectTier per
+  engine replica, uuid-namespaced like the disk tier) marks the keys it
+  references with a per-owner ref marker; an object is deleted only when
+  the last reference drops.  Puts of the same content are concurrency-safe
+  by construction: the payload write is atomic (tmp + rename) and
+  idempotent (same key == same bytes).
+* **Sleep manifests.**  A per-thread manifest (thread key -> ordered
+  content-addressed run keys + the token path they cover) is written when
+  a thread's state is demoted past disk — organically when the local
+  ladder would otherwise DROP a run, and in full by
+  ``PrefixCache.sleep_to_object()`` (the ``POST /admin/drain/{replica}``
+  seam the autoscaler's drain-then-shrink uses).  A dormant thread can
+  then wake on ANY replica of ANY host: ``prefix_cache.lookup`` reads the
+  manifest, fetches the runs, imports them into fresh pool pages and
+  serves the hit with ``cache_source="object_tier"`` instead of
+  re-prefilling the conversation.
+* **Failure semantics.**  A torn put is discarded before the ref/manifest
+  commit (atomic rename; the store never holds partial payloads).  A
+  get miss or torn fetch aborts the WHOLE wake — every page allocated for
+  it is freed — and the request degrades to the disk-tier/local hit or a
+  plain re-prefill, never partial KV.  Both paths are chaos-testable via
+  the ``kv.object_put`` / ``kv.object_get`` failpoints (fired once per
+  object).
+
+The span-ring persistence that PR 8 parked next to the disk tier moves
+along: with ``KAFKA_TPU_KV_OBJECT_DIR`` set and no explicit
+``KAFKA_TPU_TRACE_PERSIST_DIR``, finished traces persist under
+``<object_dir>/traces`` so a thread's observability history survives the
+host exactly like its KV does.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import threading
+import time
+import uuid
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .failpoints import failpoint
+from .tracing import record_span
+from ..tracing import sanitize_stem
+
+logger = logging.getLogger("kafka_tpu.object_tier")
+
+ENV_OBJECT_DIR = "KAFKA_TPU_KV_OBJECT_DIR"
+ENV_OBJECT_MB = "KAFKA_TPU_KV_OBJECT_MB"
+# Folded into the content-address fingerprint: deployments sharing one
+# bucket across model revisions (weights change, config doesn't) bump this
+# to fence off incompatible KV.
+ENV_OBJECT_NAMESPACE = "KAFKA_TPU_KV_OBJECT_NAMESPACE"
+
+MiB = 1024 * 1024
+
+# How long a cached manifest read may skip re-validating the store head
+# (seconds).  Submit-cadence probes and page-blocked admission retries
+# must not turn into one store stat per scheduler tick; a refresh landing
+# within the window is picked up at most this late — wakes degrade to
+# re-prefill in the meantime, never to wrong KV.
+_HEAD_TTL_S = 0.5
+
+# Manifests refreshed per organic archive are capped to the node's most
+# recent claimants: a fan-out shared node can carry hundreds of thread
+# claims, and the eviction path must not turn one archive into hundreds of
+# manifest writes.  The drain/sleep path covers every claimant exactly.
+_ARCHIVE_MANIFEST_CAP = 32
+
+
+def object_dir_from_env() -> Optional[str]:
+    return os.environ.get(ENV_OBJECT_DIR) or None
+
+
+def object_mb_from_env() -> int:
+    try:
+        return max(0, int(os.environ.get(ENV_OBJECT_MB, "0") or 0))
+    except ValueError:
+        return 0
+
+
+# ---------------------------------------------------------------------------
+# the store interface (S3/GCS-shaped) + the local-filesystem default
+# ---------------------------------------------------------------------------
+
+
+class ObjectStore:
+    """Opaque-key byte store: the minimal surface a real S3/GCS backend
+    implements.  Keys are relative "/"-separated paths chosen by the
+    tier (hex digests + sanitized stems — never raw user input)."""
+
+    def put(self, key: str, data: bytes) -> None:
+        """Atomic full-object write (visible all-or-nothing)."""
+        raise NotImplementedError
+
+    def get(self, key: str) -> Optional[bytes]:
+        """Full-object read; None when the key does not exist."""
+        raise NotImplementedError
+
+    def head(self, key: str) -> Optional[Tuple[int, float]]:
+        """(size_bytes, mtime) when the key exists, else None."""
+        raise NotImplementedError
+
+    def delete(self, key: str) -> None:
+        """Remove a key (idempotent; missing keys are a no-op)."""
+        raise NotImplementedError
+
+    def list(self, prefix: str) -> List[str]:
+        """Keys under `prefix` (non-recursive listing is sufficient)."""
+        raise NotImplementedError
+
+    def usage(self) -> Tuple[int, int]:
+        """(object_count, total_bytes) of run payloads in the store."""
+        raise NotImplementedError
+
+
+class LocalFSObjectStore(ObjectStore):
+    """Shared-directory object store: the default backend, and the shape
+    replicas on ONE host (or a fleet over NFS/FUSE-mounted buckets) share.
+
+    Safe for concurrent writers across processes: every put lands in a
+    uuid-named temp file first and ``os.replace``s into place, so readers
+    never observe a torn object and same-key races resolve to one winner
+    with identical bytes (keys are content addresses)."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(os.path.join(root, ".tmp"), exist_ok=True)
+        # usage() walks the objects dir; a short TTL bounds scrape cost
+        self._usage_cache: Tuple[float, Tuple[int, int]] = (0.0, (0, 0))
+
+    def _path(self, key: str) -> str:
+        parts = [p for p in key.split("/") if p not in ("", ".", "..")]
+        return os.path.join(self.root, *parts)
+
+    def put(self, key: str, data: bytes) -> None:
+        path = self._path(key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = os.path.join(self.root, ".tmp", uuid.uuid4().hex)
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+
+    def get(self, key: str) -> Optional[bytes]:
+        try:
+            with open(self._path(key), "rb") as f:
+                return f.read()
+        except OSError:
+            return None
+
+    def head(self, key: str) -> Optional[Tuple[int, float]]:
+        try:
+            st = os.stat(self._path(key))
+        except OSError:
+            return None
+        return st.st_size, st.st_mtime
+
+    def delete(self, key: str) -> None:
+        try:
+            os.unlink(self._path(key))
+        except OSError:
+            pass
+
+    def list(self, prefix: str) -> List[str]:
+        path = self._path(prefix)
+        try:
+            names = os.listdir(path)
+        except OSError:
+            return []
+        base = prefix.rstrip("/")
+        return [f"{base}/{n}" for n in names]
+
+    def usage(self) -> Tuple[int, int]:
+        now = time.monotonic()
+        ts, cached = self._usage_cache
+        if now - ts < 1.0:
+            return cached
+        count = total = 0
+        obj_dir = os.path.join(self.root, "objects")
+        try:
+            for name in os.listdir(obj_dir):
+                try:
+                    total += os.stat(os.path.join(obj_dir, name)).st_size
+                    count += 1
+                except OSError:
+                    continue
+        except OSError:
+            pass
+        self._usage_cache = (now, (count, total))
+        return count, total
+
+
+# ---------------------------------------------------------------------------
+# run payload serialization: the disk tier's wire format, verbatim
+# (kv_tier.encode_run_npz/decode_run_npz — ONE format, no drift)
+# ---------------------------------------------------------------------------
+
+
+def _encode_run(k_leaves: Sequence[np.ndarray],
+                v_leaves: Sequence[np.ndarray], n_pages: int) -> bytes:
+    from .kv_tier import encode_run_npz
+
+    return encode_run_npz(k_leaves, v_leaves, n_pages)
+
+
+def _decode_run(data: bytes) -> Tuple[List[np.ndarray], List[np.ndarray], int]:
+    from .kv_tier import decode_run_npz
+
+    return decode_run_npz(data)
+
+
+# ---------------------------------------------------------------------------
+# the tier
+# ---------------------------------------------------------------------------
+
+
+class ObjectTier:
+    """Policy layer over an :class:`ObjectStore`: content addressing,
+    per-owner refcounting, sleep manifests, budget enforcement, and the
+    OBJECT_TIER_METRIC_KEYS counters.
+
+    One instance per engine replica (mounted by
+    ``KVTierManager.attach_object``); many instances — across processes
+    and hosts — share one store.  Mutating entry points run on the engine
+    thread (the tier manager's single-writer contract); ``snapshot()``
+    and the router's manifest probes are torn-tolerant reads.
+    """
+
+    def __init__(self, store: ObjectStore, budget_bytes: int = 0,
+                 fingerprint: str = "", page_size: int = 16):
+        self.store = store
+        # 0 = unbounded.  The budget bounds the bytes THIS OWNER holds
+        # references on — a shared store is only ever shrunk through the
+        # refcount protocol, never by one owner deleting another's state.
+        self.budget_bytes = int(budget_bytes)
+        self.fingerprint = fingerprint
+        self.page_size = page_size
+        self._uid = uuid.uuid4().hex[:12]
+        self._lock = threading.Lock()
+        # second-chance LRU over the keys this owner references
+        self._owned: "OrderedDict[str, int]" = OrderedDict()  # key -> bytes
+        self._ref_bits: Dict[str, bool] = {}
+        self.owned_bytes = 0
+        # manifest read cache: thread key -> [head signature, doc,
+        # wakeable-depth memo] (the depth is computed lazily and
+        # invalidated with the signature)
+        self._manifest_cache: "OrderedDict[str, List[Any]]" = (
+            OrderedDict()
+        )
+        self._manifest_cache_cap = 256
+        # kv.object_* spans attach to the owning manager's trace context
+        self.manager: Optional[Any] = None
+        self.trace_ctx = None
+        # counters (OBJECT_TIER_METRIC_KEYS)
+        self.object_puts = 0
+        self.object_put_failures = 0
+        self.object_bytes_put = 0
+        self.object_gets = 0
+        self.object_get_failures = 0
+        self.object_bytes_got = 0
+        self.dedupe_hits = 0
+        self.wake_threads = 0
+        self.wake_tokens = 0
+        self.manifests_written = 0
+        self.objects_released = 0
+
+    # -- plumbing --------------------------------------------------------
+
+    def _ctx(self):
+        if self.manager is not None:
+            return self.manager.trace_ctx
+        return self.trace_ctx
+
+    # -- content addressing ----------------------------------------------
+
+    def run_key(self, path_tokens: Sequence[int], n_pages: int) -> str:
+        """Content address of a run: the FULL token path from the radix
+        root through the run's last token, plus the run's own START
+        boundary, plus the pool-geometry fingerprint.  KV values depend
+        on their entire prefix, so the prefix-inclusive hash is what
+        makes runs host-interchangeable — and the start boundary is what
+        keeps a SPLIT run's back half (same full path, fewer own pages)
+        from colliding with the unsplit whole: without it, a dedupe
+        could bind a 4-page node to an 8-page object and a later promote
+        would silently import the wrong half's KV."""
+        start = len(path_tokens) - n_pages * self.page_size
+        h = hashlib.sha256()
+        h.update(self.fingerprint.encode())
+        h.update(b"|")
+        h.update(np.asarray(list(path_tokens), np.int64).tobytes())
+        h.update(b"|")
+        h.update(str(start).encode())
+        return h.hexdigest()
+
+    @staticmethod
+    def _object_key(key: str) -> str:
+        return f"objects/{key}.npz"
+
+    def _ref_key(self, key: str) -> str:
+        return f"refs/{key}/{self._uid}"
+
+    def manifest_runs(
+        self, path_runs: Sequence[Sequence[int]]
+    ) -> List[Dict[str, Any]]:
+        """The manifest "runs" entries for a root-anchored run path:
+        cumulative content keys + per-run token counts."""
+        out: List[Dict[str, Any]] = []
+        acc: List[int] = []
+        for seg in path_runs:
+            acc.extend(seg)
+            out.append({
+                "key": self.run_key(acc, len(seg) // self.page_size),
+                "tokens": len(seg),
+            })
+        return out
+
+    # -- runs ------------------------------------------------------------
+
+    def has_run(self, key: str) -> bool:
+        return self.store.head(self._object_key(key)) is not None
+
+    def _own(self, key: str, nbytes: int) -> None:
+        with self._lock:
+            if key in self._owned:
+                self._owned.move_to_end(key)
+                self._ref_bits[key] = True
+                return
+            self._owned[key] = nbytes
+            self._ref_bits[key] = False
+            self.owned_bytes += nbytes
+        try:
+            self.store.put(self._ref_key(key), b"")
+        except OSError as e:  # pragma: no cover - fs flake
+            logger.warning("object ref marker for %s failed: %s", key, e)
+
+    def put_run(
+        self,
+        path_tokens: Sequence[int],
+        k_leaves: Optional[Sequence[np.ndarray]],
+        v_leaves: Optional[Sequence[np.ndarray]],
+        n_pages: int,
+    ) -> Optional[str]:
+        """Archive one run under its content address.  Returns the run
+        key, or None on failure (the caller degrades — plain eviction or
+        a skipped sleep entry).  A put of content already present is a
+        DEDUPE: no payload moves, only this owner's reference is added.
+        ``k_leaves=None`` is the reference-only form (the sleep path uses
+        it when the content is known present).  The torn-write contract:
+        the failpoint fires before anything is written, and the payload
+        write itself is atomic — a failed put leaves no partial object
+        and no reference."""
+        key = self.run_key(path_tokens, n_pages)
+        okey = self._object_key(key)
+        t0 = time.monotonic()
+        try:
+            failpoint("kv.object_put")
+            head = self.store.head(okey)
+            if head is not None:
+                self.dedupe_hits += 1
+                self._own(key, head[0])
+                # a dedupe still grows THIS owner's reference set, so
+                # the budget applies exactly like a payload write
+                self._enforce_budget()
+                return key
+            if k_leaves is None:
+                return None  # reference-only put of absent content
+            data = _encode_run(k_leaves, v_leaves, n_pages)
+            self.store.put(okey, data)
+        except Exception as e:
+            self.object_put_failures += 1
+            logger.warning("object put of %d-page run failed: %s",
+                           n_pages, e)
+            return None
+        self._own(key, len(data))
+        self.object_puts += 1
+        self.object_bytes_put += len(data)
+        record_span(
+            self._ctx(), "kv.object_put", time.monotonic() - t0,
+            attrs={"bytes": len(data), "pages": n_pages},
+        )
+        self._enforce_budget()
+        return key
+
+    def get_run(
+        self, key: str
+    ) -> Optional[Tuple[List[np.ndarray], List[np.ndarray], int, int]]:
+        """Fetch one run payload: (k_leaves, v_leaves, n_pages, nbytes),
+        or None on miss/corruption — the caller aborts the wake and
+        degrades to disk-tier-then-re-prefill."""
+        t0 = time.monotonic()
+        try:
+            failpoint("kv.object_get")
+            data = self.store.get(self._object_key(key))
+        except Exception as e:
+            self.object_get_failures += 1
+            logger.warning("object get of run %s failed: %s", key, e)
+            return None
+        if data is None:
+            self.object_get_failures += 1
+            return None
+        try:
+            k_leaves, v_leaves, n_pages = _decode_run(data)
+        except Exception as e:
+            self.object_get_failures += 1
+            logger.warning("object run %s is corrupt: %s", key, e)
+            return None
+        with self._lock:
+            if key in self._owned:
+                self._ref_bits[key] = True
+                self._owned.move_to_end(key)
+        self.object_gets += 1
+        self.object_bytes_got += len(data)
+        record_span(
+            self._ctx(), "kv.object_get", time.monotonic() - t0,
+            attrs={"bytes": len(data), "pages": n_pages,
+                   "source": "object_tier"},
+        )
+        return k_leaves, v_leaves, n_pages, len(data)
+
+    def release(self, key: str) -> None:
+        """Drop this owner's reference; delete the object when it was the
+        last one.  Never touches keys other owners still reference."""
+        with self._lock:
+            nbytes = self._owned.pop(key, None)
+            self._ref_bits.pop(key, None)
+            if nbytes is not None:
+                self.owned_bytes -= nbytes
+        self.store.delete(self._ref_key(key))
+        if not self.store.list(f"refs/{key}/"):
+            self.store.delete(self._object_key(key))
+        self.objects_released += 1
+
+    def _enforce_budget(self) -> None:
+        """Second-chance LRU over this owner's references: a referenced
+        (recently-fetched) key gets one more cycle, then the reference
+        drops (and the object, when nobody else holds one)."""
+        if self.budget_bytes <= 0:
+            return
+        scanned = 0
+        while True:
+            with self._lock:
+                if self.owned_bytes <= self.budget_bytes or not self._owned:
+                    return
+                victim = next(iter(self._owned))
+                if self._ref_bits.get(victim) and scanned < len(self._owned):
+                    self._ref_bits[victim] = False
+                    self._owned.move_to_end(victim)
+                    scanned += 1
+                    continue
+            scanned = 0
+            self.release(victim)
+
+    # -- sleep manifests -------------------------------------------------
+
+    def _manifest_store_key(self, thread_key: str) -> str:
+        # the fingerprint digest scopes the manifest like the run keys:
+        # two model revisions sharing one bucket must not clobber each
+        # other's manifests for the same thread (the loser's dormant
+        # conversation would silently re-prefill in full)
+        fp = hashlib.sha256(self.fingerprint.encode()).hexdigest()[:8]
+        return f"threads/{sanitize_stem(thread_key)}.{fp}.json"
+
+    def write_manifest(
+        self,
+        thread_key: str,
+        tokens: Sequence[int],
+        runs: List[Dict[str, Any]],
+        meta: Optional[Dict[str, Any]] = None,
+    ) -> bool:
+        """Write/refresh one thread's sleep manifest (atomic: a torn
+        write leaves the previous manifest intact).  An existing manifest
+        that already covers these tokens AND MORE is kept — eviction is
+        leaf-first, so the deepest archive writes first and shallower
+        ancestors' archives must not truncate it."""
+        tokens = list(tokens)
+        existing = self.read_manifest(thread_key)
+        if (
+            existing is not None
+            and len(existing.get("tokens") or []) >= len(tokens)
+            and existing["tokens"][: len(tokens)] == tokens
+        ):
+            return True
+        doc = {
+            "version": 1,
+            "thread": thread_key,
+            "fingerprint": self.fingerprint,
+            "page_size": self.page_size,
+            "tokens": tokens,
+            "runs": runs,
+            "meta": meta or {},
+            "written_at": time.time(),
+        }
+        skey = self._manifest_store_key(thread_key)
+        try:
+            failpoint("kv.object_put")
+            self.store.put(skey, json.dumps(doc).encode())
+        except Exception as e:
+            self.object_put_failures += 1
+            logger.warning("sleep manifest for %r failed: %s",
+                           thread_key, e)
+            return False
+        with self._lock:
+            self._manifest_cache.pop(thread_key, None)
+        self.manifests_written += 1
+        return True
+
+    def read_manifest(self, thread_key: str) -> Optional[Dict[str, Any]]:
+        """Cached manifest read (head-signature validated: a refresh by
+        any owner invalidates every reader's cache entry).  The head
+        probe itself is rate-limited per thread (_HEAD_TTL_S): the
+        router probes at submit cadence and a page-blocked admission
+        re-runs lookup every scheduler iteration — on a network-mounted
+        store an unbounded stat per tick would stall dispatch."""
+        now = time.monotonic()
+        with self._lock:
+            hit = self._manifest_cache.get(thread_key)
+            if hit is not None and now - hit[3] < _HEAD_TTL_S:
+                self._manifest_cache.move_to_end(thread_key)
+                return hit[1]
+        skey = self._manifest_store_key(thread_key)
+        sig = self.store.head(skey)
+        with self._lock:
+            hit = self._manifest_cache.get(thread_key)
+            if hit is not None and hit[0] == sig:
+                hit[3] = now
+                self._manifest_cache.move_to_end(thread_key)
+                return hit[1]  # noqa: the depth memo rides in hit[2]
+        doc: Optional[Dict[str, Any]] = None
+        if sig is not None:
+            raw = self.store.get(skey)
+            if raw is not None:
+                try:
+                    doc = json.loads(raw)
+                except ValueError:
+                    doc = None
+            if doc is not None and (
+                doc.get("fingerprint") != self.fingerprint
+                or doc.get("page_size") != self.page_size
+            ):
+                # another deployment's state under the same thread key:
+                # its runs can never import into this pool
+                doc = None
+        with self._lock:
+            self._manifest_cache[thread_key] = [sig, doc, None, now]
+            self._manifest_cache.move_to_end(thread_key)
+            while len(self._manifest_cache) > self._manifest_cache_cap:
+                self._manifest_cache.popitem(last=False)
+        return doc
+
+    def _wakeable_depth(self, thread_key: str,
+                        man: Dict[str, Any]) -> int:
+        """Tokens of the manifest's run path actually PRESENT in the
+        store, contiguous from the root — what a wake can really
+        deliver.  Organically-written manifests legitimately name
+        ancestor runs the sleeping host has not archived yet; counting
+        those as routable coverage would steer requests away from
+        genuine local caches toward a wake that truncates to nothing.
+        Memoized per manifest signature (head probes are stats, but not
+        free at submit cadence); a run archived later without this
+        thread's manifest being rewritten is picked up on the next
+        manifest refresh — an underestimate in the meantime, which only
+        ever degrades routing toward the pre-object behavior."""
+        with self._lock:
+            hit = self._manifest_cache.get(thread_key)
+            if hit is not None and hit[1] is man and hit[2] is not None:
+                return hit[2]
+        depth = 0
+        for r in man.get("runs") or []:
+            key = r.get("key")
+            if not key or not self.has_run(key):
+                break
+            depth += int(r.get("tokens", 0))
+        with self._lock:
+            hit = self._manifest_cache.get(thread_key)
+            if hit is not None and hit[1] is man:
+                hit[2] = depth
+        return depth
+
+    def manifest_match_tokens(self, thread_key: str,
+                              prompt_ids: Sequence[int]) -> int:
+        """Longest page-aligned, PRESENT-in-store manifest coverage of
+        `prompt_ids` — the router's "manifest hit = routable affinity"
+        probe.  Leaves at least one token to prefill, mirroring the
+        radix walk, and never counts runs a wake could not fetch."""
+        man = self.read_manifest(thread_key)
+        if man is None:
+            return 0
+        toks = man.get("tokens") or []
+        ps = self.page_size
+        limit = ((len(prompt_ids) - 1) // ps) * ps
+        m = 0
+        stop = min(len(toks), limit)
+        while m < stop and toks[m] == prompt_ids[m]:
+            m += 1
+        return min((m // ps) * ps,
+                   (self._wakeable_depth(thread_key, man) // ps) * ps)
+
+    def note_archive(
+        self,
+        threads: Sequence[str],
+        path_runs: Sequence[Sequence[int]],
+    ) -> None:
+        """Organic-eviction manifest refresh: a run just archived past
+        disk updates its claimants' manifests to cover the root->run
+        path.  Ancestor runs may not be archived yet — their keys are
+        computed anyway, and a wake simply truncates at the first absent
+        object (the drain/sleep path archives everything)."""
+        runs = self.manifest_runs(path_runs)
+        tokens = [t for seg in path_runs for t in seg]
+        for thread_key in list(threads)[-_ARCHIVE_MANIFEST_CAP:]:
+            self.write_manifest(thread_key, tokens, runs)
+
+    # -- export ----------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The /metrics "object_tier" section (OBJECT_TIER_METRIC_KEYS).
+        ``store_bytes``/``store_objects`` describe the SHARED store (the
+        DP aggregate reports them once, unsummed); everything else is
+        per-owner and sums."""
+        try:
+            count, total = self.store.usage()
+        except Exception:  # pragma: no cover - store flake
+            count = total = 0
+        return {
+            "store_bytes": total,
+            "store_objects": count,
+            "owned_bytes": self.owned_bytes,
+            "object_puts": self.object_puts,
+            "object_put_failures": self.object_put_failures,
+            "object_bytes_put": self.object_bytes_put,
+            "object_gets": self.object_gets,
+            "object_get_failures": self.object_get_failures,
+            "object_bytes_got": self.object_bytes_got,
+            "dedupe_hits": self.dedupe_hits,
+            "wake_threads": self.wake_threads,
+            "wake_tokens": self.wake_tokens,
+            "manifests_written": self.manifests_written,
+            "objects_released": self.objects_released,
+        }

@@ -58,6 +58,7 @@ count, which land in the first partial (unshared) page or later.
 from __future__ import annotations
 
 import dataclasses
+import time
 from collections import OrderedDict
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
@@ -72,9 +73,14 @@ class PrefixHit:
     tokens: int  # cached token count (= len(pages) * page_size)
     # "own" (this thread stored through here) | "cross" (another thread's
     # shared prefix) | "host_tier" (any part was promoted from the tier)
+    # | "object_tier" (any part was woken from the shared object store)
+    # | "shipped" (any part arrived via cross-replica page shipping)
     source: str
     # tokens of the hit that were re-materialized from the host/disk tier
     promoted_tokens: int = 0
+    # tokens of the hit re-materialized from the shared OBJECT store —
+    # a dormant thread waking on a replica that never served it
+    object_tokens: int = 0
 
 
 # Per-node claim cap: a fan-out shared-prefix node is stored through by
@@ -93,7 +99,7 @@ class _Node:
     walk still matches through it."""
 
     __slots__ = ("tokens", "pages", "children", "parent", "keys",
-                 "host_run", "shipped")
+                 "host_run", "shipped", "woken")
 
     def __init__(
         self,
@@ -119,6 +125,11 @@ class _Node:
         # classifies as cache_source="shipped" (the zero-re-prefill
         # proof), and a normal store() descending it clears the marker.
         self.shipped = False
+        # True while this run's pages were re-materialized from the
+        # shared OBJECT store (a sleep-manifest wake) and no local thread
+        # has stored through it since: lookups crossing it classify as
+        # cache_source="object_tier" — the cross-host wake proof.
+        self.woken = False
 
     def n_pages(self, page_size: int) -> int:
         """Run length in pages regardless of residency."""
@@ -179,6 +190,7 @@ class PrefixCache:
         self.cross_thread_hits = 0  # hits whose deepest node another thread wrote
         self.host_tier_hits = 0  # hits that promoted at least one tier run
         self.shipped_hits = 0  # hits crossing a cross-replica-shipped run
+        self.object_tier_hits = 0  # hits crossing an object-store-woken run
         self.evictions = 0  # nodes evicted under pressure (leaf-LRU + budget)
         self.pages_evicted = 0
         self.probes = 0  # read-only match_tokens walks (router memo tests)
@@ -321,12 +333,26 @@ class PrefixCache:
         partial KV).
         """
         segments, matched, _ = self._walk(prompt_ids)
+        if (
+            key is not None
+            and self.tier is not None
+            and getattr(self.tier, "object", None) is not None
+        ):
+            # Sleep-manifest wake (ISSUE 14): when the shared object
+            # store knows this thread beyond what the local tree holds,
+            # fetch its runs, import them into fresh pages and insert
+            # them — the dormant thread wakes on THIS replica whether or
+            # not it ever served here.
+            if self._wake_from_object(key, prompt_ids, matched,
+                                      {n for n, _ in segments}):
+                segments, matched, _ = self._walk(prompt_ids)
         if matched == 0:
             self.misses += 1
             return None
         ps = self.pool.page_size
         pages: List[int] = []
         promoted = 0
+        object_tok = 0
         shipped_any = False
         last_node: Optional[_Node] = None
         # nodes of this walk must not be evicted by promotion's reclaim —
@@ -342,6 +368,8 @@ class PrefixCache:
                 promoted += take * ps
             if node.shipped:
                 shipped_any = True
+            if node.woken:
+                object_tok += take * ps
             pages.extend(node.pages[:take])
             last_node = node
         if last_node is None:
@@ -358,6 +386,10 @@ class PrefixCache:
             # zero-re-prefill admission on the decode pool is provable
             # from this classification (disaggregated serving)
             source = "shipped"
+        elif object_tok:
+            # runs woken from the shared object store: the cross-host
+            # resume-without-re-prefill is provable from this
+            source = "object_tier"
         elif promoted:
             source = "host_tier"
         elif key is not None and key in last_node.keys:
@@ -365,7 +397,124 @@ class PrefixCache:
         else:
             source = "cross"
         return PrefixHit(pages=pages, tokens=cached, source=source,
-                         promoted_tokens=promoted)
+                         promoted_tokens=promoted,
+                         object_tokens=object_tok)
+
+    def _wake_from_object(self, key: str, prompt_ids: Sequence[int],
+                          matched: int, protect) -> bool:
+        """Re-materialize a dormant thread from its sleep manifest.
+
+        The manifest's runs beyond the locally-matched boundary are
+        fetched from the shared store, imported into freshly-allocated
+        pool pages (one contiguous alloc), and inserted into the radix
+        tree via store() — dummy page ids stand in for the local prefix,
+        which store() descends without touching.
+
+        The wake TRUNCATES at the first ABSENT object (cheap head
+        probes, before any paging work): organically-written manifests
+        legitimately name ancestor runs that are still device-resident
+        on the sleeping host and not archived yet, and runs past a
+        missing one are unusable anyway (their prefix is the hole).
+        Over the present runs it is ALL-OR-NOTHING: a failed get of a
+        present object, size mismatch, or torn import frees every page
+        allocated for the wake and aborts it — the request degrades to
+        the local (disk-tier-or-less) hit, never partial KV.  Pages are
+        reserved BEFORE the payload fetches, so pool pressure aborts
+        without wasting store round-trips.  Returns True when at least
+        one run was woken (the caller re-walks)."""
+        from .tracing import record_span
+
+        obj = self.tier.object
+        ps = self.pool.page_size
+        limit = (len(prompt_ids) - 1) // ps  # max matchable pages
+        if matched >= limit:
+            return False
+        man = obj.read_manifest(key)
+        if man is None:
+            return False
+        toks = man.get("tokens") or []
+        runs = man.get("runs") or []
+        # verified page-aligned agreement between manifest and prompt
+        m = 0
+        stop = min(len(toks), limit * ps)
+        while m < stop and toks[m] == prompt_ids[m]:
+            m += 1
+        man_pages = m // ps
+        if man_pages <= matched:
+            return False
+        t0 = time.monotonic()
+        # select the manifest runs beyond the local boundary (contiguous
+        # from it; a run straddling the boundary means the local tree
+        # split differently than the sleeping host's — abort, the local
+        # hit stands)
+        wake: List[Tuple[int, str]] = []  # (n_pages, run_key)
+        off = 0
+        for r in runs:
+            n = int(r.get("tokens", 0)) // ps
+            if n <= 0:
+                return False  # malformed manifest
+            if off + n <= matched:
+                off += n
+                continue
+            if off < matched or off + n > man_pages:
+                break
+            if not r.get("key") or not obj.has_run(r["key"]):
+                # absent object (an organically-manifested ancestor not
+                # archived yet, or budget-evicted content): truncate —
+                # deeper runs are unusable without this prefix
+                break
+            wake.append((n, r["key"]))
+            off += n
+        if not wake:
+            return False
+        # reserve the destination pages BEFORE fetching payloads: pool
+        # pressure must abort without paying store round-trips
+        total_pages = sum(n for n, _ in wake)
+        if self.pool.free_pages < total_pages:
+            self._reclaim_protected(total_pages, protect)
+        try:
+            pages = self.pool.alloc(total_pages)
+        except OutOfPagesError:
+            return False
+        nbytes = 0
+        pos = 0
+        try:
+            for n, rkey in wake:
+                got = obj.get_run(rkey)
+                if got is None or got[2] != n:
+                    # failed get of a PRESENT object (torn fetch, lost
+                    # between head and get) or a payload whose span
+                    # disagrees with the manifest: free EVERY wake page
+                    # and keep the local hit.  A miss already counted in
+                    # get_run; a span mismatch must not stay invisible.
+                    if got is not None:
+                        obj.object_get_failures += 1
+                    self.pool.release(pages)
+                    return False
+                k_l, v_l, _, got_bytes = got
+                nbytes += got_bytes
+                self.tier.shipper.import_run(k_l, v_l, n,
+                                             pages[pos:pos + n])
+                pos += n
+        except Exception:
+            # torn import: free EVERY wake page (freshly allocated,
+            # shared with nobody — complete cleanup), keep the local hit
+            self.pool.release(pages)
+            obj.object_get_failures += 1
+            return False
+        end = (matched + total_pages) * ps
+        self.store(key, list(prompt_ids[:end]),
+                   [-1] * matched + list(pages), woken=True)
+        self.pool.release(pages)  # store() retained what it kept
+        woken_tokens = total_pages * ps
+        obj.wake_threads += 1
+        obj.wake_tokens += woken_tokens
+        record_span(
+            self.tier.trace_ctx, "thread.wake", time.monotonic() - t0,
+            attrs={"tokens": woken_tokens, "runs": len(wake),
+                   "bytes": nbytes, "source": "object_tier"},
+        )
+        return True
 
     def _promote_node(self, node: _Node, protect) -> bool:
         """Re-materialize a host-resident run into fresh pool pages.
@@ -422,11 +571,13 @@ class PrefixCache:
             self.host_tier_hits += 1
         elif source == "shipped":
             self.shipped_hits += 1
+        elif source == "object_tier":
+            self.object_tier_hits += 1
 
     # -- store -----------------------------------------------------------
 
     def store(self, key: str, tokens: Sequence[int], pages: Sequence[int],
-              shipped: bool = False) -> None:
+              shipped: bool = False, woken: bool = False) -> None:
         """Insert a finished sequence's materialized tokens along its path.
 
         Only whole pages are stored (`tokens` must count exactly the
@@ -443,6 +594,14 @@ class PrefixCache:
         this replica's pre-existing content, and the duplicate shipped
         pages for them are simply not retained (the caller releases its
         alloc reference afterwards, freeing them).
+
+        ``woken=True`` is the analogous marker for runs re-materialized
+        from the object store (_wake_from_object): first lookups crossing
+        them classify as ``cache_source="object_tier"``.  Both callers
+        pass DUMMY page ids (-1) for the already-present prefix; matched
+        runs never read their page entries, and the guards below make a
+        dummy id inert everywhere one could otherwise be captured (fresh
+        insert after a racing eviction, host-run adoption).
         """
         ps = self.pool.page_size
         n_full = min(len(pages), len(tokens) // ps)
@@ -452,12 +611,18 @@ class PrefixCache:
             pkey = tuple(tokens[idx * ps:(idx + 1) * ps])
             child = node.children.get(pkey)
             if child is None:
-                run_tokens = list(tokens[idx * ps:n_full * ps])
                 run_pages = list(pages[idx:n_full])
+                if any(p < 0 for p in run_pages):
+                    # dummy placeholder ids (delta-ship skip / object
+                    # wake) whose matched node was evicted mid-operation:
+                    # there is nothing real to insert here
+                    break
+                run_tokens = list(tokens[idx * ps:n_full * ps])
                 self._retain_pages(run_pages)
                 self.generation += 1
                 new = _Node(run_tokens, run_pages, node)
                 new.shipped = shipped
+                new.woken = woken
                 self._claim(new, key)
                 node.children[pkey] = new
                 self._n_nodes += 1
@@ -492,22 +657,30 @@ class PrefixCache:
                 # Adoption: the incoming sequence carries freshly-computed
                 # pages for exactly this run's tokens — a free promotion.
                 # The tier copy is dropped; the node is device-resident
-                # again without any H2D traffic.
+                # again without any H2D traffic.  Adoption is keyed on
+                # REAL page ids: a delta-ship registration or object wake
+                # passes dummy (-1) entries for runs the destination
+                # already holds, and adopting those would capture garbage
+                # — the run stays tier-resident and promotes as usual.
                 adopt = list(pages[idx:idx + take])
-                self._retain_pages(adopt)
-                child.pages = adopt
-                if self.tier is not None:
-                    self.tier.discard(child.host_run)
-                child.host_run = None
-                self._n_pages += take
-                self._host_pages -= take
-                self._host_nodes -= 1
-                if not child.children:
-                    self._leaves[child] = None
+                if all(p >= 0 for p in adopt):
+                    self._retain_pages(adopt)
+                    child.pages = adopt
+                    if self.tier is not None:
+                        self.tier.discard(child.host_run)
+                    child.host_run = None
+                    self._n_pages += take
+                    self._host_pages -= take
+                    self._host_nodes -= 1
+                    if not child.children:
+                        self._leaves[child] = None
             if child.shipped and not shipped:
                 # the thread's own finish stored through the shipped run:
                 # it is ordinary cache content from here on
                 child.shipped = False
+            if child.woken and not woken and not shipped:
+                # the thread's own finish stored through the woken run
+                child.woken = False
             self._claim(child, key)
             self._touch(child)
             node = child
@@ -531,6 +704,7 @@ class PrefixCache:
             front_run, back_run = parts
         suffix = _Node(node.tokens[take * ps:], node.pages[take:], node)
         suffix.shipped = node.shipped  # both halves are the shipped run
+        suffix.woken = node.woken
         suffix.children = node.children
         for c in suffix.children.values():
             c.parent = suffix
@@ -612,11 +786,30 @@ class PrefixCache:
         self._evict_node(next(iter(self._leaves)))
         return True
 
+    def _path_runs(self, node: _Node) -> List[List[int]]:
+        """Per-node token runs of the radix path root -> `node` (the
+        object tier's content-address context: a run's KV depends on its
+        whole prefix).  O(path depth)."""
+        runs: List[List[int]] = []
+        n: Optional[_Node] = node
+        while n is not None and n is not self._root:
+            runs.append(list(n.tokens))
+            n = n.parent
+        runs.reverse()
+        return runs
+
     def _evict_node(self, victim: _Node) -> None:
         """Demote-or-drop one leaf (the shared step of LRU eviction and
         promotion's protected reclaim)."""
         if self.tier is not None and victim.pages:
-            run = self.tier.demote(victim.pages)
+            has_obj = getattr(self.tier, "object", None) is not None
+            run = self.tier.demote(
+                victim.pages,
+                # content-address context rides only when an object tier
+                # can use it (the path walk is not free)
+                path_runs=self._path_runs(victim) if has_obj else None,
+                threads=list(victim.keys) if has_obj else (),
+            )
             if run is not None:
                 n = len(victim.pages)
                 self._release_pages(victim.pages)
@@ -687,6 +880,110 @@ class PrefixCache:
             if not self._evict_leaf():
                 return False
         return True
+
+    # -- sleep (drain-to-object, ISSUE 14) -------------------------------
+
+    def _materialize_node(self, node: _Node):
+        """Host leaves of one node's KV wherever it lives (device pages
+        via a blocking D2H gather, host/disk via the tier's read-only
+        peek).  None = nothing local to archive (object-resident) or a
+        failed load — the sleep entry is skipped."""
+        try:
+            if node.pages:
+                pend = self.tier.shipper.export_run(node.pages)
+                return self.tier.shipper.resolve(pend)
+            if node.host_run is not None:
+                return self.tier.peek(node.host_run)
+        except Exception:
+            return None
+        return None
+
+    def _claimed_chain(self, key: str) -> List[_Node]:
+        """The deepest root-anchored chain of nodes claiming `key` (the
+        thread's stored path; store() claims every node it walks, so the
+        claims form chains — a thread whose prompt diverged mid-history
+        has several, and the deepest is its current conversation)."""
+        best: List[_Node] = []
+        best_tokens = 0
+        stack = [
+            [c] for c in self._root.children.values() if key in c.keys
+        ]
+        while stack:
+            path = stack.pop()
+            deeper = [
+                c for c in path[-1].children.values() if key in c.keys
+            ]
+            if deeper:
+                stack.extend(path + [c] for c in deeper)
+                continue
+            n_tok = sum(len(n.tokens) for n in path)
+            if n_tok > best_tokens:
+                best, best_tokens = path, n_tok
+        return best
+
+    def sleep_to_object(self) -> Dict[str, int]:
+        """Flush EVERY cached run into the shared object store and write
+        every claiming thread's sleep manifest — the ``POST
+        /admin/drain/{replica}`` seam (autoscaler drain-then-shrink): a
+        replica drained this way can be torn down without discarding any
+        warm thread state, because any replica of any host sharing the
+        store can wake the threads from their manifests.
+
+        Non-destructive: archiving is a COPY (content-addressed and
+        refcounted, so re-archiving present content is a reference-only
+        dedupe), the tree and pool are untouched, and serving resumes
+        unchanged if the replica is kept after all.  Must run with the
+        scheduler quiesced (the provider parks the worker) — the D2H
+        gathers read the pool the engine thread otherwise mutates."""
+        if self.tier is None or getattr(self.tier, "object", None) is None:
+            return {"enabled": False}
+        obj = self.tier.object
+        self.tier.drain(force=True)  # resolve in-flight demotes first
+        ps = self.pool.page_size
+        stats = {
+            "enabled": True, "runs_archived": 0, "runs_failed": 0,
+            "manifests": 0, "threads": 0,
+        }
+        bytes0 = obj.object_bytes_put
+        dedupe0 = obj.dedupe_hits
+        keys_seen: set = set()
+        # 1) archive every run, parents before children, path accumulated
+        stack = [(c, []) for c in self._root.children.values()]
+        while stack:
+            node, path = stack.pop()
+            path_runs = path + [list(node.tokens)]
+            for c in node.children.values():
+                stack.append((c, path_runs))
+            keys_seen.update(node.keys)
+            flat = [t for seg in path_runs for t in seg]
+            if obj.has_run(obj.run_key(flat, node.n_pages(ps))):
+                ok = obj.put_run(flat, None, None,
+                                 node.n_pages(ps)) is not None
+            else:
+                payload = self._materialize_node(node)
+                if payload is None and node.host_run is not None:
+                    # object-resident already (archived organically)
+                    ok = obj.put_run(flat, None, None,
+                                     node.n_pages(ps)) is not None
+                elif payload is None:
+                    ok = False
+                else:
+                    ok = obj.put_run(flat, payload[0], payload[1],
+                                     node.n_pages(ps)) is not None
+            stats["runs_archived" if ok else "runs_failed"] += 1
+        # 2) one manifest per claiming thread, covering its deepest chain
+        for key in sorted(keys_seen):
+            chain = self._claimed_chain(key)
+            if not chain:
+                continue
+            path_runs = [list(n.tokens) for n in chain]
+            tokens = [t for seg in path_runs for t in seg]
+            if obj.write_manifest(key, tokens, obj.manifest_runs(path_runs)):
+                stats["manifests"] += 1
+        stats["threads"] = len(keys_seen)
+        stats["bytes_put"] = obj.object_bytes_put - bytes0
+        stats["dedupe_hits"] = obj.dedupe_hits - dedupe0
+        return stats
 
     def invalidate(self, key: str) -> None:
         """Drop `key`'s claim; free only nodes no other thread claims.

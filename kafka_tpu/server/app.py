@@ -148,6 +148,8 @@ def build_tpu_provider(cfg: ServingConfig) -> LLMProvider:
         prefix_cache_pages=cfg.prefix_cache_pages or None,
         kv_host_tier_mb=cfg.kv_host_tier_mb,
         kv_disk_tier_dir=cfg.kv_disk_tier_dir,
+        kv_object_dir=cfg.kv_object_dir,
+        kv_object_mb=cfg.kv_object_mb,
         max_ttft_s=cfg.max_ttft_s,
         max_total_s=cfg.request_timeout_s,
         max_waiting=cfg.max_queue_depth,
@@ -707,6 +709,7 @@ def _add_routes(app: web.Application) -> None:
     r.add_get("/admin/signals", admin_signals)
     r.add_get("/admin/autoscaler", admin_autoscaler)
     r.add_post("/admin/resize", resize_topology)
+    r.add_post("/admin/drain/{replica}", admin_drain_replica)
     r.add_post("/debug/profile", capture_profile)
     r.add_get("/debug/traces", debug_traces)
     r.add_get("/debug/trace/{request_id}", debug_trace)
@@ -1413,6 +1416,52 @@ async def resize_topology(request: web.Request) -> web.Response:
     if roles_given:
         out["roles"] = roles or None
     return web.json_response(out)
+
+
+async def admin_drain_replica(request: web.Request) -> web.Response:
+    """Flush one replica's warm KV state into the shared object store
+    (ISSUE 14): every cached radix run is archived content-addressed and
+    every thread's sleep manifest written, so the replica can be removed
+    (POST /admin/resize to a smaller dp — "drain-then-shrink", which the
+    act-mode autoscaler performs automatically before its scale-ins)
+    without discarding any warm conversation: dormant threads wake on
+    the survivors with cache_source="object_tier" instead of
+    re-prefilling.  Non-destructive — the replica keeps serving
+    unchanged if it is kept after all.  Requires the object tier
+    (KAFKA_TPU_KV_OBJECT_DIR) and, like /admin/resize, a configured
+    KAFKA_TPU_API_TOKEN (it parks the scheduler for the flush)."""
+    if not _state(request)["cfg"].api_token:
+        return web.json_response(
+            {"error": "admin endpoints require KAFKA_TPU_API_TOKEN to "
+                      "be configured"},
+            status=403,
+        )
+    llm = _state(request)["llm"]
+    drain = getattr(llm, "drain_replica", None)
+    if drain is None or getattr(llm, "engine", None) is None:
+        return web.json_response(
+            {"error": "this deployment has no drainable engine"},
+            status=501,
+        )
+    try:
+        idx = int(request.match_info["replica"])
+    except ValueError:
+        return web.json_response(
+            {"error": "replica must be an integer index"}, status=400
+        )
+    try:
+        stats = await drain(idx)
+    except ValueError as e:
+        return web.json_response({"error": str(e)}, status=400)
+    except RuntimeError as e:
+        return web.json_response({"error": str(e)}, status=409)
+    if not stats.get("enabled", True):
+        return web.json_response(
+            {"error": "object tier not configured "
+                      "(set KAFKA_TPU_KV_OBJECT_DIR)", **stats},
+            status=409,
+        )
+    return web.json_response(stats)
 
 
 async def debug_traces(request: web.Request) -> web.Response:

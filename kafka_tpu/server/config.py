@@ -60,6 +60,20 @@ class ServingConfig:
     # host-budget overflow spills runs here (second-chance LRU) and the
     # tracing span ring persists alongside.  None = drop on overflow.
     kv_disk_tier_dir: Optional[str] = None
+    # Object-store KV tier (KAFKA_TPU_KV_OBJECT_DIR, README "Object-store
+    # KV tier"): a SHARED directory (or bucket mount) below host+disk
+    # that makes thread state portable across hosts — runs are archived
+    # content-addressed (identical prefixes dedupe across replicas/hosts)
+    # and per-thread sleep manifests let dormant threads wake on ANY
+    # replica with cache_source="object_tier" instead of re-prefilling.
+    # POST /admin/drain/{replica} flushes a replica's warm state before
+    # the autoscaler shrinks it away.  None (default) disables the tier;
+    # every dispatch/eviction path is byte-identical to before.
+    kv_object_dir: Optional[str] = None
+    # Byte budget (MiB) on the object-store references each replica holds
+    # (second-chance LRU; the last dropped reference deletes the object).
+    # 0 = unbounded.  KAFKA_TPU_KV_OBJECT_MB.
+    kv_object_mb: int = 0
     # parallelism (SURVEY §2.2): the server builds its mesh from these.
     #   tp — tensor parallel within each engine (attention heads / MLP)
     #   sp — sequence parallel: ring-sharded chunked prefill for long
@@ -258,6 +272,10 @@ class ServingConfig:
             kv_host_tier_mb=get("KV_HOST_TIER_MB", cls.kv_host_tier_mb,
                                 lambda v: max(0, int(v))),
             kv_disk_tier_dir=get("KV_DISK_TIER_DIR", None),
+            kv_object_dir=get("KV_OBJECT_DIR", None),
+            # clamp negatives to 0 = unbounded refs, same env policy
+            kv_object_mb=get("KV_OBJECT_MB", cls.kv_object_mb,
+                             lambda v: max(0, int(v))),
             tp_size=get_axis("TP", cls.tp_size),
             sp_size=get_axis("SP", cls.sp_size),
             pp_size=get_axis("PP", cls.pp_size),

@@ -535,14 +535,16 @@ def render_prometheus(snap: Dict[str, Any]) -> str:
                  "Prefix-cache events by kind.")
         for kind in ("hits", "misses", "tokens_reused",
                      "cross_thread_hits", "host_tier_hits",
-                     "shipped_hits", "evictions", "pages_evicted"):
+                     "shipped_hits", "object_tier_hits",
+                     "evictions", "pages_evicted"):
             if kind in pc:
                 w.sample("kafka_tpu_prefix_cache_total", pc[kind],
                          {"kind": kind})
         for idx, rpc in replica_pcs:
             for kind in ("hits", "misses", "tokens_reused",
                          "cross_thread_hits", "host_tier_hits",
-                         "shipped_hits", "evictions", "pages_evicted"):
+                         "shipped_hits", "object_tier_hits",
+                         "evictions", "pages_evicted"):
                 if kind in rpc:
                     w.sample("kafka_tpu_prefix_cache_total", rpc[kind],
                              {"replica": idx, "kind": kind})
@@ -596,6 +598,82 @@ def render_prometheus(snap: Dict[str, Any]) -> str:
             if key in tier:
                 w.sample("kafka_tpu_kv_tier_bytes_total", tier[key],
                          {"dir": label})
+
+    # Object-store KV tier (runtime/metrics.OBJECT_TIER_METRIC_KEYS — the
+    # registry tests/test_object_tier.py enforces in both files; present
+    # only when KAFKA_TPU_KV_OBJECT_DIR mounts the shared store).
+    obj = snap.get("object_tier") or {}
+    if obj:
+        w.family("kafka_tpu_object_tier_bytes", "gauge",
+                 "Object-store occupancy: scope=store is the SHARED "
+                 "store (report once per store when aggregating "
+                 "scrapes); scope=owned is this replica's references.")
+        for key, scope in (("store_bytes", "store"),
+                           ("owned_bytes", "owned")):
+            if key in obj:
+                w.sample("kafka_tpu_object_tier_bytes", obj[key],
+                         {"scope": scope})
+        if "store_objects" in obj:
+            w.family("kafka_tpu_object_tier_objects", "gauge",
+                     "Run objects resident in the shared store.")
+            w.sample("kafka_tpu_object_tier_objects",
+                     obj["store_objects"])
+        if "object_puts" in obj:
+            w.family("kafka_tpu_object_tier_puts_total", "counter",
+                     "Run payloads archived into the store.")
+            w.sample("kafka_tpu_object_tier_puts_total",
+                     obj["object_puts"])
+        if "object_gets" in obj:
+            w.family("kafka_tpu_object_tier_gets_total", "counter",
+                     "Run payloads fetched from the store (wakes).")
+            w.sample("kafka_tpu_object_tier_gets_total",
+                     obj["object_gets"])
+        w.family("kafka_tpu_object_tier_bytes_total", "counter",
+                 "Object-store payload bytes moved by direction.")
+        for key, label in (("object_bytes_put", "put"),
+                           ("object_bytes_got", "get")):
+            if key in obj:
+                w.sample("kafka_tpu_object_tier_bytes_total", obj[key],
+                         {"dir": label})
+        w.family("kafka_tpu_object_tier_failures_total", "counter",
+                 "Torn/failed store operations (put = archive degraded "
+                 "to plain eviction; get = wake aborted, pages freed).")
+        for key, op in (("object_put_failures", "put"),
+                        ("object_get_failures", "get")):
+            if key in obj:
+                w.sample("kafka_tpu_object_tier_failures_total",
+                         obj[key], {"op": op})
+        if "dedupe_hits" in obj:
+            w.family("kafka_tpu_object_tier_dedupe_hits_total", "counter",
+                     "Puts whose content was already present (cross-host "
+                     "prefix dedupe — only a reference was added).")
+            w.sample("kafka_tpu_object_tier_dedupe_hits_total",
+                     obj["dedupe_hits"])
+        if "wake_threads" in obj:
+            w.family("kafka_tpu_object_tier_wake_threads_total",
+                     "counter",
+                     "Dormant threads re-materialized from their sleep "
+                     "manifests (cache_source=\"object_tier\").")
+            w.sample("kafka_tpu_object_tier_wake_threads_total",
+                     obj["wake_threads"])
+        if "wake_tokens" in obj:
+            w.family("kafka_tpu_object_tier_wake_tokens_total", "counter",
+                     "Tokens re-materialized by sleep-manifest wakes "
+                     "(prompt tokens NOT re-prefilled).")
+            w.sample("kafka_tpu_object_tier_wake_tokens_total",
+                     obj["wake_tokens"])
+        if "manifests_written" in obj:
+            w.family("kafka_tpu_object_tier_manifests_total", "counter",
+                     "Per-thread sleep manifests written.")
+            w.sample("kafka_tpu_object_tier_manifests_total",
+                     obj["manifests_written"])
+        if "objects_released" in obj:
+            w.family("kafka_tpu_object_tier_released_total", "counter",
+                     "Owner references dropped (budget eviction / thread "
+                     "invalidation; the last reference deletes the "
+                     "object).")
+            w.sample("kafka_tpu_object_tier_released_total",
+                     obj["objects_released"])
 
     # Disaggregated prefill/decode (runtime/metrics.DISAGG_METRIC_KEYS —
     # the registry a static test enforces in both files; present only
@@ -728,6 +806,7 @@ def render_prometheus(snap: Dict[str, Any]) -> str:
             ("autoscaler_degrades", "degrade"),
             ("autoscaler_recovers", "recover"),
             ("autoscaler_vetoes", "veto"),
+            ("autoscaler_drains", "drain"),
         ):
             if key in scaler:
                 w.sample("kafka_tpu_autoscaler_events_total",

@@ -72,9 +72,13 @@ ENV_PROFILING = "KAFKA_TPU_PROFILING"
 # configured — the span ring persists "alongside the disk tier" with no
 # extra knob.  Explicit "" disables persistence even with a disk tier.
 ENV_PERSIST = "KAFKA_TPU_TRACE_PERSIST_DIR"
-# the disk-tier env is read by name (kv_tier.py owns it; importing the
-# runtime tier here would defeat this module's import-light contract)
+# the disk/object tier envs are read by name (kv_tier.py/object_tier.py
+# own them; importing the runtime tier here would defeat this module's
+# import-light contract).  With an OBJECT store configured the ring
+# persists under it by preference — thread state that outlives the host
+# should carry its trace history along (ISSUE 14).
 _ENV_DISK_TIER = "KAFKA_TPU_KV_DISK_TIER_DIR"
+_ENV_OBJECT_DIR = "KAFKA_TPU_KV_OBJECT_DIR"
 
 # The DOCUMENTED SPAN REGISTRY: every span name emitted anywhere in
 # kafka_tpu/ (tracing.span("..."), record_span(ctx, "..."),
@@ -97,6 +101,13 @@ SPANS = (
                       # pages, bytes, overlap (runtime/kv_tier.py)
     "kv.promote",     # page run re-materialized host->device ahead of the
                       # suffix prefill; attrs: pages, bytes, source, overlap
+    "kv.object_put",  # run archived into the shared object store; attrs:
+                      # pages, bytes (runtime/object_tier.py)
+    "kv.object_get",  # run fetched from the shared object store during a
+                      # thread wake; attrs: pages, bytes, source
+    "thread.wake",    # dormant thread re-materialized from its sleep
+                      # manifest; attrs: tokens, runs, bytes, source
+                      # (runtime/prefix_cache.py)
 )
 
 # Trace-level instant events (supervisor actions that punctuate a request's
@@ -240,6 +251,10 @@ def load_env() -> None:
     env = os.environ
     if ENV_PERSIST in env:
         persist = env[ENV_PERSIST]  # explicit, "" = off
+    elif env.get(_ENV_OBJECT_DIR):
+        # persist the ring alongside the OBJECT KV tier by preference:
+        # portable thread state carries its trace history across hosts
+        persist = os.path.join(env[_ENV_OBJECT_DIR], "traces")
     elif env.get(_ENV_DISK_TIER):
         # persist the ring alongside the disk KV tier by default
         persist = os.path.join(env[_ENV_DISK_TIER], "traces")

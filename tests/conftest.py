@@ -115,6 +115,10 @@ _HEAVY_TAIL = (
     # running them first would pre-warm the XLA cache under test_engine's
     # wall-clock-sensitive deadline tests (timeout would race length)
     "test_kv_tier.py",
+    # object-store tier builds several engines over the same tiny-model
+    # shapes (sleep on A / wake on B) — keep it with the tier tests on
+    # the warm-cache side of test_engine
+    "test_object_tier.py",
     # flight-recorder integration shares the tiny-model shapes too and
     # arms wall-clock-sensitive delay failpoints — keep it off the cold
     # compile path like test_kv_tier

@@ -340,6 +340,90 @@ class TestDisaggParity:
         assert all(not e.handoffs for e in dp.engines)
 
 
+class TestTieredDestinationDelta:
+    def test_delta_ship_onto_tiered_decode_replica(self, model):
+        """PR 12 follow-up (ISSUE 14): with content-keyed skips the
+        delta-ship path is enabled on destinations running a KV tier.
+        Thread B's hand-off skips the shared head the decode replica
+        already holds — even with that head DEMOTED to the host tier,
+        where the old dummy-id adopt hazard lived: store()'s adoption
+        now requires real page ids, so the host run keeps its tier copy
+        and B's resume promotes it (zero re-prefill, token-exact)."""
+        cfg, params = model
+        ecfg = EngineConfig(**ECFG, kv_host_tier_mb=64)
+        dp = DataParallelEngines(
+            cfg, params, ecfg, dp=2, tp=1, kv_dtype=jnp.float32,
+            dp_roles="prefill:1,decode:1", disagg_min_prefill_tokens=8,
+        )
+        ps = dp.ecfg.page_size
+        head = prompt_of(91, 4 * ps)
+        tail_a = prompt_of(92, ps)
+        tail_b = prompt_of(93, ps)
+
+        # thread A: full 5-page ship seeds the decode replica's cache
+        ra = GenRequest(request_id="A", prompt_ids=head + tail_a + [3],
+                        max_new_tokens=4, prefix_key="T-a")
+        dp.submit(ra)
+        assert ra.handoff
+        dp.run_to_completion()
+        assert dp.disagg.shipped_pages == 5
+        dst = dp.engines[1]
+        assert dst.kv_tier is not None
+
+        # demote A's run into the decode replica's HOST tier — the
+        # configuration the delta path used to be gated off for
+        assert dst.prefix_cache.reclaim(
+            dst.pool.free_pages + dst.prefix_cache.total_pages
+        )
+        assert dst.prefix_cache.host_nodes >= 1
+
+        rb = GenRequest(request_id="B", prompt_ids=head + tail_b + [5],
+                        max_new_tokens=4, prefix_key="T-b")
+        dp.submit(rb)
+        assert rb.handoff
+        dp.run_to_completion()
+        # delta: only B's 1-page tail crossed the wire (the 4-page head
+        # was counted as matched even though it sat in the HOST tier)
+        assert dp.disagg.shipped_pages == 6
+        # the host-resident head did NOT adopt the dummy entries — B's
+        # resume PROMOTED it from the tier (real H2D traffic, not
+        # captured garbage ids) and decoded with zero prompt re-prefill
+        assert dst.kv_tier.snapshot()["promotions"] >= 1
+        assert rb.cache_source == "shipped"
+        assert rb.cached_tokens == 5 * ps
+        for e in dp.engines:
+            assert not e.self_check()
+
+        # B's second turn stays warm on the tiered destination
+        rb2 = GenRequest(request_id="B2",
+                         prompt_ids=head + tail_b + [5] + rb.output_ids,
+                         max_new_tokens=4, prefix_key="T-b")
+        dp.submit(rb2)
+        dp.run_to_completion()
+        assert rb2.cached_tokens >= 5 * ps
+
+        # token-exactness vs a single engine serving the same threads
+        single = InferenceEngine(cfg, params, EngineConfig(**ECFG),
+                                 kv_dtype=jnp.float32)
+        outs = {}
+        for tid, p in (("a", head + tail_a + [3]), ("b", head + tail_b + [5])):
+            r1 = GenRequest(request_id=f"s-{tid}", prompt_ids=list(p),
+                            max_new_tokens=4, prefix_key=f"s-{tid}")
+            single.submit(r1)
+            single.run_to_completion()
+            outs[tid] = list(r1.output_ids)
+        assert outs["a"] == list(ra.output_ids)
+        assert outs["b"] == list(rb.output_ids)
+        s2 = GenRequest(request_id="s-b2",
+                        prompt_ids=head + tail_b + [5] + outs["b"],
+                        max_new_tokens=4, prefix_key="s-b")
+        single.submit(s2)
+        single.run_to_completion()
+        assert list(s2.output_ids) == list(rb2.output_ids)
+        for e in dp.engines:
+            assert not e.self_check()
+
+
 class TestTornShip:
     def test_torn_first_chunk_degrades_to_reprefill(self, model):
         """kv.ship error on the first chunk: nothing lands, the thread
