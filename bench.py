@@ -1000,6 +1000,208 @@ def sleep_wake_phase(cfg, params, n_threads: int = 4, common_len: int = 512,
     }
 
 
+def store_outage_phase(cfg, params, n_threads: int = 5,
+                       common_len: int = 128, suffix_len: int = 16,
+                       gen_len: int = 8, page_size: int = 8,
+                       seed: int = 43, object_dir=None) -> dict:
+    """Object-store outage containment proof (ISSUE 17): with the object
+    tier enabled and the store killed MID-RUN (failpoint storm on every
+    store op), the StoreGuard breaker opens, no request ever stalls on a
+    store op — submit→first-dispatch stays within noise of a storeless
+    baseline paying the same re-prefills — and after the store returns a
+    drained thread wakes with ``cache_source="object_tier"`` again,
+    token-exact.
+
+    Timeline on the wake replica (fresh engine B mounting the store
+    replica A drained into):
+      1. pre-outage resume — store healthy, wake from the object tier;
+      2. the store dies (``kv.object_put/get/head`` armed ``error``):
+         each newly-probed thread records one breaker failure, the
+         breaker opens at the threshold, later probes are negatively
+         cached / fast-failed — every resume completes as a plain
+         re-prefill at baseline latency;
+      3. the store returns, the open window elapses: the next resume is
+         the half-open probe, the breaker closes, and the thread wakes
+         from its sleep manifest.
+
+    Every output is asserted token-identical against a never-slept
+    reference — degradation changes WHERE tokens come from, never what
+    they are.  Importable by the tier-1 CPU smoke
+    (tests/test_store_guard.py)."""
+    import os
+    import shutil
+    import tempfile
+
+    from kafka_tpu.failpoints import clear as fp_clear
+    from kafka_tpu.failpoints import configure as fp_configure
+    from kafka_tpu.runtime import EngineConfig, GenRequest, InferenceEngine
+
+    rng = random.Random(seed)
+    own_dir = object_dir is None
+    if own_dir:
+        object_dir = tempfile.mkdtemp(prefix="kafka-kv-outage-")
+    total = common_len + suffix_len + 2 * gen_len
+    win_pages = max(4, -(-(total + 2 * page_size) // page_size))
+    open_window_s = 0.75
+    # a fast-tripping guard: the phase proves the state machine, not the
+    # production trip threshold
+    knobs = {
+        "KAFKA_TPU_KV_OBJECT_BREAKER_FAILURES": "3",
+        "KAFKA_TPU_KV_OBJECT_BREAKER_OPEN_S": str(open_window_s),
+        "KAFKA_TPU_KV_OBJECT_RETRIES": "0",
+        "KAFKA_TPU_KV_OBJECT_BACKOFF_S": "0",
+    }
+    saved = {k: os.environ.get(k) for k in knobs}
+    os.environ.update(knobs)
+
+    def mk(with_store: bool):
+        ecfg = EngineConfig(
+            max_batch=2, page_size=page_size,
+            max_pages_per_seq=win_pages,
+            num_pages=(n_threads + 2) * win_pages + 2,
+            prefill_buckets=(16, 64, 256, 512, 1024),
+            kv_host_tier_mb=256,
+            kv_object_dir=object_dir if with_store else None,
+        )
+        return InferenceEngine(cfg, params, ecfg)
+
+    common = make_prompt(rng, common_len, cfg.vocab_size)
+    suffixes = [make_prompt(rng, suffix_len, cfg.vocab_size)
+                for _ in range(n_threads)]
+    tails = [make_prompt(rng, max(4, gen_len // 2), cfg.vocab_size)
+             for _ in range(n_threads)]
+
+    def warm_compiles(eng):
+        for n in (total, 32, max(4, gen_len // 2)):
+            eng.generate(make_prompt(rng, n, cfg.vocab_size),
+                         max_new_tokens=2)
+        eng.warmup_kv_tier()
+
+    def serve_first_turns(eng):
+        outs = []
+        for i, sfx in enumerate(suffixes):
+            r = GenRequest(request_id=f"so-{i}", prompt_ids=common + sfx,
+                           max_new_tokens=gen_len, prefix_key=f"so-t{i}")
+            eng.submit(r)
+            eng.run_to_completion()
+            outs.append(list(r.output_ids))
+        return outs
+
+    def resume(eng, i, label, first_outputs):
+        prompt = common + suffixes[i] + first_outputs[i] + tails[i]
+        r = GenRequest(request_id=f"{label}-{i}", prompt_ids=prompt,
+                       max_new_tokens=gen_len, prefix_key=f"so-t{i}")
+        eng.submit(r)
+        eng.run_to_completion()
+        return r
+
+    def ttft_ms(r):
+        return round((r.first_token_time - r.submit_time) * 1e3, 2)
+
+    # thread roles: [0] pre-outage wake, [1:-1] resumed DURING the
+    # outage, [-1] resumed after the store comes back
+    outage_ids = list(range(1, n_threads - 1))
+    try:
+        # ---- replica A: serve + drain to the store ------------------
+        a_eng = mk(with_store=True)
+        warm_compiles(a_eng)
+        first_outputs = serve_first_turns(a_eng)
+        sleep_stats = a_eng.sleep_to_object()
+        del a_eng
+
+        # ---- storeless baseline: fresh replica, pure re-prefill -----
+        c_eng = mk(with_store=False)
+        warm_compiles(c_eng)
+        cold = [resume(c_eng, i, "cold", first_outputs)
+                for i in range(n_threads)]
+        baseline_ttft = [ttft_ms(cold[i]) for i in outage_ids]
+        del c_eng
+
+        # ---- replica B: wake, outage mid-run, recovery --------------
+        b_eng = mk(with_store=True)
+        warm_compiles(b_eng)
+        obj = b_eng.kv_tier.object
+        pre = resume(b_eng, 0, "pre", first_outputs)
+        for site in ("kv.object_put", "kv.object_get", "kv.object_head"):
+            fp_configure(site, "error")
+        try:
+            during = [resume(b_eng, i, "down", first_outputs)
+                      for i in outage_ids]
+        finally:
+            for site in ("kv.object_put", "kv.object_get",
+                         "kv.object_head"):
+                fp_clear(site)
+        state_during = obj.breaker_state()
+        snap_during = obj.snapshot()
+        outage_ttft = [ttft_ms(r) for r in during]
+        # the store is back; let the open window elapse so the next
+        # resume is the half-open probe
+        time.sleep(open_window_s + 0.1)
+        recovered = resume(b_eng, n_threads - 1, "rec", first_outputs)
+        snap_after = obj.snapshot()
+
+        # ---- never-slept reference: token-exactness -----------------
+        ref_eng = mk(with_store=False)
+        ref_first = serve_first_turns(ref_eng)
+        ref = [resume(ref_eng, i, "ref", first_outputs)
+               for i in range(n_threads)]
+        del ref_eng
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        if own_dir:
+            shutil.rmtree(object_dir, ignore_errors=True)
+
+    base_p99 = max(baseline_ttft)
+    out_p99 = max(outage_ttft)
+    # "within noise": the outage resumes pay exactly the baseline's
+    # re-prefill (store ops fast-fail / are negatively cached), so p99
+    # stays inside a generous CPU-jitter envelope of the baseline
+    contained = out_p99 <= base_p99 * 3.0 + 100.0
+    attainment_during = sum(
+        1 for t in outage_ttft if t <= base_p99 * 3.0 + 100.0
+    ) / max(1, len(outage_ttft))
+    outputs_match = (
+        ref_first == first_outputs
+        and list(pre.output_ids) == list(ref[0].output_ids)
+        and all(list(during[j].output_ids)
+                == list(ref[outage_ids[j]].output_ids)
+                for j in range(len(outage_ids)))
+        and list(recovered.output_ids)
+        == list(ref[n_threads - 1].output_ids)
+        and all(list(cold[i].output_ids) == list(ref[i].output_ids)
+                for i in range(n_threads))
+    )
+    return {
+        "n_threads": n_threads,
+        "sleep": sleep_stats,
+        "pre_outage_cache_source": pre.cache_source,
+        "breaker_opened": snap_during["store_breaker_opens"] >= 1,
+        "breaker_state_during": state_during,
+        "breaker_state_after": snap_after["store_breaker_state"],
+        "probe_neg_cached": snap_after["store_probe_neg_cached"],
+        "ttft_p99_ms": {"baseline_reprefill": base_p99,
+                        "store_down": out_p99},
+        "outage_ttft_ms": outage_ttft,
+        "baseline_ttft_ms": baseline_ttft,
+        "contained": contained,
+        "attainment_during_outage": round(attainment_during, 3),
+        "outage_cache_sources": [r.cache_source for r in during],
+        "recovered_cache_source": recovered.cache_source,
+        "recovered_object_tokens": recovered.object_tokens,
+        "outputs_match": outputs_match,
+        "note": ("store killed mid-run via kv.object_* failpoint storm: "
+                 "breaker opens after the trip threshold, every resume "
+                 "completes as a baseline-latency re-prefill (no store "
+                 "stall), and after the store returns the half-open "
+                 "probe closes the breaker — the last thread wakes from "
+                 "its sleep manifest, token-exact"),
+    }
+
+
 def disagg_phase(cfg, params, n_chatty: int = 4, n_long: int = 4,
                  chatty_prompt: int = 48, chatty_gen: int = 96,
                  long_prompt: int = 1025, long_gen: int = 8,
@@ -1954,7 +2156,8 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("scenario", nargs="?", default="all",
                     choices=("all", "speculative", "constrained", "kv_tier",
-                             "sleep_wake", "disagg", "autoscale"),
+                             "sleep_wake", "store_outage", "disagg",
+                             "autoscale"),
                     help="'speculative' runs ONLY the speculative-decoding "
                          "A/B phase; 'constrained' runs ONLY the on-device "
                          "grammar FSM vs host-mask A/B; 'kv_tier' runs ONLY "
@@ -1962,6 +2165,11 @@ def main() -> None:
                          "re-prefill); 'sleep_wake' runs ONLY the "
                          "object-store sleep/wake A/B (drain replica A, "
                          "wake on a fresh replica B vs full re-prefill); "
+                         "'store_outage' runs ONLY the object-store "
+                         "outage containment proof (store killed "
+                         "mid-run: breaker opens, serving degrades to "
+                         "re-prefill at baseline latency, wake resumes "
+                         "after recovery); "
                          "'disagg' runs ONLY the disaggregated "
                          "prefill/decode A/B (colocated vs "
                          "prefill:1,decode:1 under mixed open-loop traffic); "
@@ -2121,6 +2329,35 @@ def main() -> None:
         print(json.dumps({
             "metric": f"sleep_wake_cross_host_resume_speedup_{cfg.name}",
             "value": out["speedup"],
+            "unit": "x",
+            "extras": out,
+        }))
+        return
+
+    if args.scenario == "store_outage":
+        # bench.py store_outage: ONLY the outage containment proof
+        out = store_outage_phase(
+            cfg, params,
+            n_threads=5,
+            common_len=96 if args.quick else 128,
+            suffix_len=16,
+            gen_len=8,
+            page_size=8,
+        )
+        log(f"store_outage: breaker_opened {out['breaker_opened']} "
+            f"(state during outage: {out['breaker_state_during']}), "
+            f"TTFT p99 store-down {out['ttft_p99_ms']['store_down']}ms "
+            f"vs baseline re-prefill "
+            f"{out['ttft_p99_ms']['baseline_reprefill']}ms "
+            f"(contained {out['contained']}), recovered wake "
+            f"{out['recovered_cache_source']}, outputs_match "
+            f"{out['outputs_match']}")
+        print(json.dumps({
+            "metric": f"store_outage_ttft_p99_ratio_{cfg.name}",
+            "value": round(
+                out["ttft_p99_ms"]["store_down"]
+                / out["ttft_p99_ms"]["baseline_reprefill"], 3)
+            if out["ttft_p99_ms"]["baseline_reprefill"] else None,
             "unit": "x",
             "extras": out,
         }))
@@ -2322,6 +2559,21 @@ def main() -> None:
         f"re-prefill {sleep_wake['cold_resume_ttft_ms']['reprefill']}ms "
         f"({sleep_wake['speedup']}x), dedupe ratio "
         f"{sleep_wake['cross_host_dedupe_ratio']}")
+
+    # ---- store_outage: breaker containment under a dead store -----------
+    store_outage = store_outage_phase(
+        cfg, params,
+        n_threads=5,
+        common_len=96 if args.quick else 128,
+        suffix_len=16,
+        gen_len=8,
+        page_size=8,
+    )
+    log(f"store_outage: breaker_opened {store_outage['breaker_opened']}, "
+        f"TTFT p99 store-down "
+        f"{store_outage['ttft_p99_ms']['store_down']}ms vs baseline "
+        f"{store_outage['ttft_p99_ms']['baseline_reprefill']}ms, "
+        f"recovered wake {store_outage['recovered_cache_source']}")
 
     # ---- disaggregated prefill/decode: colocated vs role pools ----------
     disagg = None
@@ -2590,6 +2842,7 @@ def main() -> None:
             "shared_prefix": shared_prefix,
             "kv_tier": kv_tier,
             "sleep_wake": sleep_wake,
+            "store_outage": store_outage,
             "disagg": disagg,
             "autoscale": autoscale,
             "speculative": speculative,

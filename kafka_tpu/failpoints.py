@@ -86,6 +86,17 @@ SITES = (
     # re-prefill; `delay` simulates a slow store.
     "kv.object_put",
     "kv.object_get",
+    # Store metadata probes (runtime/object_tier.py): `kv.object_head`
+    # fires on existence checks — wake truncation's has_run probes and
+    # read_manifest's head validation.  `error` = the probe fails closed
+    # (absent-shaped): a wake truncates at that run, the router's
+    # manifest probe is negatively cached for the breaker's open window;
+    # `delay` simulates a slow store stat.  `kv.object_list` fires on
+    # listing walks — release's last-ref scan and the fsck scrubber.
+    # `error` on release leaves a crash-window orphan (exactly what fsck
+    # repairs); `error` on fsck degrades it to a partial report.
+    "kv.object_head",
+    "kv.object_list",
     "worker.dispatch",
     "sandbox.exec",
     "sandbox.boot",

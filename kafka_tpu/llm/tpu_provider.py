@@ -310,7 +310,14 @@ class TPULLMProvider(LLMProvider):
           wake counts — with the tier mounted, scale-in is
           drain-then-shrink (warm state survives the removed replica),
           so a controller can shrink more aggressively.  Null when
-          KAFKA_TPU_KV_OBJECT_DIR is unset.
+          KAFKA_TPU_KV_OBJECT_DIR is unset.  Version 6 (ISSUE 17) adds
+          store HEALTH to the section: ``breaker_state``
+          ("closed"/"half_open"/"open" — the dp max, so any replica's
+          open breaker surfaces), ``breaker_opens``,
+          ``store_available`` (False = the store is fast-failing and
+          the pre-scale-in drain will be SKIPPED: shrink decisions
+          should assume dormant threads re-prefill), and the
+          retry/timeout/error/negative-probe counters behind it.
 
         Everything is read torn-tolerantly from the engine thread's
         single-writer metrics; no locks, safe at scrape frequency.
@@ -414,13 +421,18 @@ class TPULLMProvider(LLMProvider):
         scaler = self.autoscaler
         # Object-store tier (version 5, ISSUE 14): shared-store occupancy,
         # the cross-host dedupe ratio, and wake counts — the autoscaler's
-        # "drain-then-shrink is cheap here" signal.  Null when
+        # "drain-then-shrink is cheap here" signal.  Version 6 (ISSUE 17)
+        # adds store health: breaker state (the dp-aggregate max, so any
+        # replica's open breaker surfaces), retry/timeout counters, and
+        # store_available — False tells a controller the pre-scale-in
+        # drain will be skipped (capacity beats warm state).  Null when
         # KAFKA_TPU_KV_OBJECT_DIR is unset.
         obj = snap.get("object_tier") or None
         object_section = None
         if obj:
             tried = (obj.get("object_puts", 0)
                      + obj.get("dedupe_hits", 0))
+            breaker_gauge = int(obj.get("store_breaker_state", 0))
             object_section = {
                 "store_bytes": obj.get("store_bytes", 0),
                 "store_objects": obj.get("store_objects", 0),
@@ -429,10 +441,23 @@ class TPULLMProvider(LLMProvider):
                 ) if tried else 0.0,
                 "wake_threads": obj.get("wake_threads", 0),
                 "wake_tokens": obj.get("wake_tokens", 0),
+                "breaker_state": {0: "closed", 1: "half_open",
+                                  2: "open"}.get(breaker_gauge, "open"),
+                "breaker_opens": obj.get("store_breaker_opens", 0),
+                "store_available": breaker_gauge != 2,
+                "store_retries": obj.get("store_retries", 0),
+                "store_timeouts": obj.get("store_timeouts", 0),
+                "store_errors": (obj.get("object_put_failures", 0)
+                                 + obj.get("object_get_failures", 0)),
+                "probe_neg_cached": obj.get("store_probe_neg_cached", 0),
             }
         return {
-            # version 5 (ISSUE 14): + object_tier section (shared-store
-            # bytes/objects, dedupe ratio, wake counts — null without
+            # version 6 (ISSUE 17): object_tier section gains store
+            # health — breaker_state/breaker_opens/store_available plus
+            # retry/timeout/error and negative-probe counters (the
+            # StoreGuard resilience layer).  Version 5 (ISSUE 14) added
+            # the object_tier section (shared-store bytes/objects,
+            # dedupe ratio, wake counts — null without
             # KAFKA_TPU_KV_OBJECT_DIR).  Version 4 (ISSUE 13) added the
             # autoscaler section (control-loop mode, degradation-ladder
             # rung, cooldowns, last decision — null when
@@ -442,7 +467,7 @@ class TPULLMProvider(LLMProvider):
             # counters; version 2 (ISSUE 11) the anomalies section,
             # per-replica anomalies_active, and the
             # measured-utilization fields under utilization.*.
-            "version": 5,
+            "version": 6,
             "dp": len(replicas),
             "queue": dict(snap.get("queue") or {}),
             "anomalies": anomalies,

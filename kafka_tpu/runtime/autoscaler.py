@@ -765,6 +765,22 @@ class AutoscalerController:
             pass
         return False
 
+    def _object_store_available(self) -> bool:
+        """False when any replica's store guard reports an OPEN breaker:
+        the pre-scale-in drain would only burn the drain window failing
+        every put, so the resize proceeds immediately — capacity beats
+        warm state, and the skipped state re-prefills on wake."""
+        if self.ladder is None:
+            return True
+        try:
+            for e in self.ladder._engines():
+                obj = getattr(getattr(e, "kv_tier", None), "object", None)
+                if obj is not None and not obj.available():
+                    return False
+        except Exception:  # pragma: no cover - provider shim variance
+            pass
+        return True
+
     def _drain_before_shrink(self) -> None:
         """Drain-then-shrink (ISSUE 14): before a scale-in, flush EVERY
         replica's warm KV state to the shared object store — the rebuild
@@ -782,6 +798,12 @@ class AutoscalerController:
             drain is None or self._loop is None
             or not self._object_tier_enabled()
         ):
+            return
+        if not self._object_store_available():
+            logger.warning(
+                "object store breaker open; skipping pre-scale-in drain "
+                "(capacity beats warm state — dormant threads re-prefill)",
+            )
             return
         import asyncio
 

@@ -675,6 +675,11 @@ class DataParallelEngines:
         if obj is None:
             return 0
         try:
+            if not obj.available():
+                # breaker open: the submit path pays ZERO store RTT —
+                # counted with the negatively-cached manifest probes
+                obj.probe_neg_cached += 1
+                return 0
             return obj.manifest_match_tokens(req.prefix_key,
                                              req.prompt_ids)
         except Exception:  # pragma: no cover - store flake
@@ -1355,16 +1360,22 @@ class _AggregateMetrics:
         # Object-store KV tier (ISSUE 14, OBJECT_TIER_METRIC_KEYS):
         # per-owner counters sum; the store gauges describe the ONE
         # SHARED store every replica mounts, so they report once,
-        # unsummed (summing would multiply by dp)
+        # unsummed (summing would multiply by dp); the breaker-state
+        # gauge maxes — one replica's open breaker must stay visible in
+        # the fleet view, and 2=open dominates 1=half-open dominates 0
         obj_snaps = [s["object_tier"] for s in snaps
                      if "object_tier" in s]
         if obj_snaps:
             shared = ("store_bytes", "store_objects")
-            agg["object_tier"] = {
-                k: (obj_snaps[0][k] if k in shared
-                    else sum(t[k] for t in obj_snaps))
-                for k in obj_snaps[0]
-            }
+
+            def _agg_obj(k: str) -> Any:
+                if k in shared:
+                    return obj_snaps[0][k]
+                if k == "store_breaker_state":
+                    return max(t.get(k, 0) for t in obj_snaps)
+                return sum(t[k] for t in obj_snaps)
+
+            agg["object_tier"] = {k: _agg_obj(k) for k in obj_snaps[0]}
         # Flight recorder + anomaly detectors (ISSUE 11): counters sum;
         # each active anomaly carries the replica it fires on so the
         # autoscaler's "don't scale while an anomaly is active" guard can

@@ -897,14 +897,30 @@ class InferenceEngine:
                 # ladder wants both).  The content-address fingerprint
                 # covers the pool geometry + model name, so incompatible
                 # pools can never exchange KV through a shared store.
-                from .object_tier import LocalFSObjectStore, ObjectTier
+                # build_object_store picks the backend by scheme
+                # (http(s):// = S3-shaped HTTPObjectStore, else a shared
+                # directory) and wraps it in a StoreGuard — deadline,
+                # retry, circuit breaker — configured from the
+                # KAFKA_TPU_KV_OBJECT_* env knobs, so a dead store
+                # degrades warm resumes instead of stalling dispatch.
+                from .object_tier import ObjectTier, build_object_store
 
-                self.kv_tier.attach_object(ObjectTier(
-                    LocalFSObjectStore(self.ecfg.kv_object_dir),
+                obj_tier = ObjectTier(
+                    build_object_store(self.ecfg.kv_object_dir),
                     budget_bytes=self.ecfg.kv_object_mb * 1024 * 1024,
                     fingerprint=self._object_fingerprint(),
                     page_size=ps,
-                ))
+                )
+                # opt-in in-process janitor (default off: one offline
+                # objstore_fsck.py per store beats N replicas scrubbing)
+                obj_tier.start_janitor(
+                    float(os.environ.get(
+                        "KAFKA_TPU_KV_OBJECT_SCRUB_S", "0") or 0),
+                    grace_s=float(os.environ.get(
+                        "KAFKA_TPU_KV_OBJECT_SCRUB_GRACE_S",
+                        "3600") or 3600),
+                )
+                self.kv_tier.attach_object(obj_tier)
         if self.ecfg.flight_ring < 0:
             raise ValueError(
                 "flight_ring must be >= 0 (0 disables the flight recorder)"

@@ -937,11 +937,15 @@ class KVTierManager:
         refresh its claimants' sleep manifests.  The run stays registered
         (payload-less, zero local bytes) so a later promote fetches it
         back transparently.  False = no object tier / no path context /
-        torn put — the caller drops the run as before."""
+        store breaker open / torn put — the caller drops the run as
+        before.  The availability gate is checked BEFORE encoding: with
+        the breaker open the put cannot land, so the run degrades to
+        plain eviction without paying the serialization either."""
         if (
             self.object is None
             or run.k_leaves is None
             or not run.path_runs
+            or not self.object.available()
         ):
             return False
         flat = [t for seg in run.path_runs for t in seg]

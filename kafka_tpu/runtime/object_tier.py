@@ -36,9 +36,20 @@ interface, local-filesystem default) mounted under
   commit (atomic rename; the store never holds partial payloads).  A
   get miss or torn fetch aborts the WHOLE wake — every page allocated for
   it is freed — and the request degrades to the disk-tier/local hit or a
-  plain re-prefill, never partial KV.  Both paths are chaos-testable via
-  the ``kv.object_put`` / ``kv.object_get`` failpoints (fired once per
-  object).
+  plain re-prefill, never partial KV.  All store touch points are
+  chaos-testable via the ``kv.object_put`` / ``kv.object_get`` /
+  ``kv.object_head`` / ``kv.object_list`` failpoints.
+* **Fault containment.**  In production the engine mounts the store
+  behind :class:`~kafka_tpu.runtime.store_guard.StoreGuard`
+  (``build_object_store``): per-op deadlines, bounded retry with jitter
+  (every protocol op is idempotent), and a consecutive-failure circuit
+  breaker.  While the breaker is open ``available()`` is False and every
+  consumer degrades instead of stalling — archive falls back to plain
+  eviction, wake to local/disk/re-prefill, the router's manifest probes
+  are negatively cached for the open window, and drain returns partial
+  results with honest accounting.  ``fsck`` (and
+  ``scripts/objstore_fsck.py``) walks refs↔objects↔manifests to repair
+  the refcount protocol's crash windows.
 
 The span-ring persistence that PR 8 parked next to the disk tier moves
 along: with ``KAFKA_TPU_KV_OBJECT_DIR`` set and no explicit
@@ -49,19 +60,24 @@ host exactly like its KV does.
 
 from __future__ import annotations
 
+import email.utils
 import hashlib
+import http.client
 import json
 import logging
 import os
+import re
 import threading
 import time
 import uuid
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Sequence, Tuple
+from urllib.parse import quote, urlsplit
 
 import numpy as np
 
 from .failpoints import failpoint
+from .store_guard import BREAKER_OPEN, StoreGuard, StoreGuardError
 from .tracing import record_span
 from ..tracing import sanitize_stem
 
@@ -82,6 +98,11 @@ MiB = 1024 * 1024
 # within the window is picked up at most this late — wakes degrade to
 # re-prefill in the meantime, never to wrong KV.
 _HEAD_TTL_S = 0.5
+
+# Sentinel head-signature for a manifest probe that FAILED (store error,
+# not a miss): cached like a signature, but served as a counted negative
+# for the breaker's open window instead of _HEAD_TTL_S.
+_PROBE_FAILED = object()
 
 # Manifests refreshed per organic archive are capped to the node's most
 # recent claimants: a fan-out shared node can carry hundreds of thread
@@ -134,6 +155,18 @@ class ObjectStore:
     def usage(self) -> Tuple[int, int]:
         """(object_count, total_bytes) of run payloads in the store."""
         raise NotImplementedError
+
+    def put_if_absent(self, key: str, data: bytes) -> bool:
+        """Conditional write: create `key` only when absent; True when
+        this call created it.  The refcount protocol's ref markers use
+        this so re-marking is a no-op, not a rewrite.  Backends with a
+        native conditional (S3 ``If-None-Match: *``) override; the
+        default head-then-put is good enough for a same-content race
+        (markers are empty, so the loser overwrites with equal bytes)."""
+        if self.head(key) is not None:
+            return False
+        self.put(key, data)
+        return True
 
 
 class LocalFSObjectStore(ObjectStore):
@@ -212,6 +245,201 @@ class LocalFSObjectStore(ObjectStore):
         return count, total
 
 
+class _TornBodyError(OSError):
+    """Response body did not match its declared Content-Length."""
+
+
+class HTTPObjectStore(ObjectStore):
+    """S3-shaped HTTP backend: PUT/GET/HEAD/DELETE on ``<base>/<key>``
+    plus ``GET <base>?list-type=2&prefix=`` XML listings, over a small
+    pool of persistent connections.
+
+    The ROADMAP's "genuine S3/GCS ObjectStore behind the PR 14
+    interface": conditional writes (``If-None-Match: *``, 412 = already
+    present) implement the ref-marker protocol without read-modify-write,
+    and every body is length-checked against Content-Length — a torn
+    response is discarded and counted, never decoded.  Transport faults
+    raise OSError so :class:`~.store_guard.StoreGuard` (which production
+    mounts around this class) owns the retry/deadline/breaker policy;
+    the only in-class retry is one fresh-connection replay when a POOLED
+    connection turns out stale before any response bytes arrived."""
+
+    def __init__(self, base_url: str, timeout_s: float = 10.0,
+                 pool_size: int = 4):
+        parts = urlsplit(base_url)
+        if parts.scheme not in ("http", "https"):
+            raise ValueError(f"HTTPObjectStore needs http(s) URL, got {base_url!r}")
+        self._https = parts.scheme == "https"
+        self._host = parts.hostname or "localhost"
+        self._port = parts.port
+        self._base = parts.path.rstrip("/")
+        self.timeout_s = float(timeout_s)
+        self._pool: List[http.client.HTTPConnection] = []
+        self._pool_size = int(pool_size)
+        self._pool_lock = threading.Lock()
+        self.torn_bodies = 0  # length-mismatched responses discarded
+        self._usage_cache: Tuple[float, Tuple[int, int]] = (0.0, (0, 0))
+
+    # -- transport -----------------------------------------------------
+
+    def _new_conn(self) -> http.client.HTTPConnection:
+        cls = http.client.HTTPSConnection if self._https else http.client.HTTPConnection
+        return cls(self._host, self._port, timeout=self.timeout_s)
+
+    def _checkout(self) -> Optional[http.client.HTTPConnection]:
+        with self._pool_lock:
+            return self._pool.pop() if self._pool else None
+
+    def _checkin(self, conn: http.client.HTTPConnection) -> None:
+        with self._pool_lock:
+            if len(self._pool) < self._pool_size:
+                self._pool.append(conn)
+                return
+        conn.close()
+
+    def _request(
+        self, method: str, path: str, body: Optional[bytes] = None,
+        headers: Optional[Dict[str, str]] = None,
+    ) -> Tuple[int, Dict[str, str], bytes]:
+        for attempt in range(2):
+            pooled = self._checkout()
+            conn = pooled if pooled is not None else self._new_conn()
+            try:
+                conn.request(method, path, body=body, headers=headers or {})
+                resp = conn.getresponse()
+            except (http.client.HTTPException, OSError):
+                # nothing of the response arrived: a stale keep-alive
+                # connection is indistinguishable from a dead server, so
+                # replay ONCE on a fresh connection, then surface
+                conn.close()
+                if pooled is None or attempt == 1:
+                    raise
+                continue
+            try:
+                data = resp.read()
+            except http.client.IncompleteRead as e:
+                self.torn_bodies += 1
+                conn.close()
+                raise _TornBodyError(
+                    f"{method} {path}: torn body ({len(e.partial)} bytes)"
+                ) from e
+            except OSError:
+                conn.close()
+                raise
+            clen = resp.getheader("Content-Length")
+            if method != "HEAD" and clen is not None and int(clen) != len(data):
+                self.torn_bodies += 1
+                conn.close()
+                raise _TornBodyError(
+                    f"{method} {path}: body {len(data)}B != declared {clen}B"
+                )
+            if resp.will_close:
+                conn.close()
+            else:
+                self._checkin(conn)
+            return resp.status, {k.lower(): v for k, v in resp.getheaders()}, data
+        raise OSError("unreachable")  # pragma: no cover
+
+    def _key_path(self, key: str) -> str:
+        parts = [p for p in key.split("/") if p not in ("", ".", "..")]
+        return self._base + "/" + quote("/".join(parts))
+
+    # -- ObjectStore surface -------------------------------------------
+
+    def put(self, key: str, data: bytes) -> None:
+        status, _, _ = self._request(
+            "PUT", self._key_path(key), body=data,
+            headers={"Content-Type": "application/octet-stream"},
+        )
+        if status not in (200, 201, 204):
+            raise OSError(f"PUT {key}: HTTP {status}")
+
+    def put_if_absent(self, key: str, data: bytes) -> bool:
+        status, _, _ = self._request(
+            "PUT", self._key_path(key), body=data,
+            headers={"Content-Type": "application/octet-stream",
+                     "If-None-Match": "*"},
+        )
+        if status == 412:
+            return False  # already present: the marker stands
+        if status not in (200, 201, 204):
+            raise OSError(f"conditional PUT {key}: HTTP {status}")
+        return True
+
+    def get(self, key: str) -> Optional[bytes]:
+        status, _, data = self._request("GET", self._key_path(key))
+        if status == 404:
+            return None
+        if status != 200:
+            raise OSError(f"GET {key}: HTTP {status}")
+        return data
+
+    def head(self, key: str) -> Optional[Tuple[int, float]]:
+        status, headers, _ = self._request("HEAD", self._key_path(key))
+        if status == 404:
+            return None
+        if status != 200:
+            raise OSError(f"HEAD {key}: HTTP {status}")
+        size = int(headers.get("content-length", 0))
+        mtime = 0.0
+        lm = headers.get("last-modified")
+        if lm:
+            try:
+                mtime = email.utils.parsedate_to_datetime(lm).timestamp()
+            except (TypeError, ValueError):
+                mtime = 0.0
+        return size, mtime
+
+    def delete(self, key: str) -> None:
+        status, _, _ = self._request("DELETE", self._key_path(key))
+        if status not in (200, 202, 204, 404):
+            raise OSError(f"DELETE {key}: HTTP {status}")
+
+    def _list_entries(self, prefix: str) -> List[Tuple[str, int]]:
+        path = f"{self._base or '/'}?list-type=2&prefix={quote(prefix)}"
+        status, _, data = self._request("GET", path)
+        if status != 200:
+            raise OSError(f"LIST {prefix}: HTTP {status}")
+        text = data.decode("utf-8", "replace")
+        out: List[Tuple[str, int]] = []
+        for m in re.finditer(
+            r"<Contents>.*?<Key>([^<]*)</Key>(?:.*?<Size>(\d+)</Size>)?.*?</Contents>",
+            text, re.S,
+        ):
+            out.append((m.group(1), int(m.group(2) or 0)))
+        return out
+
+    def list(self, prefix: str) -> List[str]:
+        # S3 has no directories: a prefix listing is recursive, which is
+        # a superset of LocalFS's one-level listing — every consumer
+        # (release's ref scan, fsck's walk) treats it as "keys under"
+        return [k for k, _ in self._list_entries(prefix)]
+
+    def usage(self) -> Tuple[int, int]:
+        now = time.monotonic()
+        ts, cached = self._usage_cache
+        if now - ts < 1.0:
+            return cached
+        entries = self._list_entries("objects/")
+        out = (len(entries), sum(s for _, s in entries))
+        self._usage_cache = (now, out)
+        return out
+
+
+def build_object_store(spec: str) -> StoreGuard:
+    """The engine's store constructor: ``http(s)://…`` mounts the
+    S3-shaped backend, anything else is a shared directory — and either
+    way the store is wrapped in a StoreGuard configured from the
+    ``KAFKA_TPU_KV_OBJECT_*`` env knobs, so a dead or slow backend costs
+    warm-resume TTFT, never liveness."""
+    inner: ObjectStore
+    if spec.startswith(("http://", "https://")):
+        inner = HTTPObjectStore(spec)
+    else:
+        inner = LocalFSObjectStore(spec)
+    return StoreGuard.from_env(inner)
+
+
 # ---------------------------------------------------------------------------
 # run payload serialization: the disk tier's wire format, verbatim
 # (kv_tier.encode_run_npz/decode_run_npz — ONE format, no drift)
@@ -251,6 +479,12 @@ class ObjectTier:
     def __init__(self, store: ObjectStore, budget_bytes: int = 0,
                  fingerprint: str = "", page_size: int = 16):
         self.store = store
+        # The engine mounts a StoreGuard (build_object_store); bare
+        # stores (unit tests, fsck) get no breaker and available() is
+        # always True.  Never auto-wrap here — tests poke store internals.
+        self.guard: Optional[StoreGuard] = (
+            store if isinstance(store, StoreGuard) else None
+        )
         # 0 = unbounded.  The budget bounds the bytes THIS OWNER holds
         # references on — a shared store is only ever shrunk through the
         # refcount protocol, never by one owner deleting another's state.
@@ -285,6 +519,11 @@ class ObjectTier:
         self.wake_tokens = 0
         self.manifests_written = 0
         self.objects_released = 0
+        self.probe_neg_cached = 0
+        self.scrub_repairs = 0
+        # opt-in background janitor (start_janitor)
+        self._janitor: Optional[threading.Thread] = None
+        self._janitor_stop = threading.Event()
 
     # -- plumbing --------------------------------------------------------
 
@@ -292,6 +531,37 @@ class ObjectTier:
         if self.manager is not None:
             return self.manager.trace_ctx
         return self.trace_ctx
+
+    # -- fault containment ----------------------------------------------
+
+    def available(self) -> bool:
+        """False while the guard's breaker is OPEN: consumers use this to
+        degrade cheaply (plain eviction, re-prefill, zero-RTT routing)
+        instead of paying a doomed store op — and, on the archive path,
+        instead of paying the D2H gather + encode for a put that cannot
+        land.  Half-open counts as available: the single probe is how
+        the breaker discovers recovery."""
+        return self.guard is None or self.guard.breaker.state != BREAKER_OPEN
+
+    def breaker_state(self) -> str:
+        return self.guard.breaker.state if self.guard is not None else "closed"
+
+    def _note_store_failure(self, e: BaseException) -> None:
+        """Forward a tier-level store failure to the guard's breaker.
+        Guard-typed exceptions were already recorded inside the guard
+        (counting them twice would double the trip rate); everything
+        else — including injected ``kv.object_*`` failpoint faults, which
+        fire BEFORE the guard — is fresh evidence the store is sick."""
+        if self.guard is not None and not isinstance(e, StoreGuardError):
+            self.guard.breaker.record_failure()
+
+    def _probe_failure_ttl(self) -> float:
+        """How long a FAILED manifest head probe is negatively cached:
+        the breaker's open window when guarded (the store is presumed
+        down for exactly that long), else the ordinary head TTL."""
+        if self.guard is not None:
+            return max(_HEAD_TTL_S, self.guard.breaker.open_window_s)
+        return _HEAD_TTL_S
 
     # -- content addressing ----------------------------------------------
 
@@ -339,7 +609,12 @@ class ObjectTier:
     # -- runs ------------------------------------------------------------
 
     def has_run(self, key: str) -> bool:
-        return self.store.head(self._object_key(key)) is not None
+        try:
+            failpoint("kv.object_head")
+            return self.store.head(self._object_key(key)) is not None
+        except Exception as e:
+            self._note_store_failure(e)
+            return False  # absent-shaped: wake truncates, routing skips
 
     def _own(self, key: str, nbytes: int) -> None:
         with self._lock:
@@ -351,8 +626,11 @@ class ObjectTier:
             self._ref_bits[key] = False
             self.owned_bytes += nbytes
         try:
-            self.store.put(self._ref_key(key), b"")
-        except OSError as e:  # pragma: no cover - fs flake
+            self.store.put_if_absent(self._ref_key(key), b"")
+        except Exception as e:
+            # the local reference stands; the missing store-side marker
+            # is a crash-window orphan the scrubber (fsck) repairs
+            self._note_store_failure(e)
             logger.warning("object ref marker for %s failed: %s", key, e)
 
     def put_run(
@@ -390,6 +668,7 @@ class ObjectTier:
             self.store.put(okey, data)
         except Exception as e:
             self.object_put_failures += 1
+            self._note_store_failure(e)
             logger.warning("object put of %d-page run failed: %s",
                            n_pages, e)
             return None
@@ -415,6 +694,7 @@ class ObjectTier:
             data = self.store.get(self._object_key(key))
         except Exception as e:
             self.object_get_failures += 1
+            self._note_store_failure(e)
             logger.warning("object get of run %s failed: %s", key, e)
             return None
         if data is None:
@@ -447,9 +727,18 @@ class ObjectTier:
             self._ref_bits.pop(key, None)
             if nbytes is not None:
                 self.owned_bytes -= nbytes
-        self.store.delete(self._ref_key(key))
-        if not self.store.list(f"refs/{key}/"):
-            self.store.delete(self._object_key(key))
+        try:
+            failpoint("kv.object_list")
+            self.store.delete(self._ref_key(key))
+            if not self.store.list(f"refs/{key}/"):
+                self.store.delete(self._object_key(key))
+        except Exception as e:
+            # the local reference is gone either way; a marker (or a
+            # now-refless object) left behind on a dead store is a
+            # crash-window orphan the scrubber repairs after the grace
+            # window — never a correctness problem, only garbage
+            self._note_store_failure(e)
+            logger.warning("object release of %s failed: %s", key, e)
         self.objects_released += 1
 
     def _enforce_budget(self) -> None:
@@ -518,6 +807,7 @@ class ObjectTier:
             self.store.put(skey, json.dumps(doc).encode())
         except Exception as e:
             self.object_put_failures += 1
+            self._note_store_failure(e)
             logger.warning("sleep manifest for %r failed: %s",
                            thread_key, e)
             return False
@@ -536,11 +826,34 @@ class ObjectTier:
         now = time.monotonic()
         with self._lock:
             hit = self._manifest_cache.get(thread_key)
-            if hit is not None and now - hit[3] < _HEAD_TTL_S:
-                self._manifest_cache.move_to_end(thread_key)
-                return hit[1]
+            if hit is not None:
+                ttl = (self._probe_failure_ttl()
+                       if hit[0] is _PROBE_FAILED else _HEAD_TTL_S)
+                if now - hit[3] < ttl:
+                    self._manifest_cache.move_to_end(thread_key)
+                    if hit[0] is _PROBE_FAILED:
+                        # counted miss: the submit path pays zero store
+                        # RTT for the rest of the breaker's open window
+                        self.probe_neg_cached += 1
+                        return None
+                    return hit[1]
         skey = self._manifest_store_key(thread_key)
-        sig = self.store.head(skey)
+        try:
+            failpoint("kv.object_head")
+            sig = self.store.head(skey)
+        except Exception as e:
+            # cache the FAILURE too: pre-guard, an outage re-probed (and
+            # could stall) on every keyed submit; now the first failure
+            # eats the RTT and every probe until the breaker's window
+            # elapses is a local negative hit
+            self._note_store_failure(e)
+            self.probe_neg_cached += 1
+            with self._lock:
+                self._manifest_cache[thread_key] = [_PROBE_FAILED, None, None, now]
+                self._manifest_cache.move_to_end(thread_key)
+                while len(self._manifest_cache) > self._manifest_cache_cap:
+                    self._manifest_cache.popitem(last=False)
+            return None
         with self._lock:
             hit = self._manifest_cache.get(thread_key)
             if hit is not None and hit[0] == sig:
@@ -549,7 +862,11 @@ class ObjectTier:
                 return hit[1]  # noqa: the depth memo rides in hit[2]
         doc: Optional[Dict[str, Any]] = None
         if sig is not None:
-            raw = self.store.get(skey)
+            try:
+                raw = self.store.get(skey)
+            except Exception as e:
+                self._note_store_failure(e)
+                raw = None
             if raw is not None:
                 try:
                     doc = json.loads(raw)
@@ -637,8 +954,11 @@ class ObjectTier:
     def snapshot(self) -> Dict[str, Any]:
         """The /metrics "object_tier" section (OBJECT_TIER_METRIC_KEYS).
         ``store_bytes``/``store_objects`` describe the SHARED store (the
-        DP aggregate reports them once, unsummed); everything else is
-        per-owner and sums."""
+        DP aggregate reports them once, unsummed) and
+        ``store_breaker_state`` is a gauge the aggregate maxes (any open
+        breaker is fleet-visible); everything else is per-owner and
+        sums."""
+        g = self.guard
         try:
             count, total = self.store.usage()
         except Exception:  # pragma: no cover - store flake
@@ -658,4 +978,196 @@ class ObjectTier:
             "wake_tokens": self.wake_tokens,
             "manifests_written": self.manifests_written,
             "objects_released": self.objects_released,
+            # store-guard families: zeros on a bare (unguarded) store
+            "store_retries": g.retries_total if g else 0,
+            "store_timeouts": g.timeouts_total if g else 0,
+            "store_breaker_opens": g.breaker.opens if g else 0,
+            "store_breaker_state": g.breaker.state_gauge() if g else 0,
+            "store_probe_neg_cached": self.probe_neg_cached,
+            "store_scrub_repairs": self.scrub_repairs,
         }
+
+    def scrub(self, grace_s: float = 3600.0, repair: bool = False) -> Dict[str, Any]:
+        """Run the crash-orphan scrubber against this tier's store (the
+        background-janitor entry point; ``scripts/objstore_fsck.py`` is
+        the offline one).  Repairs count into ``store_scrub_repairs``."""
+        report = fsck(self.store, grace_s=grace_s, repair=repair)
+        self.scrub_repairs += report["repaired"]
+        return report
+
+    def start_janitor(self, interval_s: float,
+                      grace_s: float = 3600.0) -> None:
+        """Opt-in background janitor: scrub(repair=True) every
+        ``interval_s`` on a daemon thread (KAFKA_TPU_KV_OBJECT_SCRUB_S;
+        0 = off, the default — most fleets run the offline
+        ``scripts/objstore_fsck.py`` on a schedule instead so exactly
+        one scrubber walks the shared store).  Skips the walk outright
+        while the breaker is open."""
+        if interval_s <= 0 or self._janitor is not None:
+            return
+
+        def _loop() -> None:
+            while not self._janitor_stop.wait(interval_s):
+                if not self.available():
+                    continue
+                try:
+                    self.scrub(grace_s=grace_s, repair=True)
+                except Exception as e:  # never kill the thread
+                    logger.warning("object-store janitor pass failed: %s",
+                                   e)
+
+        self._janitor = threading.Thread(
+            target=_loop, name="objstore-janitor", daemon=True
+        )
+        self._janitor.start()
+
+    def stop_janitor(self) -> None:
+        t = self._janitor
+        if t is not None:
+            self._janitor_stop.set()
+            t.join(timeout=5.0)
+            self._janitor = None
+            self._janitor_stop = threading.Event()
+
+
+# ---------------------------------------------------------------------------
+# crash-orphan scrubber (fsck): refs <-> objects <-> manifests
+# ---------------------------------------------------------------------------
+
+
+def _ref_markers(store: ObjectStore) -> List[str]:
+    """Every ref marker key (``refs/<run-key>/<owner-uid>``), whichever
+    listing shape the backend has: LocalFS lists one level (so ``refs/``
+    yields per-run directories to descend into), S3-shaped prefix
+    listings are recursive (so ``refs/`` yields the markers directly)."""
+    out: List[str] = []
+    for entry in store.list("refs/"):
+        rest = entry[len("refs/"):] if entry.startswith("refs/") else entry
+        if "/" in rest:
+            out.append(entry)
+        else:
+            out.extend(store.list(entry.rstrip("/") + "/"))
+    return out
+
+
+def fsck(
+    store: ObjectStore,
+    grace_s: float = 3600.0,
+    repair: bool = False,
+    now: Optional[float] = None,
+) -> Dict[str, Any]:
+    """Walk refs↔objects↔manifests and report (or repair) the refcount
+    protocol's crash-window orphans:
+
+    * **ref-less object** — put committed but the owner died before its
+      ref marker landed: nothing will ever release it.  Repair: delete
+      the object (per protocol, refcount governs life; a manifest naming
+      it makes the wake truncate there, which is safe).
+    * **dangling ref** — marker for a deleted object (last-ref delete
+      interrupted between the object delete and the marker delete, or a
+      dedupe marker raced a concurrent release).  Repair: delete the
+      marker.
+    * **dead manifest** — manifest whose runs are ALL absent (or that no
+      longer parses): a wake delivers nothing.  Repair: delete it.
+      Manifests with at least one present run are kept — a wake
+      truncates to the surviving prefix, token-exact.
+
+    Anything whose mtime is inside ``grace_s`` is left untouched: the
+    crash windows are milliseconds wide, so a generous grace window
+    cleanly separates "in-flight protocol step" from "orphan".  Dry-run
+    (``repair=False``) only reports.  Store faults during the walk are
+    counted, never raised — fsck on a flaky store degrades to a partial
+    report."""
+    t_now = time.time() if now is None else now
+    report: Dict[str, Any] = {
+        "repair": bool(repair), "grace_s": float(grace_s),
+        "objects": 0, "refs": 0, "manifests": 0,
+        "refless_objects": [], "dangling_refs": [], "dead_manifests": [],
+        "in_grace": 0, "repaired": 0, "errors": 0,
+    }
+
+    def _head_mtime(key: str) -> Optional[float]:
+        try:
+            sig = store.head(key)
+        except Exception:
+            report["errors"] += 1
+            return None
+        return None if sig is None else sig[1]
+
+    def _in_grace(mtime: Optional[float]) -> bool:
+        return mtime is None or (t_now - mtime) < grace_s
+
+    def _repair_delete(key: str) -> None:
+        if not repair:
+            return
+        try:
+            store.delete(key)
+            report["repaired"] += 1
+        except Exception:
+            report["errors"] += 1
+
+    try:
+        failpoint("kv.object_list")
+        object_keys = [k for k in store.list("objects/") if k.endswith(".npz")]
+        markers = _ref_markers(store)
+        manifest_keys = [k for k in store.list("threads/")
+                         if k.endswith(".json")]
+    except Exception as e:
+        logger.warning("fsck list walk failed: %s", e)
+        report["errors"] += 1
+        return report
+    report["objects"] = len(object_keys)
+    report["refs"] = len(markers)
+    report["manifests"] = len(manifest_keys)
+
+    referenced: set = set()
+    for marker in markers:
+        parts = marker.split("/")
+        run_key = parts[1] if len(parts) >= 3 else ""
+        referenced.add(run_key)
+        if f"objects/{run_key}.npz" in object_keys:
+            continue
+        mtime = _head_mtime(marker)
+        if _in_grace(mtime):
+            report["in_grace"] += 1
+            continue
+        report["dangling_refs"].append(marker)
+        _repair_delete(marker)
+
+    for okey in object_keys:
+        run_key = okey[len("objects/"):-len(".npz")]
+        if run_key in referenced:
+            continue
+        mtime = _head_mtime(okey)
+        if _in_grace(mtime):
+            report["in_grace"] += 1
+            continue
+        report["refless_objects"].append(okey)
+        _repair_delete(okey)
+
+    surviving = {
+        f"objects/{k}.npz" for k in referenced
+    } & set(object_keys)
+    for mkey in manifest_keys:
+        try:
+            raw = store.get(mkey)
+            doc = json.loads(raw) if raw is not None else None
+        except Exception:
+            report["errors"] += 1
+            doc = None
+        runs = (doc or {}).get("runs") or []
+        alive = any(
+            f"objects/{r.get('key')}.npz" in surviving
+            or (repair is False
+                and f"objects/{r.get('key')}.npz" in object_keys)
+            for r in runs
+        )
+        if doc is not None and alive:
+            continue
+        mtime = _head_mtime(mkey)
+        if _in_grace(mtime):
+            report["in_grace"] += 1
+            continue
+        report["dead_manifests"].append(mkey)
+        _repair_delete(mkey)
+    return report

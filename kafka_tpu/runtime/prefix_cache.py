@@ -942,7 +942,8 @@ class PrefixCache:
         ps = self.pool.page_size
         stats = {
             "enabled": True, "runs_archived": 0, "runs_failed": 0,
-            "manifests": 0, "threads": 0,
+            "runs_skipped_store_down": 0, "manifests": 0,
+            "manifests_failed": 0, "threads": 0,
         }
         bytes0 = obj.object_bytes_put
         dedupe0 = obj.dedupe_hits
@@ -955,6 +956,15 @@ class PrefixCache:
             for c in node.children.values():
                 stack.append((c, path_runs))
             keys_seen.update(node.keys)
+            if not obj.available():
+                # store breaker open: nothing can land, so skip the D2H
+                # gather + encode outright.  The drain returns a PARTIAL
+                # result with honest per-run accounting — the autoscaler
+                # shrinks anyway (capacity beats warm state) and the
+                # skipped runs re-prefill on wake.
+                stats["runs_failed"] += 1
+                stats["runs_skipped_store_down"] += 1
+                continue
             flat = [t for seg in path_runs for t in seg]
             if obj.has_run(obj.run_key(flat, node.n_pages(ps))):
                 ok = obj.put_run(flat, None, None,
@@ -980,9 +990,12 @@ class PrefixCache:
             tokens = [t for seg in path_runs for t in seg]
             if obj.write_manifest(key, tokens, obj.manifest_runs(path_runs)):
                 stats["manifests"] += 1
+            else:
+                stats["manifests_failed"] += 1
         stats["threads"] = len(keys_seen)
         stats["bytes_put"] = obj.object_bytes_put - bytes0
         stats["dedupe_hits"] = obj.dedupe_hits - dedupe0
+        stats["breaker_state"] = obj.breaker_state()
         return stats
 
     def invalidate(self, key: str) -> None:

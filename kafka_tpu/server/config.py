@@ -69,6 +69,18 @@ class ServingConfig:
     # POST /admin/drain/{replica} flushes a replica's warm state before
     # the autoscaler shrinks it away.  None (default) disables the tier;
     # every dispatch/eviction path is byte-identical to before.
+    # An http(s):// value mounts the S3-shaped HTTPObjectStore instead
+    # of a directory.  Either backend is wrapped in the StoreGuard
+    # resilience layer (README "Object store resilience"), tuned by:
+    #   KAFKA_TPU_KV_OBJECT_TIMEOUT_S          per-op deadline (0 = off)
+    #   KAFKA_TPU_KV_OBJECT_RETRIES            retry budget (default 2)
+    #   KAFKA_TPU_KV_OBJECT_BACKOFF_S          base backoff (default .05)
+    #   KAFKA_TPU_KV_OBJECT_BREAKER_FAILURES   breaker trip (default 5)
+    #   KAFKA_TPU_KV_OBJECT_BREAKER_OPEN_S     open window (default 10)
+    #   KAFKA_TPU_KV_OBJECT_SCRUB_S            in-process janitor cadence
+    #                                          (0 = off; prefer scheduling
+    #                                          scripts/objstore_fsck.py)
+    #   KAFKA_TPU_KV_OBJECT_SCRUB_GRACE_S      janitor grace (default 3600)
     kv_object_dir: Optional[str] = None
     # Byte budget (MiB) on the object-store references each replica holds
     # (second-chance LRU; the last dropped reference deletes the object).

@@ -674,6 +674,49 @@ def render_prometheus(snap: Dict[str, Any]) -> str:
                      "object).")
             w.sample("kafka_tpu_object_tier_released_total",
                      obj["objects_released"])
+        # Store-guard families (ISSUE 17): retry/deadline/breaker/scrub
+        # visibility for the resilience layer around the shared store.
+        if "store_retries" in obj:
+            w.family("kafka_tpu_object_store_retries_total", "counter",
+                     "Store ops retried by the guard (idempotent "
+                     "protocol ops, bounded exponential backoff).")
+            w.sample("kafka_tpu_object_store_retries_total",
+                     obj["store_retries"])
+        if "store_timeouts" in obj:
+            w.family("kafka_tpu_object_store_timeouts_total", "counter",
+                     "Store op attempts that exceeded the per-op "
+                     "deadline (KAFKA_TPU_KV_OBJECT_TIMEOUT_S).")
+            w.sample("kafka_tpu_object_store_timeouts_total",
+                     obj["store_timeouts"])
+        if "store_breaker_opens" in obj:
+            w.family("kafka_tpu_object_store_breaker_open_total",
+                     "counter",
+                     "Circuit-breaker open transitions (consecutive "
+                     "store failures crossed the trip threshold).")
+            w.sample("kafka_tpu_object_store_breaker_open_total",
+                     obj["store_breaker_opens"])
+        if "store_breaker_state" in obj:
+            w.family("kafka_tpu_object_store_breaker_state", "gauge",
+                     "Store circuit-breaker state: 0=closed, "
+                     "1=half-open, 2=open (ops fast-fail).")
+            w.sample("kafka_tpu_object_store_breaker_state",
+                     obj["store_breaker_state"])
+        if "store_probe_neg_cached" in obj:
+            w.family("kafka_tpu_object_store_probe_neg_cached_total",
+                     "counter",
+                     "Manifest probes answered from the negative cache "
+                     "while the store is unhealthy (zero store RTT on "
+                     "the submit path).")
+            w.sample("kafka_tpu_object_store_probe_neg_cached_total",
+                     obj["store_probe_neg_cached"])
+        if "store_scrub_repairs" in obj:
+            w.family("kafka_tpu_object_store_scrub_repairs_total",
+                     "counter",
+                     "Crash-window orphans repaired by the scrubber "
+                     "(ref-less objects, dangling refs, dead "
+                     "manifests).")
+            w.sample("kafka_tpu_object_store_scrub_repairs_total",
+                     obj["store_scrub_repairs"])
 
     # Disaggregated prefill/decode (runtime/metrics.DISAGG_METRIC_KEYS —
     # the registry a static test enforces in both files; present only

@@ -119,6 +119,9 @@ _HEAVY_TAIL = (
     # shapes (sleep on A / wake on B) — keep it with the tier tests on
     # the warm-cache side of test_engine
     "test_object_tier.py",
+    # store-guard fsck/outage acceptance builds the same engine shapes
+    # (drain on A, scrub, wake on B) plus the bench store_outage smoke
+    "test_store_guard.py",
     # flight-recorder integration shares the tiny-model shapes too and
     # arms wall-clock-sensitive delay failpoints — keep it off the cold
     # compile path like test_kv_tier

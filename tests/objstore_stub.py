@@ -1,0 +1,189 @@
+"""In-process S3-shaped stub HTTP server for object-store tests.
+
+Implements exactly the surface HTTPObjectStore speaks — PUT/GET/HEAD/
+DELETE on /<key> plus ``GET /?list-type=2&prefix=`` XML listings and
+``If-None-Match: *`` conditional writes — over a dict, with injectable
+faults so tier-1 exercises the network failure modes without a network:
+
+  * ``fail_requests = N``  — the next N requests answer 500;
+  * ``torn_next = N``      — the next N GETs declare the full
+    Content-Length but send only half the body and drop the connection
+    (a genuinely torn response);
+  * ``latency_s = x``      — every request sleeps first (slow store).
+
+Usage::
+
+    with StubS3Server() as srv:
+        store = HTTPObjectStore(srv.url)
+        ...
+"""
+
+import threading
+import time
+from email.utils import formatdate
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, unquote, urlsplit
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *args):  # silence test output
+        pass
+
+    # -- fault injection ----------------------------------------------
+
+    def _faulted(self) -> bool:
+        srv = self.server
+        with srv.lock:
+            if srv.latency_s:
+                time.sleep(srv.latency_s)
+            if srv.fail_requests > 0:
+                srv.fail_requests -= 1
+                self.send_response(500)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return True
+        return False
+
+    def _key(self) -> str:
+        return unquote(urlsplit(self.path).path.lstrip("/"))
+
+    # -- verbs ---------------------------------------------------------
+
+    def do_PUT(self):
+        # drain the body BEFORE any fault reply: an unread body would be
+        # parsed as the next request line on this keep-alive connection
+        key = self._key()
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length)
+        if self._faulted():
+            return
+        srv = self.server
+        with srv.lock:
+            if (self.headers.get("If-None-Match") == "*"
+                    and key in srv.objects):
+                self.send_response(412)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return
+            srv.objects[key] = (body, time.time())
+        self.send_response(200)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def do_GET(self):
+        if self._faulted():
+            return
+        parts = urlsplit(self.path)
+        query = parse_qs(parts.query)
+        if "list-type" in query:
+            prefix = (query.get("prefix") or [""])[0]
+            srv = self.server
+            with srv.lock:
+                entries = sorted(
+                    (k, len(v[0])) for k, v in srv.objects.items()
+                    if k.startswith(prefix)
+                )
+            rows = "".join(
+                f"<Contents><Key>{k}</Key><Size>{s}</Size></Contents>"
+                for k, s in entries
+            )
+            body = (
+                "<?xml version='1.0'?><ListBucketResult>"
+                f"{rows}</ListBucketResult>"
+            ).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/xml")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        key = self._key()
+        srv = self.server
+        with srv.lock:
+            hit = srv.objects.get(key)
+            torn = srv.torn_next > 0 and hit is not None
+            if torn:
+                srv.torn_next -= 1
+        if hit is None:
+            self._not_found()
+            return
+        body, mtime = hit
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.send_header("Last-Modified", formatdate(mtime, usegmt=True))
+        self.end_headers()
+        if torn:
+            # declare everything, deliver half, kill the connection:
+            # the client must discard, count, and never decode this
+            self.wfile.write(body[: max(0, len(body) // 2)])
+            self.wfile.flush()
+            self.close_connection = True
+            try:
+                self.connection.close()
+            except OSError:
+                pass
+            return
+        self.wfile.write(body)
+
+    def do_HEAD(self):
+        if self._faulted():
+            return
+        key = self._key()
+        with self.server.lock:
+            hit = self.server.objects.get(key)
+        if hit is None:
+            self._not_found(head=True)
+            return
+        body, mtime = hit
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.send_header("Last-Modified", formatdate(mtime, usegmt=True))
+        self.end_headers()
+
+    def do_DELETE(self):
+        if self._faulted():
+            return
+        key = self._key()
+        with self.server.lock:
+            self.server.objects.pop(key, None)
+        self.send_response(204)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def _not_found(self, head: bool = False):
+        self.send_response(404)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+
+class StubS3Server(ThreadingHTTPServer):
+    daemon_threads = True
+
+    def __init__(self):
+        super().__init__(("127.0.0.1", 0), _Handler)
+        self.objects = {}  # key -> (bytes, mtime_epoch)
+        self.lock = threading.RLock()
+        self.fail_requests = 0
+        self.torn_next = 0
+        self.latency_s = 0.0
+        self._thread = threading.Thread(target=self.serve_forever,
+                                        daemon=True)
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.server_address[1]}"
+
+    def set_mtime(self, key: str, mtime: float) -> None:
+        with self.lock:
+            body, _ = self.objects[key]
+            self.objects[key] = (body, mtime)
+
+    def __enter__(self) -> "StubS3Server":
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()
+        self.server_close()

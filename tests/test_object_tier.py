@@ -841,12 +841,15 @@ class TestDrainEndpoint:
                 stats2 = await r.json()
                 assert stats2["dedupe_hits"] >= stats2["runs_archived"] - \
                     stats2["runs_failed"] - 1 or stats2["dedupe_hits"] >= 1
-                # signals v5 carries the object_tier section
+                # signals v6 carries the object_tier section +
+                # store health (ISSUE 17)
                 s = await client.get("/admin/signals", headers=hdr)
                 sig = await s.json()
-                assert sig["version"] == 5
+                assert sig["version"] == 6
                 assert sig["object_tier"]["store_objects"] >= 1
                 assert "dedupe_ratio" in sig["object_tier"]
+                assert sig["object_tier"]["breaker_state"] == "closed"
+                assert sig["object_tier"]["store_available"] is True
             finally:
                 await client.close()
 
