@@ -912,13 +912,19 @@ class InferenceEngine:
                     page_size=ps,
                 )
                 # opt-in in-process janitor (default off: one offline
-                # objstore_fsck.py per store beats N replicas scrubbing)
+                # objstore_fsck.py per store beats N replicas scrubbing).
+                # Malformed knobs fall back to the defaults, same as the
+                # KAFKA_TPU_KV_OBJECT_* guard knobs (StoreGuard.from_env).
+                def _env_f(name: str, default: float) -> float:
+                    try:
+                        return float(os.environ.get(name, default) or default)
+                    except (TypeError, ValueError):
+                        return default
+
                 obj_tier.start_janitor(
-                    float(os.environ.get(
-                        "KAFKA_TPU_KV_OBJECT_SCRUB_S", "0") or 0),
-                    grace_s=float(os.environ.get(
-                        "KAFKA_TPU_KV_OBJECT_SCRUB_GRACE_S",
-                        "3600") or 3600),
+                    _env_f("KAFKA_TPU_KV_OBJECT_SCRUB_S", 0.0),
+                    grace_s=_env_f("KAFKA_TPU_KV_OBJECT_SCRUB_GRACE_S",
+                                   3600.0),
                 )
                 self.kv_tier.attach_object(obj_tier)
         if self.ecfg.flight_ring < 0:

@@ -396,18 +396,38 @@ class HTTPObjectStore(ObjectStore):
             raise OSError(f"DELETE {key}: HTTP {status}")
 
     def _list_entries(self, prefix: str) -> List[Tuple[str, int]]:
-        path = f"{self._base or '/'}?list-type=2&prefix={quote(prefix)}"
-        status, _, data = self._request("GET", path)
-        if status != 200:
-            raise OSError(f"LIST {prefix}: HTTP {status}")
-        text = data.decode("utf-8", "replace")
+        # Real S3 truncates ListObjectsV2 at 1000 keys per page; a
+        # partial view here would make fsck see live objects as orphans
+        # (and usage() undercount), so follow the continuation chain
+        # until <IsTruncated> goes false — and refuse to return a
+        # listing the backend admits is incomplete.
         out: List[Tuple[str, int]] = []
-        for m in re.finditer(
-            r"<Contents>.*?<Key>([^<]*)</Key>(?:.*?<Size>(\d+)</Size>)?.*?</Contents>",
-            text, re.S,
-        ):
-            out.append((m.group(1), int(m.group(2) or 0)))
-        return out
+        token: Optional[str] = None
+        while True:
+            path = f"{self._base or '/'}?list-type=2&prefix={quote(prefix)}"
+            if token is not None:
+                path += f"&continuation-token={quote(token, safe='')}"
+            status, _, data = self._request("GET", path)
+            if status != 200:
+                raise OSError(f"LIST {prefix}: HTTP {status}")
+            text = data.decode("utf-8", "replace")
+            for m in re.finditer(
+                r"<Contents>.*?<Key>([^<]*)</Key>(?:.*?<Size>(\d+)</Size>)?.*?</Contents>",
+                text, re.S,
+            ):
+                out.append((m.group(1), int(m.group(2) or 0)))
+            if not re.search(r"<IsTruncated>\s*true\s*</IsTruncated>", text):
+                return out
+            nxt = re.search(
+                r"<NextContinuationToken>([^<]+)</NextContinuationToken>",
+                text,
+            )
+            if nxt is None or nxt.group(1) == token:
+                raise OSError(
+                    f"LIST {prefix}: truncated listing without a fresh "
+                    "continuation token — refusing to act on a partial view"
+                )
+            token = nxt.group(1)
 
     def list(self, prefix: str) -> List[str]:
         # S3 has no directories: a prefix listing is recursive, which is
@@ -556,10 +576,13 @@ class ObjectTier:
             self.guard.breaker.record_failure()
 
     def _probe_failure_ttl(self) -> float:
-        """How long a FAILED manifest head probe is negatively cached:
-        the breaker's open window when guarded (the store is presumed
-        down for exactly that long), else the ordinary head TTL."""
-        if self.guard is not None:
+        """How long a FAILED manifest head probe is negatively cached.
+        Evaluated at READ time against the breaker's CURRENT state: while
+        the breaker is actually OPEN the store is presumed down for the
+        whole open window, so the negative hit answers for that long; an
+        isolated blip with a closed (or recovered) breaker only hides
+        warm state for the ordinary head TTL."""
+        if self.guard is not None and self.guard.breaker.state == BREAKER_OPEN:
             return max(_HEAD_TTL_S, self.guard.breaker.open_window_s)
         return _HEAD_TTL_S
 
@@ -1145,9 +1168,13 @@ def fsck(
         report["refless_objects"].append(okey)
         _repair_delete(okey)
 
-    surviving = {
-        f"objects/{k}.npz" for k in referenced
-    } & set(object_keys)
+    # Aliveness must be the SAME predicate in both modes so a dry-run
+    # reports exactly what --repair would delete: a run is alive iff its
+    # object survives the (actual or hypothetical) repair above — i.e.
+    # it is present and was not condemned as refless-outside-grace.
+    # Present-but-refless objects still inside the grace window were
+    # kept, so they keep their manifests alive in repair mode too.
+    surviving = set(object_keys) - set(report["refless_objects"])
     for mkey in manifest_keys:
         try:
             raw = store.get(mkey)
@@ -1157,10 +1184,7 @@ def fsck(
             doc = None
         runs = (doc or {}).get("runs") or []
         alive = any(
-            f"objects/{r.get('key')}.npz" in surviving
-            or (repair is False
-                and f"objects/{r.get('key')}.npz" in object_keys)
-            for r in runs
+            f"objects/{r.get('key')}.npz" in surviving for r in runs
         )
         if doc is not None and alive:
             continue

@@ -168,9 +168,14 @@ class StoreGuard:
         self.breaker = breaker if breaker is not None else CircuitBreaker()
         self.retries_total = 0
         self.timeouts_total = 0
+        self.pool_replacements = 0
         self._rng = random.Random(0xC0FFEE)
         self._executor: Optional[concurrent.futures.ThreadPoolExecutor] = None
         self._executor_lock = threading.Lock()
+        self._pool_workers = 4
+        # timed-out futures whose backend thread never returned: each one
+        # pins a worker until (if ever) the backend call unblocks
+        self._abandoned: List[concurrent.futures.Future] = []
         # op -> [calls, errors, total_latency_s]; single small dict, torn
         # reads under concurrency only skew the report, never correctness.
         self.op_stats: Dict[str, List[float]] = {}
@@ -202,9 +207,25 @@ class StoreGuard:
         if self.timeout_s <= 0.0:
             return fn(*args)
         with self._executor_lock:
+            # Abandoned calls pin workers until (if ever) the backend
+            # unblocks — e.g. LocalFS on a hard NFS mount has no socket
+            # timeout.  If every worker is pinned, new submissions would
+            # queue behind them and time out without ever reaching the
+            # backend — including the breaker's half-open probe, so the
+            # breaker could never close after recovery.  Swap in a fresh
+            # pool instead; the old one keeps its stuck threads and is
+            # dropped without joining them.
+            self._abandoned = [f for f in self._abandoned if not f.done()]
+            if (self._executor is not None
+                    and len(self._abandoned) >= self._pool_workers):
+                self._executor.shutdown(wait=False)
+                self._executor = None
+                self._abandoned = []
+                self.pool_replacements += 1
             if self._executor is None:
                 self._executor = concurrent.futures.ThreadPoolExecutor(
-                    max_workers=4, thread_name_prefix="store-guard"
+                    max_workers=self._pool_workers,
+                    thread_name_prefix="store-guard",
                 )
             ex = self._executor
         fut = ex.submit(fn, *args)
@@ -212,6 +233,9 @@ class StoreGuard:
             return fut.result(timeout=self.timeout_s)
         except concurrent.futures.TimeoutError:
             fut.cancel()  # best effort; a stuck backend thread is abandoned
+            with self._executor_lock:
+                if not fut.done() and ex is self._executor:
+                    self._abandoned.append(fut)
             raise StoreTimeoutError(
                 f"object store op exceeded {self.timeout_s:.3f}s deadline"
             )
@@ -280,9 +304,14 @@ class StoreGuard:
 
     def snapshot(self) -> Dict[str, Any]:
         """Guard counters for ObjectTier.snapshot() / debugging."""
+        with self._executor_lock:
+            self._abandoned = [f for f in self._abandoned if not f.done()]
+            stuck = len(self._abandoned)
         return {
             "retries": self.retries_total,
             "timeouts": self.timeouts_total,
+            "stuck_ops": stuck,
+            "pool_replacements": self.pool_replacements,
             "breaker_state": self.breaker.state_gauge(),
             "breaker_opens": self.breaker.opens,
             "consecutive_failures": self.breaker.consecutive_failures,

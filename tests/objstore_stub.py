@@ -79,19 +79,33 @@ class _Handler(BaseHTTPRequestHandler):
         query = parse_qs(parts.query)
         if "list-type" in query:
             prefix = (query.get("prefix") or [""])[0]
+            token = (query.get("continuation-token") or [""])[0]
             srv = self.server
             with srv.lock:
                 entries = sorted(
                     (k, len(v[0])) for k, v in srv.objects.items()
                     if k.startswith(prefix)
                 )
+                max_keys = srv.max_keys
+            # S3-shaped pagination: pages of max_keys in key order; the
+            # (opaque-to-clients) continuation token is the last key of
+            # the previous page
+            if token:
+                entries = [e for e in entries if e[0] > token]
+            page, truncated = entries[:max_keys], len(entries) > max_keys
             rows = "".join(
                 f"<Contents><Key>{k}</Key><Size>{s}</Size></Contents>"
-                for k, s in entries
+                for k, s in page
+            )
+            tail = (
+                "<IsTruncated>true</IsTruncated>"
+                f"<NextContinuationToken>{page[-1][0]}"
+                "</NextContinuationToken>"
+                if truncated else "<IsTruncated>false</IsTruncated>"
             )
             body = (
                 "<?xml version='1.0'?><ListBucketResult>"
-                f"{rows}</ListBucketResult>"
+                f"{rows}{tail}</ListBucketResult>"
             ).encode()
             self.send_response(200)
             self.send_header("Content-Type", "application/xml")
@@ -168,6 +182,7 @@ class StubS3Server(ThreadingHTTPServer):
         self.fail_requests = 0
         self.torn_next = 0
         self.latency_s = 0.0
+        self.max_keys = 1000  # S3's ListObjectsV2 page size; tests shrink it
         self._thread = threading.Thread(target=self.serve_forever,
                                         daemon=True)
 

@@ -31,6 +31,7 @@ import json
 import os
 import subprocess
 import sys
+import threading
 import time
 
 import numpy as np
@@ -49,6 +50,7 @@ from kafka_tpu.runtime import (
 from kafka_tpu.runtime import failpoints as fp
 from kafka_tpu.runtime.kv_tier import KVTierManager, LocalPageShipper
 from kafka_tpu.runtime.object_tier import (
+    _HEAD_TTL_S,
     HTTPObjectStore,
     LocalFSObjectStore,
     ObjectTier,
@@ -268,6 +270,40 @@ class TestGuardRetryDeadline:
         assert g.breaker.state == BREAKER_CLOSED
         assert g.snapshot()["breaker_opens"] == 1
 
+    def test_stuck_workers_replaced_pool_recovers(self):
+        # Four abandoned (hung-forever) backend calls used to pin every
+        # deadline worker permanently: later ops — including the
+        # breaker's half-open probe — queued behind them and timed out
+        # without ever reaching the backend, so the breaker could never
+        # close even after the store recovered.
+        release = threading.Event()
+        st = _FlakyStore()
+        st.data["k"] = b"v"
+        real_get = st.get
+        hang_next = [4]
+
+        def hung_get(key):
+            if hang_next[0] > 0:
+                hang_next[0] -= 1
+                release.wait()
+            return real_get(key)
+
+        st.get = hung_get
+        g = StoreGuard(st, timeout_s=0.05, retries=0,
+                       breaker=CircuitBreaker(failure_threshold=100))
+        try:
+            for _ in range(4):
+                with pytest.raises(StoreTimeoutError):
+                    g.get("k")
+            assert g.snapshot()["stuck_ops"] == 4
+            # every worker is pinned: the next op must still reach the
+            # (now healthy) backend instead of queueing behind them
+            assert g.get("k") == b"v"
+            assert g.pool_replacements == 1
+            assert g.snapshot()["stuck_ops"] == 0
+        finally:
+            release.set()  # unstick the abandoned threads for clean exit
+
     def test_from_env_reads_knobs(self):
         env = {
             "KAFKA_TPU_KV_OBJECT_TIMEOUT_S": "1.5",
@@ -333,8 +369,9 @@ class TestTierBreakerIntegration:
         assert snap["store_breaker_state"] == 0
 
     def test_probe_failure_neg_cached_as_counted_miss(self, tmp_path):
-        # failure TTL = max(_HEAD_TTL_S, open_window_s), so the window
-        # must dominate the 0.5s head TTL for the timing below
+        # the breaker stays CLOSED here (threshold 5, one failure), so
+        # the failure TTL is the ordinary 0.5s head TTL — the sleep
+        # below must outlast it
         obj, guard = _guarded_tier(tmp_path, threshold=5, window=0.6)
         toks = list(range(8))
         assert obj.write_manifest("t", toks, obj.manifest_runs([toks]))
@@ -349,6 +386,32 @@ class TestTierBreakerIntegration:
         assert obj.probe_neg_cached == 2
         # window over: the probe re-runs and the manifest is back
         time.sleep(0.65)
+        man = obj.read_manifest("t")
+        assert man is not None and man["tokens"] == toks
+
+    def test_probe_failure_ttl_tracks_breaker_state(self, tmp_path):
+        # the open window applies only while the breaker is actually
+        # OPEN; an isolated blip with a closed breaker gets the ordinary
+        # head TTL (and a recovery mid-window shrinks the TTL back)
+        obj, guard = _guarded_tier(tmp_path, threshold=1, window=60.0)
+        assert obj._probe_failure_ttl() == _HEAD_TTL_S
+        guard.breaker.record_failure()  # trips OPEN at threshold 1
+        assert obj._probe_failure_ttl() == 60.0
+        guard.breaker.state = BREAKER_CLOSED  # store recovered
+        assert obj._probe_failure_ttl() == _HEAD_TTL_S
+
+    def test_closed_breaker_blip_expires_at_head_ttl(self, tmp_path):
+        # a single transient head failure with a CLOSED breaker must not
+        # hide the thread's warm state for the breaker's whole open
+        # window (60s here) — only for the ordinary head TTL
+        obj, guard = _guarded_tier(tmp_path, threshold=5, window=60.0)
+        toks = list(range(8))
+        assert obj.write_manifest("t", toks, obj.manifest_runs([toks]))
+        obj._manifest_cache.clear()
+        with fp.armed("kv.object_head", "error", count=1):
+            assert obj.read_manifest("t") is None
+        assert guard.breaker.state == BREAKER_CLOSED
+        time.sleep(_HEAD_TTL_S + 0.1)
         man = obj.read_manifest("t")
         assert man is not None and man["tokens"] == toks
 
@@ -527,6 +590,40 @@ class TestHTTPDifferential:
             assert sorted(st.list("refs/k/")) == ["refs/k/u1",
                                                   "refs/k/u2"]
 
+    def test_truncated_listing_followed_to_completion(self):
+        # real S3 truncates ListObjectsV2 at 1000 keys; the client must
+        # follow the continuation chain, not act on the first page
+        with StubS3Server() as srv:
+            srv.max_keys = 2
+            st = HTTPObjectStore(srv.url)
+            keys = [f"objects/{i:02d}.npz" for i in range(5)]
+            for i, k in enumerate(keys):
+                st.put(k, b"x" * (i + 1))
+            assert sorted(st.list("objects/")) == keys
+            count, nbytes = st.usage()
+            assert count == 5 and nbytes == 1 + 2 + 3 + 4 + 5
+
+    def test_fsck_sees_whole_store_through_paginated_listing(self):
+        # the disaster a partial listing invites: live objects whose ref
+        # markers fall outside the first page look like orphans and
+        # --repair would delete shared-store state that is in use
+        with StubS3Server() as srv:
+            srv.max_keys = 2
+            st = HTTPObjectStore(srv.url)
+            for i in range(4):
+                st.put(f"objects/live{i}.npz", b"x")
+                st.put(f"refs/live{i}/u1", b"")
+            old = time.time() - 7200
+            for key in list(srv.objects):
+                srv.set_mtime(key, old)
+            report = fsck(st, grace_s=3600.0, repair=True)
+            assert report["objects"] == 4 and report["refs"] == 4
+            assert report["repaired"] == 0
+            assert not report["refless_objects"]
+            assert not report["dangling_refs"]
+            for i in range(4):
+                assert st.head(f"objects/live{i}.npz") is not None
+
     def test_fsck_walks_s3_shaped_flat_listing(self):
         with StubS3Server() as srv:
             st = HTTPObjectStore(srv.url)
@@ -644,6 +741,42 @@ class TestFsck:
         assert report["dead_manifests"] == ["threads/bad.ffffffff.json"]
         assert not os.path.exists(man)
 
+    def test_dry_run_predicts_repair_manifest_deletions(self, tmp_path):
+        """Same aliveness predicate in both modes: a manifest whose only
+        object is refless-but-in-grace (kept by the grace window)
+        survives --repair exactly as dry-run reports, while one whose
+        only object is refless-and-aged is reported dead by BOTH modes —
+        dry-run must never understate what --repair will delete."""
+        store = LocalFSObjectStore(str(tmp_path))
+
+        def plant(run_key, aged_obj):
+            okey = os.path.join(str(tmp_path), "objects", run_key + ".npz")
+            os.makedirs(os.path.dirname(okey), exist_ok=True)
+            with open(okey, "wb") as f:
+                f.write(b"payload")
+            if aged_obj:
+                _age(okey)
+            man = os.path.join(str(tmp_path), "threads",
+                               f"{run_key[:5]}.json")
+            os.makedirs(os.path.dirname(man), exist_ok=True)
+            with open(man, "w") as f:
+                json.dump({"version": 1, "thread": run_key[:5],
+                           "tokens": [1],
+                           "runs": [{"key": run_key, "tokens": 1}]}, f)
+            _age(man)  # the manifest is old: only aliveness can save it
+            return okey, man
+
+        fresh_obj, fresh_man = plant("aa" * 32, aged_obj=False)
+        aged_obj, aged_man = plant("bb" * 32, aged_obj=True)
+        dry = fsck(store, grace_s=3600.0, repair=False)
+        rep = fsck(store, grace_s=3600.0, repair=True)
+        assert dry["refless_objects"] == rep["refless_objects"]
+        assert dry["dead_manifests"] == rep["dead_manifests"]
+        assert dry["dead_manifests"] == ["threads/bbbbb.json"]
+        assert os.path.exists(fresh_obj) and os.path.exists(fresh_man)
+        assert not os.path.exists(aged_obj)
+        assert not os.path.exists(aged_man)
+
     def test_surviving_threads_wake_token_exact_after_repair(
         self, model, tmp_path
     ):
@@ -714,6 +847,17 @@ class TestJanitor:
                          fingerprint="f", page_size=4)
         obj.start_janitor(0.0)
         assert obj._janitor is None
+
+    def test_malformed_scrub_env_tolerated(self, model, tmp_path,
+                                           monkeypatch):
+        # engine construction must fall back to defaults (janitor off)
+        # on bad knobs, like the KAFKA_TPU_KV_OBJECT_* guard knobs do
+        monkeypatch.setenv("KAFKA_TPU_KV_OBJECT_SCRUB_S", "not-a-number")
+        monkeypatch.setenv("KAFKA_TPU_KV_OBJECT_SCRUB_GRACE_S", "")
+        cfg, params = model
+        eng = make_engine(cfg, params, obj_dir=tmp_path)  # must not raise
+        obj = eng.kv_tier.object
+        assert obj is not None and obj._janitor is None
 
     def test_janitor_skips_while_breaker_open(self, tmp_path):
         obj, guard = _guarded_tier(tmp_path, threshold=1, window=60.0)
