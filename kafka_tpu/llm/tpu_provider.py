@@ -318,6 +318,20 @@ class TPULLMProvider(LLMProvider):
           the pre-scale-in drain will be SKIPPED: shrink decisions
           should assume dormant threads re-prefill), and the
           retry/timeout/error/negative-probe counters behind it.
+        * ``compiles`` (version 7, ISSUE 18): the compile observatory's
+          ring summary — compiles_total, seconds, cache hit/miss/off
+          split, current phase, and ``storm_active``: True means XLA is
+          recompiling under live traffic (a shape regression or cache
+          wipe) and EVERY resize must hold — latency numbers during a
+          storm measure the compiler, not capacity.  Null when
+          KAFKA_TPU_COMPILE_RING=0.
+        * ``memory`` (version 7, ISSUE 18): measured HBM against the
+          startup MemoryPlan — worst-case ``headroom_bytes`` (min over
+          replicas), ``plan_skew`` (measured bytes_in_use / planned
+          total; > 1 = the plan under-charges, so size scale-ups from
+          the device numbers, not the plan), ``pressure`` (headroom
+          under the watermark — the degradation ladder's shed input),
+          plus the per-replica rows.  Null before the first poll.
 
         Everything is read torn-tolerantly from the engine thread's
         single-writer metrics; no locks, safe at scrape frequency.
@@ -451,8 +465,51 @@ class TPULLMProvider(LLMProvider):
                                  + obj.get("object_get_failures", 0)),
                 "probe_neg_cached": obj.get("store_probe_neg_cached", 0),
             }
+        # Device-truth sections (version 7, ISSUE 18).  compiles: the
+        # process-wide observatory ring summary — storm_active is the
+        # "XLA is recompiling under live traffic" veto input (null when
+        # KAFKA_TPU_COMPILE_RING=0).  memory: measured HBM per replica
+        # plus the worst-case aggregate — a controller sizes scale-up
+        # against MEASURED headroom (min across replicas) and treats
+        # plan_skew > 1 as "the plan under-charges, trust the device".
+        from ..runtime import compile_log
+
+        obs = compile_log.get()
+        compiles_section = (
+            obs.signals_section() if obs is not None else None
+        )
+        mem_reps: List[Dict[str, Any]] = []
+        for i, e in enumerate(replicas):
+            mm = getattr(e, "memory_monitor", None)
+            sec = mm.section() if mm is not None else None
+            if not sec or sec.get("source") == "none":
+                continue
+            mem_reps.append({
+                "replica": i,
+                "source": sec["source"],
+                "hbm_bytes_in_use": sec["hbm_bytes_in_use"],
+                "hbm_bytes_limit": sec["hbm_bytes_limit"],
+                "hbm_headroom_bytes": sec["hbm_headroom_bytes"],
+                "hbm_plan_skew": sec["hbm_plan_skew"],
+                "hbm_pressure": sec["hbm_pressure"],
+            })
+        memory_section = None
+        if mem_reps:
+            memory_section = {
+                "headroom_bytes": min(
+                    r["hbm_headroom_bytes"] for r in mem_reps
+                ),
+                "plan_skew": max(r["hbm_plan_skew"] for r in mem_reps),
+                "pressure": max(r["hbm_pressure"] for r in mem_reps),
+                "replicas": mem_reps,
+            }
         return {
-            # version 6 (ISSUE 17): object_tier section gains store
+            # version 7 (ISSUE 18): device-truth sections — compiles
+            # (observatory ring summary + storm_active, null when
+            # KAFKA_TPU_COMPILE_RING=0) and memory (measured HBM
+            # headroom/plan_skew/pressure, per replica + worst-case
+            # aggregate, null before the first poll or without a
+            # monitor).  version 6 (ISSUE 17): object_tier section gains store
             # health — breaker_state/breaker_opens/store_available plus
             # retry/timeout/error and negative-probe counters (the
             # StoreGuard resilience layer).  Version 5 (ISSUE 14) added
@@ -467,10 +524,12 @@ class TPULLMProvider(LLMProvider):
             # counters; version 2 (ISSUE 11) the anomalies section,
             # per-replica anomalies_active, and the
             # measured-utilization fields under utilization.*.
-            "version": 6,
+            "version": 7,
             "dp": len(replicas),
             "queue": dict(snap.get("queue") or {}),
             "anomalies": anomalies,
+            "compiles": compiles_section,
+            "memory": memory_section,
             "pools": pools,
             "object_tier": object_section,
             "disagg": {
@@ -655,6 +714,12 @@ class TPULLMProvider(LLMProvider):
         # ANY thread, so run it off the event loop — /health (and every
         # other handler) stays responsive during the rebuild instead of
         # blocking behind it.
+        from ..runtime import compile_log
+
+        # rebuild compiles are expected, not a storm: phase the compile
+        # observatory here (not in the HTTP handler) so act-mode
+        # autoscaler resizes get the same treatment (ISSUE 18)
+        compile_log.set_phase("rebuild")
         fut = asyncio.get_running_loop().run_in_executor(
             None, lambda: (
                 rebuild(dp=dp) if roles is _ROLES_KEEP
@@ -676,6 +741,7 @@ class TPULLMProvider(LLMProvider):
                 def _resume(f) -> None:
                     self._rebuild_owns_resume = False
                     self.worker.resume()
+                    compile_log.set_phase("first_traffic")
                     # the cancelled caller never sees the rebuild's fate:
                     # a silent rebuild failure (old/half topology still
                     # serving) must at least reach the logs
@@ -693,6 +759,7 @@ class TPULLMProvider(LLMProvider):
         finally:
             if not self._rebuild_owns_resume:
                 self.worker.resume()
+                compile_log.set_phase("first_traffic")
         return clean
 
     async def drain_replica(self, replica: int) -> Dict[str, Any]:

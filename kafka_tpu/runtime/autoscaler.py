@@ -250,6 +250,7 @@ class ControllerState:
     overload_polls: int = 0
     idle_polls: int = 0
     recover_polls: int = 0
+    pressure_polls: int = 0       # consecutive polls with hbm_pressure
     ladder: int = 0               # current degradation rung (0 = normal)
     last_resize_t: Optional[float] = None   # monotonic, either direction
     last_ladder_t: Optional[float] = None
@@ -342,6 +343,14 @@ def condense(snap: Dict[str, Any]) -> Dict[str, Any]:
         "anomalies_active": (snap.get("anomalies") or {}).get(
             "anomalies_active", 0
         ),
+        # device-truth inputs (version-7 feeds, ISSUE 18); None/0 on
+        # older feeds or when the observatory/monitor is off
+        "compile_storm_active": bool(
+            (snap.get("compiles") or {}).get("storm_active")
+        ),
+        "hbm_pressure": int(
+            (snap.get("memory") or {}).get("pressure") or 0
+        ),
         "replica_states": states,
     }
     pools = _role_pools(snap)
@@ -398,6 +407,10 @@ def decide(snap: Dict[str, Any], state: ControllerState,
     anomalies_active = int(
         (snap.get("anomalies") or {}).get("anomalies_active", 0) or 0
     )
+    # device-truth inputs (ISSUE 18, version-7 feeds — absent keys read
+    # as inactive so v6 feeds keep deciding identically)
+    compile_storm = bool((snap.get("compiles") or {}).get("storm_active"))
+    hbm_pressure = bool((snap.get("memory") or {}).get("pressure"))
     all_quarantined = bool(states) and all(
         s == "quarantined" for s in states
     )
@@ -410,6 +423,9 @@ def decide(snap: Dict[str, Any], state: ControllerState,
     state.idle_polls = state.idle_polls + 1 if idle else 0
     state.recover_polls = (
         state.recover_polls + 1 if (recovered and state.ladder > 0) else 0
+    )
+    state.pressure_polls = (
+        state.pressure_polls + 1 if hbm_pressure else 0
     )
 
     d = Decision(action=HOLD, cause="steady", dp=dp, inputs=condense(snap))
@@ -432,7 +448,22 @@ def decide(snap: Dict[str, Any], state: ControllerState,
             d.ladder_target = state.ladder + 1
         else:
             d.cause = "saturated"  # capped AND at the ladder floor
-    elif state.ladder > 0 and state.recover_polls >= cfg.sustain_recover:
+    elif (
+        hbm_pressure
+        and state.pressure_polls >= cfg.sustain_out
+        and state.ladder < LADDER_MAX
+    ):
+        # measured HBM headroom under the watermark (ISSUE 18): the next
+        # allocation may OOM the device, so shed load NOW regardless of
+        # SLO attainment — more replicas would not shrink this replica's
+        # working set, only the ladder can
+        d.action = DEGRADE
+        d.cause = "hbm_pressure"
+        d.ladder_target = state.ladder + 1
+    elif (state.ladder > 0 and state.recover_polls >= cfg.sustain_recover
+          and not hbm_pressure):
+        # a rung applied for hbm_pressure must not climb back while the
+        # headroom is still under water, however healthy the SLO looks
         d.action = RECOVER
         d.cause = "attainment_recovered"
         d.ladder_target = state.ladder - 1
@@ -467,6 +498,14 @@ def decide(snap: Dict[str, Any], state: ControllerState,
         if snap.get("draining"):
             d.vetoes.append("draining")
         if d.action in (SCALE_OUT, SCALE_IN):
+            if compile_storm:
+                # XLA is recompiling under live traffic (ISSUE 18): the
+                # latency the controller would act on measures the
+                # compiler, not capacity — and a rebuild would ADD a
+                # cold engine's compiles on top.  Named separately from
+                # anomaly_active so the decision log shows the cause
+                # even when the flight recorder is off.
+                d.vetoes.append("compile_storm")
             if any_probation:
                 # a probation replica is mid-re-admission; a rebuild
                 # would reset the experiment (and flap)

@@ -405,6 +405,12 @@ class DataParallelEngines:
         # The fresh engine re-applies its roofline on the first dispatch
         # it records (the PR 10 reset rule), so transplanting is safe.
         engine.metrics = old.metrics
+        # an open kernel-sampler trace window on the discarded engine
+        # would hold the process-wide jax.profiler lock forever (ISSUE
+        # 18): flush it into the transplanted metrics before the swap
+        sampler = getattr(old, "kernel_sampler", None)
+        if sampler is not None:
+            sampler.close(old.metrics)
         self.engines[i] = engine
         for req in pending:
             engine.adopt(req)
@@ -1071,6 +1077,12 @@ class DataParallelEngines:
         pending: List[GenRequest] = []
         for e in self.engines:
             pending.extend(e.take_waiting())
+            # discarded engines must not exit holding the process-wide
+            # jax.profiler trace lock (ISSUE 18): close any open kernel-
+            # sampler window before the replica set is replaced
+            sampler = getattr(e, "kernel_sampler", None)
+            if sampler is not None:
+                sampler.close(e.metrics)
         old_dp = len(self.engines)
         self._build_engines(dp)
         # replica indices changed meaning: stale pins/routes must not leak
@@ -1307,6 +1319,20 @@ class _AggregateMetrics:
                 "model_skew": round(measured_s / modeled_s, 3)
                 if modeled_s > 0 else 0.0,
             }
+            # sampled kernel profiling (ISSUE 18): sample counts and
+            # device-kernel seconds sum; the skew ratio recomputes from
+            # modeled seconds reconstructed per row (busy_s / skew)
+            kern_s = sum(r.get("kernel_busy_s", 0.0) for r in rows)
+            kern_modeled = sum(
+                r.get("kernel_busy_s", 0.0) / r["kernel_skew"]
+                for r in rows if r.get("kernel_skew")
+            )
+            sec["kernel_samples"] = sum(
+                r.get("kernel_samples", 0) for r in rows
+            )
+            sec["kernel_busy_s"] = round(kern_s, 4)
+            sec["kernel_skew"] = (round(kern_s / kern_modeled, 3)
+                                  if kern_modeled > 0 else 0.0)
             # aggregate busy time is SUMMED replica-seconds, so the ratio
             # divides by replica-seconds of roofline — per-chip MFU, not
             # fleet-total
@@ -1385,10 +1411,13 @@ class _AggregateMetrics:
         for i, a in enumerate(anoms):
             for entry in a.get("active", []):
                 active.append({**entry, "replica": i})
+        from .flight_recorder import ANOMALY_KINDS
+
         agg["anomalies"] = {
-            key: sum(a.get(key, 0) for a in anoms)
-            for key in ("anomaly_queue_stall", "anomaly_fetch_starvation",
-                        "anomaly_mfu_collapse", "anomaly_prefill_convoy")
+            f"anomaly_{kind}": sum(
+                a.get(f"anomaly_{kind}", 0) for a in anoms
+            )
+            for kind in ANOMALY_KINDS
         }
         agg["anomalies"]["anomalies_active"] = len(active)
         agg["anomalies"]["active"] = active
@@ -1396,6 +1425,34 @@ class _AggregateMetrics:
         if flights:
             agg["flight"] = {
                 k: sum(f[k] for f in flights) for k in flights[0]
+            }
+        # Live HBM accounting (ISSUE 18, MEMORY_METRIC_KEYS): the fleet
+        # view is worst-case — the plan is per-replica, so the tightest
+        # replica bounds the fleet (max in_use/peak/skew/pressure, min
+        # limit/headroom); component attribution is identical across
+        # replicas (same plan), reported once
+        mems = [s["memory"] for s in snaps if "memory" in s]
+        if mems:
+            agg["memory"] = {
+                "source": mems[0]["source"],
+                "hbm_bytes_in_use": max(
+                    m["hbm_bytes_in_use"] for m in mems
+                ),
+                "hbm_bytes_peak": max(m["hbm_bytes_peak"] for m in mems),
+                "hbm_bytes_limit": min(
+                    m["hbm_bytes_limit"] for m in mems
+                ),
+                "hbm_headroom_bytes": min(
+                    m["hbm_headroom_bytes"] for m in mems
+                ),
+                "hbm_plan_skew": max(m["hbm_plan_skew"] for m in mems),
+                "hbm_pressure": max(m["hbm_pressure"] for m in mems),
+                "hbm_component_bytes": dict(
+                    mems[0].get("hbm_component_bytes") or {}
+                ),
+                "devices": [
+                    d for m in mems for d in m.get("devices", [])
+                ],
             }
         # Disaggregated prefill/decode (ISSUE 12, DISAGG_METRIC_KEYS):
         # router-owned ship counters + the ship-latency histogram,

@@ -70,6 +70,8 @@ from ..ops.sampling import (
     grammar_allowed_mask,
     sample_tokens_per_slot,
 )
+from . import compile_log
+from . import kernel_profiler as _kernel_profiler
 from .failpoints import failpoint
 from .flight_recorder import (
     FlightRecorder,
@@ -994,6 +996,24 @@ class InferenceEngine:
             self._have_roofline = self._roofline[2] != "unknown"
         except Exception as e:
             logger.debug("dispatch cost model unavailable: %s", e)
+        # Live HBM accounting (ISSUE 18): per-device memory_stats polled
+        # at step cadence (throttled inside the monitor), reconciled
+        # against the MemoryPlan the serving layer attaches after
+        # planning (engine.memory_monitor.plan = plan).  Read-only
+        # device introspection — no dispatch path depends on it.
+        try:
+            from .planner import MemoryMonitor
+
+            self.memory_monitor: Optional[MemoryMonitor] = MemoryMonitor(
+                list(mesh.devices.flat) if mesh is not None
+                else jax.devices()[:1]
+            )
+        except Exception:  # pragma: no cover - defensive
+            self.memory_monitor = None
+        # Sampled kernel profiling (ISSUE 18): every Nth step traced via
+        # jax.profiler when KAFKA_TPU_PROFILE_SAMPLE > 0, else None with
+        # every dispatch path byte-identical (tested like flight ring=0).
+        self.kernel_sampler = _kernel_profiler.build_from_env()
         # DP replica index (set by runtime/dp_router.py): traced requests'
         # engine spans carry it so a timeline names the replica it ran on
         self.replica: Optional[int] = None
@@ -1240,7 +1260,9 @@ class InferenceEngine:
                      self.ecfg.max_window, self.ecfg.max_batch, self.mesh)
         if cache_key in _FN_CACHE:
             return _FN_CACHE[cache_key]
-        jitted = jax.jit(self._decode_step_body(), donate_argnums=(1, 2))
+        jitted = compile_log.instrument(
+            "decode", jax.jit(self._decode_step_body(),
+                              donate_argnums=(1, 2)))
         _FN_CACHE[cache_key] = jitted
         return jitted
 
@@ -1265,7 +1287,8 @@ class InferenceEngine:
                      g_slack),
             )
 
-        jitted = jax.jit(fn, donate_argnums=(1, 2))
+        jitted = compile_log.instrument(
+            "decode_fsm", jax.jit(fn, donate_argnums=(1, 2)))
         _FN_CACHE[cache_key] = jitted
         return jitted
 
@@ -1329,7 +1352,9 @@ class InferenceEngine:
             )
             return cache.k, cache.v, toks
 
-        jitted = jax.jit(fn, donate_argnums=(1, 2))
+        jitted = compile_log.instrument(
+            f"bprefill[{bucket}x{width}]",
+            jax.jit(fn, donate_argnums=(1, 2)))
         _FN_CACHE[cache_key] = jitted
         return jitted
 
@@ -1387,7 +1412,9 @@ class InferenceEngine:
                 )
                 return kp, vp, toks_seq, last, lens
 
-        jitted = jax.jit(fn, donate_argnums=(1, 2))
+        jitted = compile_log.instrument(
+            f"multi_decode[{steps}]{'_fsm' if fsm else ''}",
+            jax.jit(fn, donate_argnums=(1, 2)))
         _FN_CACHE[cache_key] = jitted
         return jitted
 
@@ -1539,7 +1566,9 @@ class InferenceEngine:
                         new_fsm, new_budget)
             return cache.k, cache.v, out, new_last, new_lens
 
-        jitted = jax.jit(fn, donate_argnums=(1, 2))
+        jitted = compile_log.instrument(
+            "verify_fsm" if fsm else "verify",
+            jax.jit(fn, donate_argnums=(1, 2)))
         _FN_CACHE[cache_key] = jitted
         if not fsm:
             self._verify_fn = jitted
@@ -1602,7 +1631,8 @@ class InferenceEngine:
             tok = sample_tokens_per_slot(final_logits, sp, key[None], allowed_mask)
             return cache.k, cache.v, tok[0]
 
-        jitted = jax.jit(fn, donate_argnums=(1, 2))
+        jitted = compile_log.instrument(
+            f"prefill[{bucket}]", jax.jit(fn, donate_argnums=(1, 2)))
         _FN_CACHE[cache_key] = jitted
         self._prefill_fns[bucket] = jitted
         return jitted
@@ -1937,6 +1967,13 @@ class InferenceEngine:
         chunk's compute.
         """
         failpoint("engine.step")
+        if self.kernel_sampler is not None:
+            # close the previous sample's trace window (async device
+            # work has had the inter-step gap to land in it) and open a
+            # new one when this step is due
+            self.kernel_sampler.on_step_begin(self.metrics)
+        if self.memory_monitor is not None:
+            self.memory_monitor.poll()  # throttled to ~1 Hz internally
         if self.kv_tier is not None:
             # resolve completed D2H demotions so their gather buffers
             # leave HBM promptly (cheap: a list scan, usually empty)

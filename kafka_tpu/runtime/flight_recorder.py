@@ -116,6 +116,12 @@ ANOMALY_KINDS = (
     "fetch_starvation",
     "mfu_collapse",
     "prefill_convoy",
+    # device-truth detectors (ISSUE 18): the compile observatory's
+    # level-held storm condition (XLA recompiling under live traffic —
+    # the autoscaler refuses to resize while it holds) and measured
+    # HBM headroom under the watermark (runtime/planner.MemoryMonitor)
+    "compile_storm",
+    "hbm_pressure",
 )
 
 
@@ -521,6 +527,42 @@ class FlightRecorder:
                 self._check_mfu(engine, metrics, now)
             except Exception:  # pragma: no cover - defensive
                 pass
+        # compile storm (ISSUE 18): the process compile observatory is
+        # level-holding the condition; this detector edge-counts it per
+        # replica and keeps it in the active set the autoscaler reads.
+        try:
+            from . import compile_log
+
+            obs = compile_log.get()
+            if obs is not None:
+                if obs.storm_active():
+                    self._fire(
+                        engine, metrics, "compile_storm", now,
+                        f"{obs.storm_n}+ compiles in {obs.storm_s:.0f}s "
+                        "under live traffic",
+                    )
+                else:
+                    self._clear("compile_storm")
+        except Exception:  # pragma: no cover - defensive
+            pass
+        # HBM pressure (ISSUE 18): MEASURED device headroom dropped
+        # under the watermark — the resident set outgrew the plan
+        # (plan_skew tells by how much); the degradation ladder input.
+        try:
+            mm = getattr(engine, "memory_monitor", None)
+            if mm is not None:
+                if mm.pressure():
+                    sec = mm.section() or {}
+                    self._fire(
+                        engine, metrics, "hbm_pressure", now,
+                        f"headroom "
+                        f"{sec.get('hbm_headroom_bytes', 0) / 2**20:.0f}"
+                        f"MiB (skew {sec.get('hbm_plan_skew', 0.0)})",
+                    )
+                else:
+                    self._clear("hbm_pressure")
+        except Exception:  # pragma: no cover - defensive
+            pass
 
     def _check_mfu(self, engine: Any, metrics: Any, now: float) -> None:
         peak = metrics.peak_flops

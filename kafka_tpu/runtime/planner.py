@@ -567,3 +567,173 @@ def plan_for_serving(scfg, hbm_bytes: Optional[int] = None,
         # reviews see the full footprint of a tiered deployment
         kv_host_tier_bytes=getattr(scfg, "kv_host_tier_mb", 0) * MiB,
     )
+
+
+# ---------------------------------------------------------------------------
+# Live HBM accounting (ISSUE 18, leg b): reconcile the boot-time plan
+# against what the device actually holds, at step cadence.
+
+HBM_WATERMARK_ENV = "KAFKA_TPU_HBM_WATERMARK"
+HBM_POLL_ENV = "KAFKA_TPU_HBM_POLL_S"
+
+
+def _watermark_frac() -> Optional[float]:
+    """Headroom watermark as a fraction of the device byte limit.
+    Explicitly set -> that fraction (clamped to [0, 1)).  Unset ->
+    0.03 for device-sourced samples and DISABLED for plan-synthesized
+    ones: a barely-fitting plan on CPU smoke would otherwise hold an
+    hbm_pressure anomaly forever on numbers that are a prediction, not
+    a measurement."""
+    raw = __import__("os").environ.get(HBM_WATERMARK_ENV)
+    if raw is None or raw == "":
+        return None
+    try:
+        return min(0.99, max(0.0, float(raw)))
+    except ValueError:
+        return None
+
+
+class MemoryMonitor:
+    """Per-engine live HBM gauge set (engine-thread single-writer).
+
+    ``poll()`` reads every device's ``memory_stats()`` (throttled to
+    ``KAFKA_TPU_HBM_POLL_S``, default 1s — one host RPC per device,
+    never on the dispatch hot path more than that) and publishes one
+    immutable section dict; readers (``/metrics``, ``/admin/signals``,
+    the flight recorder's ``hbm_pressure`` detector) grab the latest
+    reference torn-free.
+
+    Devices without ``memory_stats`` (CPU smoke) synthesize the sample
+    from the :class:`MemoryPlan` itself (``source: "plan"``,
+    ``plan_skew`` pinned at 1.0) so every consumer downstream — the
+    gauges, the signals section, the ladder input — exercises the same
+    code path the TPU runs.
+    """
+
+    def __init__(self, devices, plan: Optional[MemoryPlan] = None,
+                 poll_s: Optional[float] = None):
+        import os as _os
+        self.devices = list(devices)
+        self.plan = plan
+        if poll_s is None:
+            try:
+                poll_s = float(_os.environ.get(HBM_POLL_ENV, "1.0"))
+            except ValueError:
+                poll_s = 1.0
+        self.poll_s = max(0.0, poll_s)
+        explicit = _watermark_frac()
+        self.watermark_frac = explicit
+        self._watermark_explicit = explicit is not None
+        self._last_poll_t: Optional[float] = None
+        self._last: Optional[Dict[str, object]] = None
+        self.polls = 0
+
+    # -- sampling --------------------------------------------------------
+
+    def poll(self, now: Optional[float] = None,
+             force: bool = False) -> Optional[Dict[str, object]]:
+        """Refresh the sample when the throttle allows; returns the
+        current section either way (None before the first poll)."""
+        import time as _time
+        now = _time.monotonic() if now is None else now
+        if (not force and self._last_poll_t is not None
+                and now - self._last_poll_t < self.poll_s):
+            return self._last
+        self._last_poll_t = now
+        self._last = self._sample()
+        self.polls += 1
+        return self._last
+
+    def _sample(self) -> Dict[str, object]:
+        per_dev = []
+        for d in self.devices:
+            try:
+                stats = d.memory_stats()
+            except Exception:
+                stats = None
+            if not stats or not stats.get("bytes_limit"):
+                continue
+            per_dev.append({
+                "device": str(getattr(d, "id", len(per_dev))),
+                "bytes_in_use": int(stats.get("bytes_in_use", 0)),
+                "bytes_peak": int(stats.get(
+                    "peak_bytes_in_use", stats.get("bytes_in_use", 0))),
+                "bytes_limit": int(stats["bytes_limit"]),
+            })
+        plan = self.plan
+        if per_dev:
+            # worst device bounds the fleet: the plan is per-device
+            in_use = max(d["bytes_in_use"] for d in per_dev)
+            peak = max(d["bytes_peak"] for d in per_dev)
+            limit = min(d["bytes_limit"] for d in per_dev)
+            source = "device"
+        elif plan is not None:
+            in_use = plan.total_bytes
+            peak = plan.total_bytes
+            limit = plan.usable_bytes
+            source = "plan"
+        else:
+            return {
+                "source": "none", "hbm_bytes_in_use": 0,
+                "hbm_bytes_peak": 0, "hbm_bytes_limit": 0,
+                "hbm_headroom_bytes": 0, "hbm_plan_skew": 0.0,
+                "hbm_pressure": 0, "hbm_component_bytes": {},
+                "devices": [],
+            }
+        headroom = limit - in_use
+        skew = (in_use / plan.total_bytes
+                if plan is not None and plan.total_bytes else 0.0)
+        wm = self.watermark_frac
+        if wm is None:
+            wm = 0.03 if source == "device" else None
+        pressure = (wm is not None and limit > 0
+                    and headroom < wm * limit)
+        return {
+            "source": source,
+            "hbm_bytes_in_use": int(in_use),
+            "hbm_bytes_peak": int(peak),
+            "hbm_bytes_limit": int(limit),
+            "hbm_headroom_bytes": int(headroom),
+            "hbm_plan_skew": round(skew, 4),
+            "hbm_pressure": 1 if pressure else 0,
+            "hbm_component_bytes": self._attribution(in_use),
+            "devices": per_dev,
+        }
+
+    def _attribution(self, in_use: int) -> Dict[str, int]:
+        """Measured bytes reconciled against the plan's line items:
+        each planned component at its planned charge, with the
+        residual (gather staging, XLA scratch, fragmentation — real
+        allocations the plan folds into reserve_frac) surfaced as
+        ``unattributed``.  A strongly negative residual means the plan
+        OVER-charges (plan_skew < 1): components larger than life."""
+        plan = self.plan
+        if plan is None:
+            return {}
+        comp = {
+            "weights": plan.weight_bytes,
+            "kv_pool": plan.kv_pool_bytes,
+            "activations": plan.activation_bytes,
+            "grammar_tables": plan.grammar_table_bytes,
+        }
+        comp["unattributed"] = int(in_use) - plan.total_bytes
+        return comp
+
+    # -- export ----------------------------------------------------------
+
+    def section(self) -> Optional[Dict[str, object]]:
+        """Latest sample (the ``memory`` metrics/signals section; keys
+        registered as MEMORY_METRIC_KEYS in metrics.py)."""
+        return self._last
+
+    def pressure(self) -> bool:
+        s = self._last
+        return bool(s and s.get("hbm_pressure"))
+
+    def headroom_frac(self) -> Optional[float]:
+        """Headroom as a fraction of the limit (autoscaler sizing input:
+        size against MEASURED headroom, not planned)."""
+        s = self._last
+        if not s or not s.get("hbm_bytes_limit"):
+            return None
+        return s["hbm_headroom_bytes"] / s["hbm_bytes_limit"]

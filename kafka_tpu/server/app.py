@@ -92,6 +92,15 @@ def build_tpu_provider(cfg: ServingConfig) -> LLMProvider:
     # backend"); returns False and costs nothing single-process
     init_distributed()
 
+    # compile observatory (runtime/compile_log.py): the ring must exist
+    # before the first jax.jit below so boot-phase compiles are captured;
+    # KAFKA_TPU_COMPILE_RING=0 leaves it off and every instrument() seam
+    # returns the jitted fn unchanged
+    from ..runtime import compile_log
+
+    compile_log.init()
+    compile_log.set_phase("boot")
+
     if cfg.compile_cache_dir:
         # persistent XLA compile cache: a warm reboot loads every serving
         # program from disk instead of recompiling (~30s per bucket)
@@ -102,6 +111,9 @@ def build_tpu_provider(cfg: ServingConfig) -> LLMProvider:
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+        compile_log.configure_cache(cache_dir)
+    else:
+        compile_log.configure_cache(None)
 
     # Resolve the model's ARCHITECTURE cheaply (config.json / registry —
     # no weight materialization) so the memory-fit check below can reject
@@ -251,6 +263,13 @@ def build_tpu_provider(cfg: ServingConfig) -> LLMProvider:
                 ep=cfg.ep_size,
             ))
         engine = InferenceEngine(model_cfg, params, engine_cfg, mesh=mesh)
+    if memory_plan is not None:
+        # live HBM accounting (runtime/planner.py MemoryMonitor): the plan
+        # attaches after construction so measured bytes_in_use can report
+        # plan_skew against the numbers this deployment was validated on
+        for _e in getattr(engine, "engines", [engine]):
+            if getattr(_e, "memory_monitor", None) is not None:
+                _e.memory_monitor.plan = memory_plan
     if cfg.warmup:
         # Compile the serving programs NOW (engine is not yet driven by the
         # worker thread, so direct generate() is safe); the first real
@@ -262,6 +281,7 @@ def build_tpu_provider(cfg: ServingConfig) -> LLMProvider:
         from ..runtime.metrics import EngineMetrics
 
         t0 = _time.monotonic()
+        compile_log.set_phase("warmup")
         engines = getattr(engine, "engines", [engine])
         # warmup is operator traffic, not client traffic: it must not trip
         # the admission bound (a small max_queue_depth would otherwise
@@ -366,6 +386,9 @@ def build_tpu_provider(cfg: ServingConfig) -> LLMProvider:
         for e in engines:
             e.metrics = EngineMetrics()
         logger.info("warmup compile done in %.1fs", _time.monotonic() - t0)
+    # everything compiled past this point is unexpected work under live
+    # traffic: the observatory's storm detector only counts this phase
+    compile_log.set_phase("first_traffic")
     vision_params = None
     if model_cfg.vision is not None:
         # vision tower (models/vision.py).  Random-init like the text
@@ -714,6 +737,8 @@ def _add_routes(app: web.Application) -> None:
     r.add_get("/debug/traces", debug_traces)
     r.add_get("/debug/trace/{request_id}", debug_trace)
     r.add_get("/debug/flight/{replica}", debug_flight)
+    r.add_get("/debug/compiles", debug_compiles)
+    r.add_get("/debug/kernels", debug_kernels)
     r.add_get("/playground", playground)
     # OPTIONS preflight is answered by cors_middleware before routing
 
@@ -1297,6 +1322,14 @@ async def metrics(request: web.Request) -> web.Response:
     scaler = _state(request).get("autoscaler")
     if scaler is not None:
         snap["autoscaler"] = scaler.metrics_section()
+    # compile observatory counters (COMPILE_METRIC_KEYS): process-wide
+    # like the sandbox/autoscaler sections — XLA compiles are per-process
+    # events, not per-replica (absent when KAFKA_TPU_COMPILE_RING=0)
+    from ..runtime import compile_log
+
+    obs = compile_log.get()
+    if obs is not None:
+        snap["compiles"] = obs.metrics_section()
     if request.query.get("format") == "prometheus":
         from .prometheus import render_prometheus
 
@@ -1407,6 +1440,9 @@ async def resize_topology(request: web.Request) -> web.Response:
     if roles_given:
         kwargs["roles"] = roles
     try:
+        # rebuild compiles are phased by the provider (_resize_locked
+        # sets the observatory to "rebuild" so they don't read as a
+        # compile storm) — act-mode autoscaler resizes share that path
         clean = await resize(dp, **kwargs)
     except ValueError as e:
         return web.json_response({"error": str(e)}, status=400)
@@ -1523,6 +1559,61 @@ async def debug_flight(request: web.Request) -> web.Response:
     return web.json_response(payload)
 
 
+async def debug_compiles(request: web.Request) -> web.Response:
+    """The compile observatory's bounded ring (ISSUE 18): every XLA
+    compilation this process performed — label, wall seconds, cache
+    hit/miss/off, and the serving phase it happened in (boot / warmup /
+    first_traffic / rebuild) — plus storm-detector state and running
+    totals.  `scripts/flightview.py --compiles` pretty-prints the
+    payload.  Read-only, same token policy as /metrics."""
+    from ..runtime import compile_log
+
+    obs = compile_log.get()
+    if obs is None:
+        return web.json_response(
+            {"error": "compile observatory disabled "
+                      "(KAFKA_TPU_COMPILE_RING=0)"},
+            status=404,
+        )
+    return web.json_response(obs.snapshot())
+
+
+async def debug_kernels(request: web.Request) -> web.Response:
+    """Sampled per-kernel device timing (ISSUE 18): the top-K kernels by
+    device time, grouped by the dispatch kinds active in each sampled
+    window, from KAFKA_TPU_PROFILE_SAMPLE=N every-Nth-step traces.
+    Aggregated across DP replicas (each engine owns its own sampler).
+    404 when sampling is off — the steady-state default, where every
+    dispatch path is byte-identical to a build without this feature."""
+    llm = _state(request)["llm"]
+    engine = getattr(llm, "engine", None)
+    if engine is None:
+        return web.json_response({"error": "no local engine"}, status=404)
+    try:
+        top_k = int(request.query.get("top_k", "20"))
+    except ValueError:
+        return web.json_response(
+            {"error": "top_k must be an integer"}, status=400
+        )
+    samplers = [
+        (i, s) for i, e in enumerate(getattr(engine, "engines", [engine]))
+        if (s := getattr(e, "kernel_sampler", None)) is not None
+    ]
+    if not samplers:
+        return web.json_response(
+            {"error": "kernel sampling disabled "
+                      "(set KAFKA_TPU_PROFILE_SAMPLE=N)"},
+            status=404,
+        )
+    payload = samplers[0][1].snapshot(top_k=top_k)
+    payload["replicas"] = [
+        dict(s.snapshot(top_k=top_k), replica=i) for i, s in samplers
+    ] if len(samplers) > 1 else None
+    if payload["replicas"] is None:
+        del payload["replicas"]
+    return web.json_response(payload)
+
+
 async def playground(request: web.Request) -> web.Response:
     """The in-tree chat client (reference: playground/src/, a Next.js app).
 
@@ -1621,12 +1712,27 @@ async def capture_profile(request: web.Request) -> web.Response:
             )
         llm = _state(request)["llm"]
         start_seqs = _flight_seqs(llm)
+        # the process-wide trace lock is shared with the every-Nth-step
+        # kernel sampler (runtime/kernel_profiler.py): jax.profiler
+        # supports one trace at a time, so an open sampler window must
+        # make this capture back off rather than crash the scheduler
+        from ..runtime import kernel_profiler
+
+        if not kernel_profiler.try_acquire_trace():
+            return web.json_response(
+                {"error": "device tracing busy (kernel sampler window "
+                          "open, or another capture running)"},
+                status=409,
+            )
         t_start = _time.time()
-        jax.profiler.start_trace(_PROFILE_DIR)
         try:
-            await asyncio.sleep(seconds)
+            jax.profiler.start_trace(_PROFILE_DIR)
+            try:
+                await asyncio.sleep(seconds)
+            finally:
+                jax.profiler.stop_trace()
         finally:
-            jax.profiler.stop_trace()
+            kernel_profiler.release_trace()
         t_end = _time.time()
         end_seqs = _flight_seqs(llm)
     finally:

@@ -393,6 +393,29 @@ def render_prometheus(snap: Dict[str, Any]) -> str:
         for k in kinds:
             w.sample("kafka_tpu_dispatch_model_skew",
                      util[k].get("model_skew", 0), {"kind": k})
+        # Profiler-sampled kernel truth (ISSUE 18, runtime/
+        # kernel_profiler.py): TRUE device kernel seconds from sampled
+        # jax.profiler traces vs the modeled seconds of those same
+        # sampled steps — the chip-truth calibration model_skew is read
+        # against (keys kernel_samples / kernel_busy_s / kernel_skew).
+        w.family("kafka_tpu_kernel_samples_total", "counter",
+                 "Profiler trace samples attributed to this dispatch "
+                 "kind (KAFKA_TPU_PROFILE_SAMPLE).")
+        for k in kinds:
+            w.sample("kafka_tpu_kernel_samples_total",
+                     util[k].get("kernel_samples", 0), {"kind": k})
+        w.family("kafka_tpu_kernel_seconds_total", "counter",
+                 "True device kernel time by dispatch kind, from "
+                 "sampled profiler traces.")
+        for k in kinds:
+            w.sample("kafka_tpu_kernel_seconds_total",
+                     util[k].get("kernel_busy_s", 0), {"kind": k})
+        w.family("kafka_tpu_kernel_skew", "gauge",
+                 "Sampled device kernel time / modeled roofline time "
+                 "for the same steps, by kind (0 = no samples yet).")
+        for k in kinds:
+            w.sample("kafka_tpu_kernel_skew",
+                     util[k].get("kernel_skew", 0), {"kind": k})
         if util.get("peak_tflops"):
             w.family("kafka_tpu_device_peak_teraflops", "gauge",
                      "Roofline peak FLOP/s per chip (datasheet or env "
@@ -804,6 +827,8 @@ def render_prometheus(snap: Dict[str, Any]) -> str:
             ("anomaly_fetch_starvation", "fetch_starvation"),
             ("anomaly_mfu_collapse", "mfu_collapse"),
             ("anomaly_prefill_convoy", "prefill_convoy"),
+            ("anomaly_compile_storm", "compile_storm"),
+            ("anomaly_hbm_pressure", "hbm_pressure"),
         ):
             if key in anom:
                 w.sample("kafka_tpu_anomalies_total", anom[key],
@@ -863,6 +888,68 @@ def render_prometheus(snap: Dict[str, Any]) -> str:
             w.family("kafka_tpu_autoscaler_dp", "gauge",
                      "dp at the controller's last signal poll.")
             w.sample("kafka_tpu_autoscaler_dp", scaler["autoscaler_dp"])
+
+    # Compile observatory (runtime/metrics.COMPILE_METRIC_KEYS — the
+    # registry tests/test_device_truth.py enforces in both files;
+    # process-wide, merged into the snapshot by server/app.py).  The
+    # total counter carries the {cache, phase} label matrices; the
+    # storm gauge is the autoscaler's "don't resize mid-storm" input.
+    comp = snap.get("compiles") or {}
+    if comp:
+        w.family("kafka_tpu_compiles_total", "counter",
+                 "XLA compilations observed, by persistent-cache "
+                 "disposition and engine phase.")
+        for cache, n in (comp.get("by_cache") or {}).items():
+            w.sample("kafka_tpu_compiles_total", n, {"cache": cache})
+        for phase, n in (comp.get("by_phase") or {}).items():
+            w.sample("kafka_tpu_compiles_total", n, {"phase": phase})
+        if "compile_seconds_total" in comp:
+            w.family("kafka_tpu_compile_seconds_total", "counter",
+                     "Wall-clock seconds spent in XLA compilation.")
+            w.sample("kafka_tpu_compile_seconds_total",
+                     comp["compile_seconds_total"])
+        if "compile_storm_active" in comp:
+            w.family("kafka_tpu_compile_storm_active", "gauge",
+                     "Compile storm condition currently held "
+                     "(recompiles under live traffic).")
+            w.sample("kafka_tpu_compile_storm_active",
+                     comp["compile_storm_active"])
+        if "compile_storms_total" in comp:
+            w.family("kafka_tpu_compile_storms_total", "counter",
+                     "Compile storm episodes entered.")
+            w.sample("kafka_tpu_compile_storms_total",
+                     comp["compile_storms_total"])
+
+    # Live HBM accounting (runtime/metrics.MEMORY_METRIC_KEYS, fed by
+    # runtime/planner.MemoryMonitor at step cadence).  Gauges are the
+    # worst device's numbers; the component family reconciles measured
+    # bytes against the MemoryPlan's line items.
+    mem = snap.get("memory") or {}
+    if mem:
+        for key, help_text in (
+            ("hbm_bytes_in_use", "Live HBM bytes in use (worst "
+             "device; source=plan on chips without memory_stats)."),
+            ("hbm_bytes_peak", "Peak HBM bytes in use (worst device)."),
+            ("hbm_bytes_limit", "HBM byte limit (smallest device)."),
+            ("hbm_headroom_bytes", "Measured HBM headroom: limit - "
+             "in_use (size against this, not the plan)."),
+            ("hbm_plan_skew", "Measured bytes / MemoryPlan predicted "
+             "bytes (1.0 = the plan was right)."),
+            ("hbm_pressure", "Headroom under the watermark "
+             "(KAFKA_TPU_HBM_WATERMARK)."),
+        ):
+            if key in mem:
+                w.family(f"kafka_tpu_{key}", "gauge", help_text)
+                w.sample(f"kafka_tpu_{key}", mem[key])
+        components = mem.get("hbm_component_bytes") or {}
+        if components:
+            w.family("kafka_tpu_hbm_component_bytes", "gauge",
+                     "HBM attribution by MemoryPlan line item "
+                     "(unattributed = measured residual: gather "
+                     "staging, scratch, fragmentation).")
+            for comp_name, b in components.items():
+                w.sample("kafka_tpu_hbm_component_bytes", b,
+                         {"component": comp_name})
 
     sandbox = snap.get("sandbox") or {}
     if sandbox:

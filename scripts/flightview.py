@@ -14,9 +14,14 @@ failure / quarantine / failed recovery)::
 Output: one line per scheduler iteration — seq, wall time, inter-
 iteration gap, dispatch kinds, batch composition, queue/page pressure,
 modeled vs measured dispatch time, cause codes — followed by the anomaly
-state and (for postmortems) the active-lane table and headline metrics.
+state and (for postmortems) the active-lane table and headline metrics
+(including the live-HBM ``memory`` section when present, ISSUE 18).
 The record schema and cause-code table are documented in README
 "Flight recorder".
+
+``--url ... --compiles`` switches to the compile observatory's ring
+(GET /debug/compiles): one line per XLA compilation — label, phase,
+cache hit/miss/off, wall seconds — plus storm state and totals.
 """
 
 from __future__ import annotations
@@ -31,10 +36,15 @@ from typing import Any, Dict, List, Optional
 
 def _fetch_live(url: str, replica: int,
                 token: Optional[str] = None) -> Dict[str, Any]:
+    return _fetch(url, f"/debug/flight/{replica}", token)
+
+
+def _fetch(url: str, path: str,
+           token: Optional[str] = None) -> Dict[str, Any]:
     from urllib.request import Request, urlopen
 
     req = Request(
-        f"{url.rstrip('/')}/debug/flight/{replica}",
+        f"{url.rstrip('/')}{path}",
         headers={"Authorization": f"Bearer {token}"} if token else {},
     )
     with urlopen(req, timeout=10) as r:
@@ -151,6 +161,56 @@ def print_metrics_headline(m: Dict[str, Any]) -> None:
             print(f"  {kind}: dispatches={u['dispatches']} "
                   f"mfu={u.get('mfu')} skew={u.get('model_skew')} "
                   f"measured_s={u.get('measured_busy_s')}")
+    print_memory(m.get("memory") or {})
+
+
+def print_memory(mem: Dict[str, Any]) -> None:
+    """Live HBM accounting (ISSUE 18) — the `memory` metrics section."""
+    if not mem or mem.get("source") == "none":
+        return
+    mib = 1 / (1024 * 1024)
+    print(f"  memory[{mem.get('source')}]: "
+          f"in_use={mem.get('hbm_bytes_in_use', 0) * mib:.1f}MiB "
+          f"peak={mem.get('hbm_bytes_peak', 0) * mib:.1f}MiB "
+          f"limit={mem.get('hbm_bytes_limit', 0) * mib:.1f}MiB "
+          f"headroom={mem.get('hbm_headroom_bytes', 0) * mib:.1f}MiB "
+          f"plan_skew={mem.get('hbm_plan_skew')} "
+          f"pressure={mem.get('hbm_pressure', 0)}")
+    comp = mem.get("hbm_component_bytes") or {}
+    if comp:
+        parts = " ".join(f"{k}={v * mib:.1f}MiB"
+                         for k, v in comp.items())
+        print(f"    components: {parts}")
+
+
+def print_compiles(payload: Dict[str, Any], tail: int) -> None:
+    """The compile observatory ring (GET /debug/compiles, ISSUE 18)."""
+    totals = payload.get("totals") or {}
+    storm = payload.get("storm") or {}
+    print(f"ring: {len(payload.get('records', []))} records "
+          f"(size {payload.get('ring_size')}, "
+          f"{payload.get('next_seq')} total)  phase: "
+          f"{payload.get('phase')}  cache_dir: "
+          f"{payload.get('cache_dir') or '-'}")
+    print(f"totals: {totals.get('compiles', 0)} compiles, "
+          f"{totals.get('seconds', 0.0):.2f}s  "
+          f"by_cache={totals.get('by_cache')}  "
+          f"by_phase={totals.get('by_phase')}")
+    if storm.get("active"):
+        print(f"!! COMPILE STORM ACTIVE (threshold {storm.get('n')} in "
+              f"{storm.get('window_s')}s; {storm.get('storms_total')} "
+              f"storm(s) total)")
+    records = payload.get("records") or []
+    if tail > 0:
+        records = records[-tail:]
+    hdr = (f"{'seq':>6} {'time':>12} {'phase':>13} {'cache':>5} "
+           f"{'secs':>8}  label")
+    print(hdr)
+    print("-" * len(hdr))
+    for r in records:
+        print(f"{r.get('seq', 0):>6} {_fmt_t(r.get('t')):>12} "
+              f"{r.get('phase', '?'):>13} {r.get('cache', '?'):>5} "
+              f"{r.get('seconds', 0.0):>8.3f}  {r.get('label', '?')}")
 
 
 def main() -> None:
@@ -167,12 +227,28 @@ def main() -> None:
                          "$KAFKA_TPU_API_TOKEN)")
     ap.add_argument("--latest", action="store_true",
                     help="open the newest postmortem in the dump dir")
+    ap.add_argument("--compiles", action="store_true",
+                    help="with --url: show the compile observatory ring "
+                         "(GET /debug/compiles) instead of the flight "
+                         "ring")
     ap.add_argument("-n", "--tail", type=int, default=64,
                     help="show only the last N records (0 = all)")
     ap.add_argument("--json", action="store_true",
                     help="dump the raw payload instead of the table")
     args = ap.parse_args()
 
+    if args.url and args.compiles:
+        payload = _fetch(args.url, "/debug/compiles", args.token)
+        if args.json:
+            json.dump(payload, sys.stdout, indent=2)
+            print()
+            return
+        print("== COMPILE OBSERVATORY ==")
+        print_compiles(payload, args.tail)
+        return
+    if args.compiles:
+        ap.error("--compiles needs --url (it reads the live ring)")
+        return
     if args.url:
         payload = _fetch_live(args.url, args.replica, args.token)
         title = f"LIVE ring, replica {payload.get('replica')}"

@@ -8,6 +8,10 @@ TPU, and times nested subsets of the decode step:
                                      timing (same source as the /metrics
                                      model-skew gauge), isolating device
                                      time from host scheduling
+  A''. sampled per-kernel table    — KAFKA_TPU_PROFILE_SAMPLE=N kernel
+                                     sampler output (same table as
+                                     GET /debug/kernels): device time by
+                                     XLA program, n/a when sampling is off
   B. decode_fn device loop         — jitted step only, device-resident args
   C. variant: greedy argmax only   — drops the top-k/top-p sort pipeline
   D. variant: no logits head       — drops the [H, V] projection + sampling
@@ -131,6 +135,35 @@ def main() -> None:
     else:
         print("A' measured dispatch latency   :     n/a "
               "(KAFKA_TPU_FLIGHT_RING=0)")
+
+    # ---- A''. sampled per-kernel device time (ISSUE 18) ------------------
+    # The kernel sampler (runtime/kernel_profiler.py) wrapped every Nth
+    # engine.step above in a jax.profiler trace when
+    # KAFKA_TPU_PROFILE_SAMPLE=N was set at engine construction; its
+    # per-kernel table attributes the A'-level device time to the actual
+    # XLA programs (fusions, matmuls, gathers) instead of whole
+    # dispatches — the same table GET /debug/kernels serves.
+    sampler = engine.kernel_sampler
+    if sampler is not None:
+        sampler.close(engine.metrics)  # flush any open trace window
+        rows = sampler.table(top_k=12)
+        if rows:
+            print(f"A'' sampled kernel table       : "
+                  f"{sampler.samples_total} sample(s)")
+            print(f"   {'kind':<16} {'kernel':<40} {'count':>6} "
+                  f"{'total us':>10} {'avg us':>8} {'frac':>6}")
+            for r in rows:
+                print(f"   {r['kind']:<16} {r['kernel'][:40]:<40} "
+                      f"{r['count']:>6} {r['total_us']:>10.0f} "
+                      f"{r['avg_us']:>8.1f} {r['frac']:>6.3f}")
+        else:
+            print("A'' sampled kernel table       :     n/a "
+                  "(no samples landed — raise --steps or lower "
+                  "KAFKA_TPU_PROFILE_SAMPLE)")
+    else:
+        print("A'' sampled kernel table       :     n/a "
+              "(set KAFKA_TPU_PROFILE_SAMPLE=N to sample every Nth "
+              "step)")
 
     # ---- device-resident args for the raw fn loops ----------------------
     B, ps, C = ecfg.max_batch, ecfg.page_size, ecfg.max_window

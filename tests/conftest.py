@@ -39,6 +39,18 @@ os.environ.setdefault("KAFKA_TPU_MCP_SERVERS", "[]")
 # serialization has been exercised for rounds without incident.
 os.environ["KAFKA_TPU_COMPILE_CACHE"] = ""
 
+# NO compile observatory by default in tests: the observatory is a
+# process-wide singleton, and build_tpu_provider boots it and leaves the
+# phase at "first_traffic" on exit.  After any test touches that path,
+# the suite's hundreds of tiny-model recompiles all read as live-traffic
+# compiles, the storm detector latches, and every later engine's flight
+# recorder reports a compile_storm anomaly — observed polluting
+# test_metrics, test_autoscaler, and test_flight_recorder at suite
+# scale.  Ring size 0 makes init() a no-op ("" would mean "use the
+# default"); device-truth tests opt back in with an explicit init(size)
+# or a monkeypatched env.
+os.environ["KAFKA_TPU_COMPILE_RING"] = "0"
+
 # The root cause of full-suite crashes (segfault/abort inside XLA:CPU
 # compile, detonating at a shifting late-suite test): every JIT-compiled
 # executable holds process memory mappings, the suite compiles thousands,
@@ -126,6 +138,10 @@ _HEAVY_TAIL = (
     # arms wall-clock-sensitive delay failpoints — keep it off the cold
     # compile path like test_kv_tier
     "test_flight_recorder.py",
+    # device-truth telemetry (ISSUE 18) drives real engines with the
+    # kernel sampler tracing every step — jax.profiler windows on the
+    # warm-cache side, same reasoning as test_flight_recorder
+    "test_device_truth.py",
     "test_grammar_fsm.py",
     "test_speculative.py",
     "test_server_parallel.py",

@@ -177,8 +177,16 @@ class TestEngineOverride:
         prompt = [5] + [cfg.image_token_id] * P + [7]
         pos = np.arange(1, 1 + P, dtype=np.int32)
         outs = []
-        for seed in (3, 4):
-            pix = jax.random.uniform(jax.random.PRNGKey(seed), (1, 32, 32, 3))
+        # Maximally-separated inputs, not two uniform-noise draws: under
+        # the random 2-layer toy model, iid-uniform images produce patch
+        # embeddings so close in distribution that greedy decoding
+        # collapses both onto the SAME attractor token (the phenomenon
+        # the HTTP test below documents for text comparisons).  Solid
+        # black vs solid white keeps the assertion about the serving
+        # path — different pixels MUST condition generation — instead of
+        # about the toy model's sensitivity to noise seeds.
+        for fill in (0.0, 1.0):
+            pix = jnp.full((1, 32, 32, 3), fill, dtype=jnp.float32)
             rows = np.asarray(
                 encode_images(vparams, cfg.vision, pix)[0], np.float32)
             outs.append(eng.generate(
