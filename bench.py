@@ -1565,6 +1565,282 @@ def disagg_phase(cfg, params, n_chatty: int = 4, n_long: int = 4,
     }
 
 
+def zero_copy_phase(cfg, params, n_long: int = 2, long_prompt: int = 257,
+                    long_gen: int = 4, n_groups: int = 2,
+                    c_len: int = 96, m_len: int = 48, x_len: int = 16,
+                    gen_len: int = 8, page_size: int = 8, seed: int = 47,
+                    min_prefill_tokens: int = 64,
+                    store_delay_s: float = 0.1) -> dict:
+    """Zero-host-copy movement A/Bs (ISSUE 19), two independent proofs:
+
+    * **ship transport** (needs >= 2 devices): the same disaggregated
+      hand-off workload under ``KAFKA_TPU_SHIP_TRANSPORT=host`` vs
+      ``device`` — outputs must be token-identical (the transport moves
+      the SAME bytes, only the route changes), the device run's ship
+      counters must show zero host-staged runs and a zero staging-bytes
+      peak (the "no numpy materialization" proof), and both report ship
+      MB/s.
+    * **wake prefetch**: threads slept to the object store wake on a
+      fresh router with every ``kv.object_get`` delayed
+      ``store_delay_s`` (the injected store RTT).  Each woken thread's
+      sleep manifest spans THREE runs (its first turn diverged from two
+      siblings at two radix depths, so its path is three nodes);
+      prefetch-on stages all of them in parallel at submit, prefetch-off
+      pays one RTT per run serially inside admission.  Reports the
+      wake-TTFT A/B and asserts speedup >= 1.5x with 0 coverable prompt
+      tokens recomputed and outputs token-identical across the modes.
+    """
+    import os as _os
+    import shutil
+    import tempfile
+
+    import jax as _jax
+
+    from kafka_tpu import failpoints
+    from kafka_tpu.runtime import EngineConfig, GenRequest, InferenceEngine
+    from kafka_tpu.runtime.dp_router import DataParallelEngines
+    from kafka_tpu.runtime.kv_tier import ENV_SHIP_TRANSPORT
+    from kafka_tpu.runtime.metrics import EngineMetrics
+    from kafka_tpu.runtime.object_tier import ENV_WAKE_PREFETCH_MB
+
+    rng = random.Random(seed)
+    out: dict = {}
+
+    # ---- part 1: ship-bandwidth A/B, host vs device transport -----------
+    if len(_jax.devices()) >= 2:
+        win_pages = max(
+            4, -(-(long_prompt + long_gen + 2 * page_size) // page_size)
+        )
+        ecfg = EngineConfig(
+            max_batch=2, page_size=page_size,
+            max_pages_per_seq=win_pages,
+            num_pages=(2 * n_long + 2) * win_pages + 8,
+            prefill_buckets=(16, 64, 256),
+            multi_step=1,
+        )
+        long_prompts = [make_prompt(rng, long_prompt, cfg.vocab_size)
+                        for _ in range(n_long)]
+
+        def run_ship(transport: str) -> dict:
+            _os.environ[ENV_SHIP_TRANSPORT] = transport
+            try:
+                dp = DataParallelEngines(
+                    cfg, params, ecfg, dp=2, tp=1,
+                    dp_roles="prefill:1,decode:1",
+                    disagg_min_prefill_tokens=min_prefill_tokens,
+                )
+                for n, e in enumerate(dp.engines):
+                    e.submit(GenRequest(request_id=f"__w{n}",
+                                        prompt_ids=[3] * long_prompt,
+                                        max_new_tokens=2))
+                    e.run_to_completion()
+                dp.warmup_disagg()
+                for e in dp.engines:
+                    e.metrics = EngineMetrics()
+                dp.disagg.snapshot()  # re-arm the staging-peak gauge
+                reqs = [
+                    GenRequest(request_id=f"zc-{transport}-{i}",
+                               prompt_ids=list(p), max_new_tokens=long_gen,
+                               prefix_key=f"zc-{i}")
+                    for i, p in enumerate(long_prompts)
+                ]
+                for r in reqs:
+                    dp.submit(r)
+                dp.run_to_completion()
+                snap = dp.disagg.snapshot()
+                ship_s = snap["ship_ms"]["sum"] / 1e3
+                res = {
+                    "shipped_runs": snap["disagg_shipped_runs"],
+                    "shipped_pages": snap["disagg_shipped_pages"],
+                    "shipped_bytes": snap["disagg_shipped_bytes"],
+                    "host_runs": snap["disagg_ship_host_runs"],
+                    "device_runs": snap["disagg_ship_device_runs"],
+                    "staging_peak_bytes": snap["disagg_ship_staging_bytes"],
+                    "ship_mb_s": round(
+                        snap["disagg_shipped_bytes"] / ship_s / 1e6, 1
+                    ) if ship_s > 0 else None,
+                    "outputs": {r.request_id.split("-", 1)[1].split("-")[1]:
+                                list(r.output_ids) for r in reqs},
+                    "cache_sources": sorted(
+                        {r.cache_source or "none" for r in reqs}),
+                }
+                del dp
+                return res
+            finally:
+                _os.environ.pop(ENV_SHIP_TRANSPORT, None)
+
+        host = run_ship("host")
+        device = run_ship("device")
+        assert host["outputs"] == device["outputs"], \
+            "ship transport changed generated tokens"
+        assert device["shipped_runs"] > 0, "nothing shipped"
+        assert device["device_runs"] == device["shipped_runs"], \
+            "device-transport run shipped through the host path"
+        assert device["host_runs"] == 0 and \
+            device["staging_peak_bytes"] == 0, \
+            "device-transport run materialized host staging bytes"
+        assert host["host_runs"] == host["shipped_runs"], \
+            "host-transport run used the device path"
+        out["ship_transport"] = {
+            "ship_mb_s": {"host": host["ship_mb_s"],
+                          "device": device["ship_mb_s"]},
+            "shipped_runs": device["shipped_runs"],
+            "shipped_pages": device["shipped_pages"],
+            "shipped_bytes": device["shipped_bytes"],
+            "host_staging_peak_bytes": host["staging_peak_bytes"],
+            "device_staging_peak_bytes": device["staging_peak_bytes"],
+            "outputs_match": True,
+            "note": ("same hand-off workload, host-staged vs "
+                     "device-to-device ship; token-identical outputs, "
+                     "device run asserted zero host staging"),
+        }
+    else:
+        out["ship_transport"] = None
+
+    # ---- part 2: wake-TTFT A/B, prefetch on vs off ----------------------
+    # Per-group thread family: thread `a` (the one woken later) shares
+    # c+m with sibling `b` and c alone with sibling `c`, so after the
+    # first turns its radix path is three nodes — and its sleep manifest
+    # three runs.  Groups share nothing with each other: every wake
+    # fetches all three of its runs from the store (no cross-wake local
+    # radix reuse quietly shrinking the off-path's serial RTT bill).
+    object_dir = tempfile.mkdtemp(prefix="kafka-kv-zerocopy-")
+    total = c_len + m_len + x_len + 2 * gen_len
+    wake_win = max(4, -(-(total + 2 * page_size) // page_size))
+
+    def mk_cfg():
+        return EngineConfig(
+            max_batch=1, page_size=page_size,
+            max_pages_per_seq=wake_win,
+            num_pages=(3 * n_groups + 3) * wake_win + 2,
+            prefill_buckets=(16, 64, 256, 512),
+            kv_host_tier_mb=256,
+            kv_object_dir=object_dir,
+        )
+
+    groups = [
+        {
+            "c": make_prompt(rng, c_len, cfg.vocab_size),
+            "m": make_prompt(rng, m_len, cfg.vocab_size),
+            "xa": make_prompt(rng, x_len, cfg.vocab_size),
+            "xb": make_prompt(rng, x_len, cfg.vocab_size),
+            "y": make_prompt(rng, x_len, cfg.vocab_size),
+            "tail": make_prompt(rng, max(4, gen_len // 2), cfg.vocab_size),
+        }
+        for _ in range(n_groups)
+    ]
+
+    def warm_compiles(eng):
+        for n in (total, c_len + x_len, max(4, gen_len // 2)):
+            eng.generate(make_prompt(rng, n, cfg.vocab_size),
+                         max_new_tokens=2)
+        eng.warmup_kv_tier()
+
+    a_eng = InferenceEngine(cfg, params, mk_cfg())
+    warm_compiles(a_eng)
+    first_outputs = []
+    for i, g in enumerate(groups):
+        # serve order a, b, c: each sibling splits thread a's radix path
+        # one level deeper ([c+m+xa] -> [c+m][xa] -> [c][m][xa])
+        turns = [("a", g["c"] + g["m"] + g["xa"]),
+                 ("b", g["c"] + g["m"] + g["xb"]),
+                 ("c", g["c"] + g["y"])]
+        for name, prompt in turns:
+            r = GenRequest(request_id=f"zcw-{i}{name}",
+                           prompt_ids=list(prompt),
+                           max_new_tokens=gen_len,
+                           prefix_key=f"zc-{i}{name}")
+            a_eng.submit(r)
+            a_eng.run_to_completion()
+            if name == "a":
+                first_outputs.append(list(r.output_ids))
+    a_eng.sleep_to_object()
+    del a_eng
+
+    ps = page_size
+
+    def run_wake(prefetch_mb: int) -> dict:
+        if prefetch_mb:
+            _os.environ[ENV_WAKE_PREFETCH_MB] = str(prefetch_mb)
+        try:
+            dp = DataParallelEngines(cfg, params, mk_cfg(), dp=1, tp=1)
+            eng = dp.engines[0]
+            warm_compiles(eng)
+            eng.metrics = EngineMetrics()
+            rows = []
+            failpoints.configure("kv.object_get", "delay",
+                                 str(store_delay_s))
+            try:
+                for i, g in enumerate(groups):
+                    prompt = (g["c"] + g["m"] + g["xa"]
+                              + first_outputs[i] + g["tail"])
+                    r = GenRequest(request_id=f"zcr-{prefetch_mb}-{i}",
+                                   prompt_ids=prompt,
+                                   max_new_tokens=gen_len,
+                                   prefix_key=f"zc-{i}a")
+                    dp.submit(r)
+                    dp.run_to_completion()
+                    rows.append(r)
+            finally:
+                failpoints.clear("kv.object_get")
+            obj = eng.kv_tier.object
+            recomputed = 0
+            for i, r in enumerate(rows):
+                stored = (c_len + m_len + x_len
+                          + len(first_outputs[i]) - 1)
+                coverable = min((stored // ps) * ps,
+                                ((len(r.prompt_ids) - 1) // ps) * ps)
+                recomputed += max(0, coverable - r.cached_tokens)
+            res = {
+                "ttft_ms": [round(
+                    (r.first_token_time - r.submit_time) * 1e3, 2)
+                    for r in rows],
+                "cache_sources": [r.cache_source for r in rows],
+                "outputs": [list(r.output_ids) for r in rows],
+                "recomputed": recomputed,
+                "prefetch_hits": obj.prefetch_hits,
+                "prefetch_wasted": obj.prefetch_wasted,
+            }
+            del dp
+            return res
+        finally:
+            _os.environ.pop(ENV_WAKE_PREFETCH_MB, None)
+
+    off = run_wake(0)
+    on = run_wake(64)
+    shutil.rmtree(object_dir, ignore_errors=True)
+    assert on["outputs"] == off["outputs"], \
+        "wake prefetch changed generated tokens"
+    assert on["recomputed"] == 0, \
+        f"prefetch-on wake recomputed {on['recomputed']} prompt tokens"
+    assert on["prefetch_hits"] >= 2 * n_groups, \
+        f"expected staged-run consumption: hits={on['prefetch_hits']}"
+    on_ms = statistics.median(on["ttft_ms"])
+    off_ms = statistics.median(off["ttft_ms"])
+    assert on_ms > 0 and off_ms / on_ms >= 1.5, (
+        f"prefetch-on wake TTFT must be >= 1.5x better under injected "
+        f"store RTT: off {off_ms}ms vs on {on_ms}ms"
+    )
+    out["wake_prefetch"] = {
+        "store_delay_ms": round(store_delay_s * 1e3, 1),
+        "wake_ttft_ms": {"prefetch_off": off["ttft_ms"],
+                         "prefetch_on": on["ttft_ms"]},
+        "wake_ttft_p50_ms": {"prefetch_off": round(off_ms, 2),
+                             "prefetch_on": round(on_ms, 2)},
+        "speedup": round(off_ms / on_ms, 2) if on_ms else None,
+        "prefetch_hits": on["prefetch_hits"],
+        "prefetch_wasted": on["prefetch_wasted"],
+        "prompt_tokens_recomputed": on["recomputed"],
+        "cache_sources": on["cache_sources"],
+        "outputs_match": True,
+        "note": ("threads with three-run sleep manifests wake on a fresh "
+                 "router with every kv.object_get delayed; prefetch-on "
+                 "stages all runs in parallel at submit, prefetch-off "
+                 "pays one RTT per run serially inside admission"),
+    }
+    return out
+
+
 def traffic_ramp_phase(cfg, params, n_warm: int = 3, n_ramp: int = 12,
                        n_post: int = 5, prompt_len: int = 32,
                        gen_len: int = 28, page_size: int = 8,
@@ -2270,7 +2546,7 @@ def main() -> None:
     ap.add_argument("scenario", nargs="?", default="all",
                     choices=("all", "speculative", "constrained", "kv_tier",
                              "sleep_wake", "store_outage", "disagg",
-                             "autoscale", "device_truth"),
+                             "autoscale", "device_truth", "zero_copy"),
                     help="'speculative' runs ONLY the speculative-decoding "
                          "A/B phase; 'constrained' runs ONLY the on-device "
                          "grammar FSM vs host-mask A/B; 'kv_tier' runs ONLY "
@@ -2290,7 +2566,10 @@ def main() -> None:
                          "the autoscaler control loop closed (dp 1 -> 2 "
                          "mid-run); 'device_truth' runs ONLY the kernel-"
                          "sampling overhead A/B + the warm-vs-cold rebuild "
-                         "compile-outage measurement")
+                         "compile-outage measurement; 'zero_copy' runs ONLY "
+                         "the zero-host-copy movement A/Bs (host vs device "
+                         "ship transport, wake prefetch on vs off under "
+                         "injected store RTT)")
     ap.add_argument("--model", default="llama-3.2-1b")
     ap.add_argument("--quick", action="store_true",
                     help="tiny model + short runs (CI smoke)")
@@ -2309,7 +2588,7 @@ def main() -> None:
                     help="skip the 1B-int8/3B/8B model-scale phase")
     args = ap.parse_args()
 
-    if args.scenario in ("disagg", "autoscale"):
+    if args.scenario in ("disagg", "autoscale", "zero_copy"):
         # dp=2 replicas need 2 devices; on a CPU host force the device
         # count BEFORE jax initializes (the flag only affects the host
         # platform — real TPU device sets are untouched)
@@ -2560,6 +2839,44 @@ def main() -> None:
         }))
         return
 
+    if args.scenario == "zero_copy":
+        # bench.py zero_copy: ONLY the zero-host-copy movement A/Bs
+        out = zero_copy_phase(
+            cfg, params,
+            n_long=2 if args.quick else 3,
+            long_prompt=257 if args.quick else 1025,
+            long_gen=4 if args.quick else 8,
+            n_groups=2 if args.quick else 3,
+            c_len=96 if args.quick else 192,
+            m_len=48 if args.quick else 96,
+            x_len=16 if args.quick else 32,
+            gen_len=8 if args.quick else 16,
+            page_size=8 if args.quick else 16,
+            min_prefill_tokens=64 if args.quick else 256,
+        )
+        ship = out.get("ship_transport") or {}
+        wake = out["wake_prefetch"]
+        if ship:
+            log(f"zero_copy: ship {ship['shipped_pages']} pages host "
+                f"{ship['ship_mb_s']['host']} MB/s -> device "
+                f"{ship['ship_mb_s']['device']} MB/s "
+                f"(device staging peak {ship['device_staging_peak_bytes']}B)")
+        else:
+            log("zero_copy: ship transport A/B skipped (needs >= 2 devices)")
+        log(f"zero_copy: wake TTFT p50 prefetch-off "
+            f"{wake['wake_ttft_p50_ms']['prefetch_off']}ms -> on "
+            f"{wake['wake_ttft_p50_ms']['prefetch_on']}ms "
+            f"({wake['speedup']}x) under {wake['store_delay_ms']}ms "
+            f"injected store RTT, {wake['prompt_tokens_recomputed']} "
+            f"prompt tokens recomputed")
+        print(json.dumps({
+            "metric": f"zero_copy_wake_prefetch_speedup_{cfg.name}",
+            "value": wake["speedup"],
+            "unit": "x",
+            "extras": out,
+        }))
+        return
+
     ecfg = EngineConfig(
         max_batch=args.batch,
         page_size=16,
@@ -2743,6 +3060,30 @@ def main() -> None:
             f"({disagg['decode_tpot_p99_ms']['improvement']}x)")
     else:
         log("disagg: skipped (needs >= 2 devices for dp=2 pools)")
+
+    # ---- zero-host-copy movement: ship transport + wake prefetch --------
+    zero_copy = zero_copy_phase(
+        cfg, params,
+        n_long=2 if args.quick else 3,
+        long_prompt=257 if args.quick else 1025,
+        long_gen=4 if args.quick else 8,
+        n_groups=2 if args.quick else 3,
+        c_len=96 if args.quick else 192,
+        m_len=48 if args.quick else 96,
+        x_len=16 if args.quick else 32,
+        gen_len=8 if args.quick else 16,
+        page_size=8 if args.quick else 16,
+        min_prefill_tokens=64 if args.quick else 256,
+    )
+    _zs = zero_copy.get("ship_transport") or {}
+    _zw = zero_copy["wake_prefetch"]
+    if _zs:
+        log(f"zero_copy: ship host {_zs['ship_mb_s']['host']} -> device "
+            f"{_zs['ship_mb_s']['device']} MB/s (device staging peak "
+            f"{_zs['device_staging_peak_bytes']}B)")
+    log(f"zero_copy: wake TTFT p50 off "
+        f"{_zw['wake_ttft_p50_ms']['prefetch_off']}ms -> on "
+        f"{_zw['wake_ttft_p50_ms']['prefetch_on']}ms ({_zw['speedup']}x)")
 
     # ---- autoscaler: closed-loop traffic ramp (ISSUE 13) -----------------
     autoscale = None
@@ -3002,6 +3343,7 @@ def main() -> None:
             "sleep_wake": sleep_wake,
             "store_outage": store_outage,
             "disagg": disagg,
+            "zero_copy": zero_copy,
             "autoscale": autoscale,
             "speculative": speculative,
             "batch_sweep": sweep,

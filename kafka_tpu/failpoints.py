@@ -97,6 +97,13 @@ SITES = (
     # repairs); `error` on fsck degrades it to a partial report.
     "kv.object_head",
     "kv.object_list",
+    # Wake prefetch (runtime/object_tier.WakePrefetcher): fired once per
+    # PREFETCHED run, on the prefetch worker thread, before the object
+    # GET.  `error` = that run's prefetch is dropped and the wake falls
+    # back to today's synchronous fetch (never a failed wake — prefetch
+    # is an overlap optimization, not a correctness dependency); `delay`
+    # simulates a prefetch racing admission.
+    "kv.prefetch",
     "worker.dispatch",
     "sandbox.exec",
     "sandbox.boot",

@@ -325,6 +325,13 @@ class TPULLMProvider(LLMProvider):
           wipe) and EVERY resize must hold — latency numbers during a
           storm measure the compiler, not capacity.  Null when
           KAFKA_TPU_COMPILE_RING=0.
+        * zero-host-copy movement (version 8, ISSUE 19):
+          ``object_tier.prefetch`` carries the wake-prefetch
+          hits/wasted/bytes/inflight counters (all zeros when
+          KAFKA_TPU_WAKE_PREFETCH_MB is unset), and ``disagg`` gains the
+          ship-transport split (``disagg_ship_host_runs`` /
+          ``disagg_ship_device_runs``) plus the host-staging peak gauge
+          (``disagg_ship_staging_bytes``).
         * ``memory`` (version 7, ISSUE 18): measured HBM against the
           startup MemoryPlan — worst-case ``headroom_bytes`` (min over
           replicas), ``plan_skew`` (measured bytes_in_use / planned
@@ -464,6 +471,15 @@ class TPULLMProvider(LLMProvider):
                 "store_errors": (obj.get("object_put_failures", 0)
                                  + obj.get("object_get_failures", 0)),
                 "probe_neg_cached": obj.get("store_probe_neg_cached", 0),
+                # version 8 (ISSUE 19): wake-prefetch effectiveness —
+                # hits vs wasted tells a controller whether the staging
+                # budget is sized right (all zeros = prefetch off)
+                "prefetch": {
+                    "hits": obj.get("prefetch_hits", 0),
+                    "wasted": obj.get("prefetch_wasted", 0),
+                    "bytes": obj.get("prefetch_bytes", 0),
+                    "inflight": obj.get("prefetch_inflight", 0),
+                },
             }
         # Device-truth sections (version 7, ISSUE 18).  compiles: the
         # process-wide observatory ring summary — storm_active is the
@@ -504,6 +520,15 @@ class TPULLMProvider(LLMProvider):
                 "replicas": mem_reps,
             }
         return {
+            # version 8 (ISSUE 19): zero-host-copy movement — the
+            # object_tier section gains ``prefetch`` (wake-prefetch
+            # hits/wasted/bytes/inflight: zeros when
+            # KAFKA_TPU_WAKE_PREFETCH_MB is unset) and the disagg
+            # section carries the ship-transport split
+            # (disagg_ship_host_runs / disagg_ship_device_runs — host +
+            # device sum to disagg_shipped_runs) plus the host-staging
+            # peak gauge (disagg_ship_staging_bytes, 0 under the
+            # device transport).
             # version 7 (ISSUE 18): device-truth sections — compiles
             # (observatory ring summary + storm_active, null when
             # KAFKA_TPU_COMPILE_RING=0) and memory (measured HBM
@@ -524,7 +549,7 @@ class TPULLMProvider(LLMProvider):
             # counters; version 2 (ISSUE 11) the anomalies section,
             # per-replica anomalies_active, and the
             # measured-utilization fields under utilization.*.
-            "version": 7,
+            "version": 8,
             "dp": len(replicas),
             "queue": dict(snap.get("queue") or {}),
             "anomalies": anomalies,

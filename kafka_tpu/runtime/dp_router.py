@@ -760,6 +760,13 @@ class DataParallelEngines:
 
     def submit(self, req: GenRequest) -> None:
         idx = self._pick(req)
+        if req.prefix_key is not None and not req.handoff:
+            # kick BEFORE the engine sees the request: admission can run
+            # the wake inline (off-slot prefix attach fires on submit),
+            # so staging must already be registered for take() to find.
+            # A submit that raises below leaves staged payloads behind —
+            # bounded by the budget, reclaimed as prefetch_wasted.
+            self._kick_prefetch(idx, req)
         self.engines[idx].submit(req)  # may raise: record routes only after
         self._route[req.request_id] = idx
         if req.prefix_key is not None and not req.handoff:
@@ -767,10 +774,41 @@ class DataParallelEngines:
             # the DECODE home — never to the transient prefill replica
             self._set_affinity(req.prefix_key, idx)
 
+    def _kick_prefetch(self, idx: int, req: GenRequest) -> None:
+        """Wake prefetch (ISSUE 19): when the thread's sleep manifest
+        could serve deeper than the CHOSEN replica's local radix cache,
+        start the object GETs now — the store RTT overlaps the queue
+        wait instead of running synchronously inside prefill admission.
+        Everything past the sync manifest-probe cache happens on the
+        prefetcher's executor; a dead store degrades at the breaker gate
+        inside prefetch_thread (today's synchronous path, zero RTT
+        here).  Per-REPLICA staging: the payloads land in the picked
+        engine's tier, where its prefix_cache.lookup consumes them."""
+        e = self.engines[idx]
+        tier = getattr(e, "kv_tier", None)
+        obj = getattr(tier, "object", None) if tier is not None else None
+        pre = getattr(obj, "prefetcher", None) if obj is not None else None
+        if pre is None:
+            return
+        pc = e.prefix_cache
+        local = pc.match_tokens(req.prompt_ids) if pc is not None else 0
+        pre.prefetch_thread(req.prefix_key, min_depth=local)
+
     def cancel(self, request_id: str, reason: str = "cancelled") -> bool:
         idx = self._route.pop(request_id, None)
         if idx is None:
             return False
+        # Doom any wake prefetch staged for the request's thread (ISSUE
+        # 19): a cancelled request's staged payloads would otherwise sit
+        # in the budget until evicted as waste.  Another queued request
+        # of the same thread simply degrades to the synchronous fetch.
+        req = self.engines[idx]._requests.get(request_id)
+        if req is not None and req.prefix_key is not None:
+            tier = getattr(self.engines[idx], "kv_tier", None)
+            obj = getattr(tier, "object", None) if tier is not None else None
+            pre = getattr(obj, "prefetcher", None) if obj is not None else None
+            if pre is not None:
+                pre.cancel_thread(req.prefix_key)
         # A request parked in an engine's hand-off list (prefill done,
         # ship + requeue pending) is in NEITHER engine's _requests — an
         # engine-level cancel would return False and the next step's
@@ -950,12 +988,14 @@ class DataParallelEngines:
         cache.store(req.prefix_key, tokens[:n_full * ps],
                     [-1] * skip + list(dest), shipped=True)
         dst_e.pool.release(dest)
-        self.disagg.record_ship(n_ship, nbytes, dur)
+        self.disagg.record_ship(n_ship, nbytes, dur,
+                                transport=shipper.transport)
         return {
             "shipped": True,
             "shipped_pages": n_ship,
             "shipped_bytes": nbytes,
             "already_cached_pages": skip,
+            "transport": shipper.transport,
         }
 
     def warmup_disagg(self) -> None:

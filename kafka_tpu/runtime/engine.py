@@ -928,6 +928,14 @@ class InferenceEngine:
                     grace_s=_env_f("KAFKA_TPU_KV_OBJECT_SCRUB_GRACE_S",
                                    3600.0),
                 )
+                # Wake prefetch (ISSUE 19): opt-in via
+                # KAFKA_TPU_WAKE_PREFETCH_MB — the DP router's manifest
+                # probe starts object GETs at submit time so store RTT
+                # overlaps queue wait.  None when unset: the wake path
+                # stays the synchronous fetch, bit-identical.
+                from .object_tier import WakePrefetcher
+
+                obj_tier.prefetcher = WakePrefetcher.from_env(obj_tier)
                 self.kv_tier.attach_object(obj_tier)
         if self.ecfg.flight_ring < 0:
             raise ValueError(
@@ -2096,6 +2104,9 @@ class InferenceEngine:
         """The active-lane table for postmortems: every registered
         request's scheduler-visible state, readable without the engine."""
         now = time.monotonic()
+        tier = getattr(self, "kv_tier", None)
+        obj = getattr(tier, "object", None) if tier is not None else None
+        pre = getattr(obj, "prefetcher", None) if obj is not None else None
         out: List[Dict[str, Any]] = []
         for req in self._requests.values():
             out.append({
@@ -2111,6 +2122,13 @@ class InferenceEngine:
                 "spec_ahead": req.spec_ahead,
                 "cached_tokens": req.cached_tokens,
                 "cache_source": req.cache_source,
+                # wake-prefetch staging ready for this lane's thread
+                # (ISSUE 19): nonzero = an admission would consume these
+                # bytes with zero fetch RTT
+                "prefetch_staged_bytes": (
+                    pre.staged_bytes_for(req.prefix_key)
+                    if pre is not None and req.prefix_key else 0
+                ),
                 "grammar": req.grammar is not None,
                 "host_constrained": self._host_constrained(req),
                 "predicted": len(req.predicted),

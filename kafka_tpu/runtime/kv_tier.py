@@ -63,6 +63,19 @@ logger = logging.getLogger("kafka_tpu.kv_tier")
 
 ENV_HOST_MB = "KAFKA_TPU_KV_HOST_TIER_MB"
 ENV_DISK_DIR = "KAFKA_TPU_KV_DISK_TIER_DIR"
+# Cross-replica ship transport (ISSUE 19): "host" (the PR-12 host-staged
+# path, the default — unset keeps today's behavior bit-identical),
+# "device" (force the zero-host-copy DeviceShipper), or "auto" (device
+# when both replicas' pools are in-process jax arrays, host otherwise —
+# i.e. whenever a same-process handoff can skip the host hop, it does).
+ENV_SHIP_TRANSPORT = "KAFKA_TPU_SHIP_TRANSPORT"
+# Byte bound on host-staged ship copies (MiB, 0 = unbounded).  The
+# host-staged path holds one numpy copy per in-flight chunk until its
+# scatter lands; under a burst of concurrent handoffs those copies can
+# balloon host RSS silently — over budget, staging waits for the
+# outstanding scatters before materializing another chunk (RSS bounded
+# to budget + one chunk).
+ENV_SHIP_STAGING_MB = "KAFKA_TPU_SHIP_STAGING_MB"
 
 MiB = 1024 * 1024
 
@@ -93,6 +106,92 @@ def host_tier_mb_from_env() -> int:
 
 def disk_tier_dir_from_env() -> Optional[str]:
     return os.environ.get(ENV_DISK_DIR) or None
+
+
+def ship_transport_from_env() -> str:
+    """The cross-replica ship transport knob (unknown values -> host:
+    the conservative path can move any payload)."""
+    t = (os.environ.get(ENV_SHIP_TRANSPORT) or "host").strip().lower()
+    return t if t in ("auto", "host", "device") else "host"
+
+
+def ship_staging_budget_bytes() -> int:
+    try:
+        mb = max(0, int(os.environ.get(ENV_SHIP_STAGING_MB, "0") or 0))
+    except ValueError:
+        mb = 0
+    return mb * MiB
+
+
+def _pools_on_device(owner: Any) -> bool:
+    """True when the owner's pools are in-process jax arrays a
+    device-to-device transfer can address (always for live engines; a
+    cross-process transport stub holding opaque handles returns False
+    and keeps the host-staged wire path)."""
+    try:
+        for pool in (owner.k_pool, owner.v_pool):
+            for a in jax.tree.leaves(pool):
+                if not isinstance(a, jax.Array):
+                    return False
+    except Exception:
+        return False
+    return True
+
+
+def resolve_ship_transport(src_owner: Any, dst_owner: Any,
+                           mode: Optional[str] = None) -> str:
+    """Resolve auto-selection: device only when BOTH pools are reachable
+    in-process (see _pools_on_device).  Explicit host/device are taken
+    at their word."""
+    mode = mode or ship_transport_from_env()
+    if mode == "auto":
+        return (
+            "device"
+            if _pools_on_device(src_owner) and _pools_on_device(dst_owner)
+            else "host"
+        )
+    return mode
+
+
+# -- host-staged ship accounting (ISSUE 19 satellite) -----------------------
+# Module-level because staging RSS is a PROCESS property: every
+# CrossReplicaPageShipper (they are constructed per handoff) adds to the
+# same pool of pinned host copies.
+_ship_stage_lock = threading.Lock()
+_ship_stage_bytes = 0
+_ship_stage_peak = 0
+
+
+def _ship_stage_add(n: int) -> None:
+    global _ship_stage_bytes, _ship_stage_peak
+    with _ship_stage_lock:
+        _ship_stage_bytes += n
+        if _ship_stage_bytes > _ship_stage_peak:
+            _ship_stage_peak = _ship_stage_bytes
+
+
+def _ship_stage_sub(n: int) -> None:
+    global _ship_stage_bytes
+    with _ship_stage_lock:
+        _ship_stage_bytes = max(0, _ship_stage_bytes - n)
+
+
+def ship_staging_bytes() -> int:
+    """Host bytes currently pinned by in-flight host-staged ship chunks."""
+    with _ship_stage_lock:
+        return _ship_stage_bytes
+
+
+def ship_staging_peak(reset: bool = False) -> int:
+    """Peak staged bytes; with reset=True, re-armed at the current level
+    (peak-since-last-snapshot, the queue_depth_peak idiom) so every
+    scrape interval reports its own high-water mark."""
+    global _ship_stage_peak
+    with _ship_stage_lock:
+        peak = _ship_stage_peak
+        if reset:
+            _ship_stage_peak = _ship_stage_bytes
+        return peak
 
 
 def _bucketize(n_pages: int) -> List[int]:
@@ -380,18 +479,186 @@ class LocalPageShipper(PageShipper):
         return total
 
 
+class DeviceShipper(PageShipper):
+    """Device-to-device page-run transport: zero host copies (ISSUE 19).
+
+    The same export/resolve/import seam as :class:`LocalPageShipper`,
+    but no leaf is ever materialized as numpy: export's bucketed gathers
+    stay on the source mesh, resolve re-places the buffers onto the
+    destination pool's sharding with ``jax.device_put`` (a no-op
+    placement when both replicas share devices, an ICI/DMA transfer when
+    they don't — the KV pool's slot axis is unsharded, so the gathered
+    rows take the pool's NamedSharding directly), and import runs the
+    donating scatter on the destination.
+
+    :meth:`ship` is the chunk-aligned fast path
+    :class:`CrossReplicaPageShipper` routes to: gather -> device_put ->
+    scatter per SHIP_BUCKETS chunk, skipping resolve's trim/concat (the
+    padded rows ride along and land in the destination trash page, same
+    as the host transport).  The ``kv.ship`` failpoint fires once per
+    chunk here too, so torn-copy chaos rules (``error:nth=2``) behave
+    identically across transports, and so does the cleanup contract:
+    ship() raising means the destination pages are PARTIAL and the
+    caller frees them all.
+    """
+
+    def __init__(self, src_owner: Any, dst_owner: Any, page_size: int):
+        self.src = src_owner
+        self.dst = dst_owner
+        self.page_size = page_size
+
+    def _place(self, leaves: List[Any], refs: List[Any]) -> List[Any]:
+        """Move gathered leaves onto the matching destination pool
+        leaves' shardings, staying on device."""
+        out = []
+        for a, ref in zip(leaves, refs):
+            sh = getattr(ref, "sharding", None)
+            out.append(a if sh is None else jax.device_put(a, sh))
+        return out
+
+    # -- the PageShipper seam ------------------------------------------
+
+    def export_run(self, pages: Sequence[int]) -> _PendingExport:
+        ps = self.page_size
+        chunks: List[Tuple[List[Any], List[Any]]] = []
+        chunk_pages: List[int] = []
+        off = 0
+        for padded in _bucketize(len(pages)):
+            real = min(padded, len(pages) - off)
+            idx = _flat_slots(pages[off:off + real], ps, padded)
+            k_rows, v_rows = _gather_rows(
+                self.src.k_pool, self.src.v_pool, jnp.asarray(idx)
+            )
+            # NO copy_to_host_async: the buffers stay device-resident
+            chunks.append((jax.tree.leaves(k_rows), jax.tree.leaves(v_rows)))
+            chunk_pages.append(real)
+            off += real
+        return _PendingExport(len(pages), chunk_pages, chunks)
+
+    def resolve(self, pending: _PendingExport) -> Tuple[List[Any], List[Any]]:
+        """Trim chunk padding and concatenate ON DEVICE, then place the
+        run onto the destination pool's sharding — one jax array per
+        pool leaf, never numpy."""
+        ps = self.page_size
+        k_parts: List[List[Any]] = []
+        v_parts: List[List[Any]] = []
+        for (k_leaves, v_leaves), real in zip(
+            pending.chunks, pending.chunk_pages
+        ):
+            k_parts.append([a[:, : real * ps] for a in k_leaves])
+            v_parts.append([a[:, : real * ps] for a in v_leaves])
+        n_leaves = len(k_parts[0])
+        k_out = [
+            jnp.concatenate([part[i] for part in k_parts], axis=1)
+            if len(k_parts) > 1 else k_parts[0][i]
+            for i in range(n_leaves)
+        ]
+        v_out = [
+            jnp.concatenate([part[i] for part in v_parts], axis=1)
+            if len(v_parts) > 1 else v_parts[0][i]
+            for i in range(n_leaves)
+        ]
+        return (
+            self._place(k_out, jax.tree.leaves(self.dst.k_pool)),
+            self._place(v_out, jax.tree.leaves(self.dst.v_pool)),
+        )
+
+    def import_run(
+        self,
+        k_leaves: List[Any],
+        v_leaves: List[Any],
+        n_pages: int,
+        dest_pages: Sequence[int],
+    ) -> None:
+        if len(dest_pages) != n_pages:
+            raise ShipError(
+                f"import of {n_pages}-page run into {len(dest_pages)} pages"
+            )
+        ps = self.page_size
+        treedef_k = jax.tree.structure(self.dst.k_pool)
+        treedef_v = jax.tree.structure(self.dst.v_pool)
+        off = 0
+        for padded in _bucketize(n_pages):
+            real = min(padded, n_pages - off)
+            idx = _flat_slots(dest_pages[off:off + real], ps, padded)
+            lo, hi = off * ps, (off + real) * ps
+            pad_rows = (padded - real) * ps
+
+            def chunk_of(a):
+                rows = a[:, lo:hi]
+                if pad_rows:
+                    pad = jnp.zeros(
+                        (rows.shape[0], pad_rows) + tuple(rows.shape[2:]),
+                        rows.dtype,
+                    )
+                    rows = jnp.concatenate([rows, pad], axis=1)
+                return rows
+
+            self.dst.k_pool, self.dst.v_pool = _scatter_jit(
+                self.dst.k_pool, self.dst.v_pool, jnp.asarray(idx),
+                jax.tree.unflatten(treedef_k, [chunk_of(a) for a in k_leaves]),
+                jax.tree.unflatten(treedef_v, [chunk_of(a) for a in v_leaves]),
+            )
+            off += real
+
+    def bytes_per_page(self) -> int:
+        ps = self.page_size
+        total = 0
+        for pool in (self.src.k_pool, self.src.v_pool):
+            for a in jax.tree.leaves(pool):
+                per_slot = int(np.prod(a.shape[2:])) if a.ndim > 2 else 1
+                total += a.shape[0] * ps * per_slot * a.dtype.itemsize
+        return total
+
+    # -- the chunk-aligned ship fast path ------------------------------
+
+    def ship(self, src_pages: Sequence[int],
+             dest_pages: Sequence[int]) -> int:
+        ps = self.page_size
+        treedef_k = jax.tree.structure(self.dst.k_pool)
+        treedef_v = jax.tree.structure(self.dst.v_pool)
+        dst_k_refs = jax.tree.leaves(self.dst.k_pool)
+        dst_v_refs = jax.tree.leaves(self.dst.v_pool)
+        off = 0
+        nbytes = 0
+        for padded in _bucketize(len(src_pages)):
+            failpoint("kv.ship")
+            real = min(padded, len(src_pages) - off)
+            sidx = _flat_slots(src_pages[off:off + real], ps, padded)
+            k_rows, v_rows = _gather_rows(
+                self.src.k_pool, self.src.v_pool, jnp.asarray(sidx)
+            )
+            k_leaves = self._place(jax.tree.leaves(k_rows), dst_k_refs)
+            v_leaves = self._place(jax.tree.leaves(v_rows), dst_v_refs)
+            frac = real / padded
+            nbytes += int(sum(
+                a.nbytes * frac for a in (*k_leaves, *v_leaves)
+            ))
+            didx = _flat_slots(dest_pages[off:off + real], ps, padded)
+            self.dst.k_pool, self.dst.v_pool = _scatter_jit(
+                self.dst.k_pool, self.dst.v_pool, jnp.asarray(didx),
+                jax.tree.unflatten(treedef_k, k_leaves),
+                jax.tree.unflatten(treedef_v, v_leaves),
+            )
+            off += real
+        return nbytes
+
+
 class CrossReplicaPageShipper:
     """Ship a page run from one replica's PagePool into another's
     (disaggregated prefill/decode, ISSUE 12).
 
-    Same bucketed gather/scatter programs as the local tier copies, with
-    the handoff HOST-STAGED: each chunk is gathered out of the source
-    pool, materialized on host (the D2H resolve blocks), and scattered
-    into the destination pool (H2D) — the seam stays transport-agnostic,
-    so an ICI/DMA transport can replace the host staging without touching
-    any caller.  Both pools' scatters donate, so ship() must run on the
-    thread that owns dispatch for BOTH replicas (the DP router's worker
-    thread drives every replica, so this holds by construction).
+    Same bucketed gather/scatter programs as the local tier copies.  Two
+    transports (ISSUE 19, ``KAFKA_TPU_SHIP_TRANSPORT``): the default
+    HOST-STAGED path gathers each chunk out of the source pool,
+    materializes it on host (the D2H resolve blocks), and scatters it
+    into the destination pool (H2D); the DEVICE path
+    (:class:`DeviceShipper`) replaces the host hop with a
+    ``jax.device_put`` onto the destination sharding — the seam stays
+    transport-agnostic, so callers never change.  Both pools' scatters
+    donate, so ship() must run on the thread that owns dispatch for BOTH
+    replicas (the DP router's worker thread drives every replica, so
+    this holds by construction).
 
     Chunks are padded to SHIP_BUCKETS with trash-page slots on both
     sides: padded gather rows are garbage read out of the source trash
@@ -407,10 +674,18 @@ class CrossReplicaPageShipper:
     complete) and the thread degrades to re-prefill.
     """
 
-    def __init__(self, src_owner: Any, dst_owner: Any, page_size: int):
+    def __init__(self, src_owner: Any, dst_owner: Any, page_size: int,
+                 transport: Optional[str] = None):
         self.src = src_owner
         self.dst = dst_owner
         self.page_size = page_size
+        self.transport = resolve_ship_transport(
+            src_owner, dst_owner, transport
+        )
+        self._device = (
+            DeviceShipper(src_owner, dst_owner, page_size)
+            if self.transport == "device" else None
+        )
 
     def bytes_per_page(self) -> int:
         ps = self.page_size
@@ -432,15 +707,27 @@ class CrossReplicaPageShipper:
                 f"ship of {len(src_pages)} pages into "
                 f"{len(dest_pages)} destination pages"
             )
+        if self._device is not None:
+            return self._device.ship(src_pages, dest_pages)
+        return self._ship_host(src_pages, dest_pages)
+
+    def _ship_host(self, src_pages: Sequence[int],
+                   dest_pages: Sequence[int]) -> int:
         ps = self.page_size
         treedef_k = jax.tree.structure(self.dst.k_pool)
         treedef_v = jax.tree.structure(self.dst.v_pool)
         off = 0
         nbytes = 0
+        budget = ship_staging_budget_bytes()
         for padded in _bucketize(len(src_pages)):
             failpoint("kv.ship")
             real = min(padded, len(src_pages) - off)
             sidx = _flat_slots(src_pages[off:off + real], ps, padded)
+            if budget and ship_staging_bytes() >= budget:
+                # staging over budget: let the outstanding scatters land
+                # (releasing their pinned host copies) before pinning
+                # another chunk — RSS bounded to budget + one chunk
+                jax.block_until_ready((self.dst.k_pool, self.dst.v_pool))
             k_rows, v_rows = _gather_rows(
                 self.src.k_pool, self.src.v_pool, jnp.asarray(sidx)
             )
@@ -449,16 +736,25 @@ class CrossReplicaPageShipper:
             # page below), then scatter device-side on the destination
             k_leaves = [np.asarray(a) for a in jax.tree.leaves(k_rows)]
             v_leaves = [np.asarray(a) for a in jax.tree.leaves(v_rows)]
-            frac = real / padded
-            nbytes += int(sum(
-                a.nbytes * frac for a in (*k_leaves, *v_leaves)
+            staged = int(sum(
+                a.nbytes for a in (*k_leaves, *v_leaves)
             ))
-            didx = _flat_slots(dest_pages[off:off + real], ps, padded)
-            self.dst.k_pool, self.dst.v_pool = _scatter_jit(
-                self.dst.k_pool, self.dst.v_pool, jnp.asarray(didx),
-                jax.tree.unflatten(treedef_k, k_leaves),
-                jax.tree.unflatten(treedef_v, v_leaves),
-            )
+            _ship_stage_add(staged)
+            try:
+                frac = real / padded
+                nbytes += int(sum(
+                    a.nbytes * frac for a in (*k_leaves, *v_leaves)
+                ))
+                didx = _flat_slots(dest_pages[off:off + real], ps, padded)
+                self.dst.k_pool, self.dst.v_pool = _scatter_jit(
+                    self.dst.k_pool, self.dst.v_pool, jnp.asarray(didx),
+                    jax.tree.unflatten(treedef_k, k_leaves),
+                    jax.tree.unflatten(treedef_v, v_leaves),
+                )
+            finally:
+                # the scatter dispatch has consumed the staged copies
+                # (jax holds its own references until the H2D lands)
+                _ship_stage_sub(staged)
             off += real
         return nbytes
 

@@ -85,6 +85,19 @@ logger = logging.getLogger("kafka_tpu.object_tier")
 
 ENV_OBJECT_DIR = "KAFKA_TPU_KV_OBJECT_DIR"
 ENV_OBJECT_MB = "KAFKA_TPU_KV_OBJECT_MB"
+# Wake-prefetch staging budget (MiB, ISSUE 19).  0/unset = prefetch OFF
+# (today's synchronous wake path, bit-identical).  When set, a sleep-
+# manifest hit at SUBMIT time starts the object GETs on a bounded
+# executor so the store RTT overlaps queue wait; prefix_cache.lookup
+# consumes the staged payloads at admission instead of fetching.
+ENV_WAKE_PREFETCH_MB = "KAFKA_TPU_WAKE_PREFETCH_MB"
+# Simple-vs-multipart PUT threshold for the S3-shaped HTTP backend
+# (MiB, ISSUE 19).  0/unset = simple puts only (today's behavior).
+# Payloads at or over the threshold upload as S3 multipart (initiate /
+# UploadPart / complete) with abort-on-failure, closing the multi-GB-run
+# gap — single-request puts of that size trip per-op deadlines and
+# buffer the whole payload in one socket write.
+ENV_OBJECT_MULTIPART_MB = "KAFKA_TPU_KV_OBJECT_MULTIPART_MB"
 # Folded into the content-address fingerprint: deployments sharing one
 # bucket across model revisions (weights change, config doesn't) bump this
 # to fence off incompatible KV.
@@ -120,6 +133,16 @@ def object_mb_from_env() -> int:
         return max(0, int(os.environ.get(ENV_OBJECT_MB, "0") or 0))
     except ValueError:
         return 0
+
+
+def object_multipart_bytes() -> int:
+    """Part size (bytes) above which HTTP puts switch to S3 multipart
+    uploads; 0 (the default) keeps every put a single request."""
+    try:
+        mb = max(0, int(os.environ.get(ENV_OBJECT_MULTIPART_MB, "0") or 0))
+    except ValueError:
+        mb = 0
+    return mb * MiB
 
 
 # ---------------------------------------------------------------------------
@@ -278,6 +301,11 @@ class HTTPObjectStore(ObjectStore):
         self._pool_size = int(pool_size)
         self._pool_lock = threading.Lock()
         self.torn_bodies = 0  # length-mismatched responses discarded
+        # S3 multipart threshold (ISSUE 19): bodies larger than this go
+        # initiate/part/complete instead of one monolithic PUT.  0 = off.
+        self.multipart_bytes = object_multipart_bytes()
+        self.multipart_puts = 0    # objects landed via multipart
+        self.multipart_aborts = 0  # failed uploads aborted server-side
         self._usage_cache: Tuple[float, Tuple[int, int]] = (0.0, (0, 0))
 
     # -- transport -----------------------------------------------------
@@ -347,12 +375,66 @@ class HTTPObjectStore(ObjectStore):
     # -- ObjectStore surface -------------------------------------------
 
     def put(self, key: str, data: bytes) -> None:
+        if self.multipart_bytes and len(data) > self.multipart_bytes:
+            self._put_multipart(key, data)
+            return
         status, _, _ = self._request(
             "PUT", self._key_path(key), body=data,
             headers={"Content-Type": "application/octet-stream"},
         )
         if status not in (200, 201, 204):
             raise OSError(f"PUT {key}: HTTP {status}")
+
+    def _put_multipart(self, key: str, data: bytes) -> None:
+        """S3 multipart upload: initiate, PUT parts of ``multipart_bytes``
+        each, complete.  Any failure aborts the upload server-side and
+        re-raises — S3 only materializes the object at Complete, so an
+        aborted upload leaves no partial object and the operation stays
+        idempotent under StoreGuard's retry (each attempt is a fresh
+        UploadId; the winner's Complete is the only visible write)."""
+        path = self._key_path(key)
+        status, _, body = self._request(
+            "POST", path + "?uploads",
+            headers={"Content-Type": "application/octet-stream"},
+        )
+        if status != 200:
+            raise OSError(f"multipart initiate {key}: HTTP {status}")
+        m = re.search(r"<UploadId>([^<]+)</UploadId>",
+                      body.decode("utf-8", "replace"))
+        if m is None:
+            raise OSError(f"multipart initiate {key}: no UploadId")
+        uid = quote(m.group(1), safe="")
+        try:
+            parts: List[Tuple[int, str]] = []
+            psize = self.multipart_bytes
+            for off in range(0, len(data), psize):
+                n = off // psize + 1
+                status, hdrs, _ = self._request(
+                    "PUT", f"{path}?partNumber={n}&uploadId={uid}",
+                    body=data[off:off + psize],
+                    headers={"Content-Type": "application/octet-stream"},
+                )
+                if status not in (200, 201, 204):
+                    raise OSError(f"multipart part {n} of {key}: HTTP {status}")
+                parts.append((n, hdrs.get("etag", "")))
+            xml = "<CompleteMultipartUpload>" + "".join(
+                f"<Part><PartNumber>{n}</PartNumber><ETag>{e}</ETag></Part>"
+                for n, e in parts
+            ) + "</CompleteMultipartUpload>"
+            status, _, _ = self._request(
+                "POST", f"{path}?uploadId={uid}", body=xml.encode(),
+                headers={"Content-Type": "application/xml"},
+            )
+            if status != 200:
+                raise OSError(f"multipart complete {key}: HTTP {status}")
+        except Exception:
+            self.multipart_aborts += 1
+            try:
+                self._request("DELETE", f"{path}?uploadId={uid}")
+            except Exception:
+                pass  # the abort is best-effort; orphaned uploads age out
+            raise
+        self.multipart_puts += 1
 
     def put_if_absent(self, key: str, data: bytes) -> bool:
         status, _, _ = self._request(
@@ -480,6 +562,283 @@ def _decode_run(data: bytes) -> Tuple[List[np.ndarray], List[np.ndarray], int]:
 
 
 # ---------------------------------------------------------------------------
+# wake prefetch (ISSUE 19)
+# ---------------------------------------------------------------------------
+
+
+class _StagedRun:
+    """One prefetched run: inflight until ``event`` sets, then staged
+    (payload present) or failed (payload None).  ``doomed`` marks a
+    cancelled thread's entries — the worker drops the payload instead of
+    staging it."""
+
+    __slots__ = ("thread_key", "event", "payload", "nbytes", "started",
+                 "doomed")
+
+    def __init__(self, thread_key: str):
+        self.thread_key = thread_key
+        self.event = threading.Event()
+        self.payload: Optional[Tuple[List[np.ndarray], List[np.ndarray],
+                                     int, int]] = None
+        self.nbytes = 0
+        self.started = False
+        self.doomed = False
+
+
+class WakePrefetcher:
+    """Start a sleeping thread's object GETs at SUBMIT time so the store
+    RTT overlaps queue wait (ISSUE 19) — the same overlap the host tier's
+    promotion gets from enqueueing H2D ahead of the suffix prefill.
+
+    Staging protocol: the router's manifest probe schedules one fetch
+    per PRESENT manifest run (single-flight per content key — a fan-out
+    of requests for one thread schedules each run once) on a bounded
+    executor; workers fetch through :meth:`ObjectTier.get_run`, so the
+    existing accounting, failpoints, and StoreGuard policy all apply
+    unchanged.  ``prefix_cache.lookup`` consumes staged payloads through
+    :meth:`ObjectTier.fetch_run`: a ready payload is a prefetch HIT
+    (zero fetch RTT inside admission), an inflight one is awaited (never
+    slower than fetching synchronously — the GET is already closer to
+    done), a queued-but-unstarted or missing one falls back to the
+    synchronous fetch.
+
+    Failure semantics: prefetch is an overlap optimization, never a
+    correctness dependency.  A failed or cancelled prefetch degrades to
+    the synchronous path; a dead store degrades at the scheduling gate
+    (breaker-aware: no fetches are even queued while
+    ``tier.available()`` is False).  Staged-but-never-consumed payloads
+    are evicted oldest-first past the byte budget and counted
+    ``prefetch_wasted``.
+    """
+
+    def __init__(self, tier: "ObjectTier", budget_bytes: int,
+                 workers: int = 4):
+        import concurrent.futures
+
+        self.tier = tier
+        self.budget_bytes = int(budget_bytes)
+        self._lock = threading.Lock()
+        self._staged: "OrderedDict[str, _StagedRun]" = OrderedDict()
+        self._staged_bytes = 0
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=workers, thread_name_prefix="kv-prefetch"
+        )
+        self._closed = False
+
+    @classmethod
+    def from_env(cls, tier: "ObjectTier") -> Optional["WakePrefetcher"]:
+        try:
+            mb = max(0, int(os.environ.get(ENV_WAKE_PREFETCH_MB, "0") or 0))
+        except ValueError:
+            mb = 0
+        if mb <= 0:
+            return None
+        return cls(tier, mb * MiB)
+
+    # -- scheduling (router submit path) -------------------------------
+
+    def prefetch_thread(self, thread_key: str, min_depth: int = 0) -> bool:
+        """Kick off prefetch for the thread's manifest without blocking the
+        caller (the router calls this on the submit path, so even the
+        manifest read — a store round trip when the head-sig memo is cold —
+        must happen off-thread).  ``min_depth`` is the replica's local radix
+        match: runs wholly covered by it are skipped, since a wake would
+        skip them too.  Returns whether scheduling was accepted."""
+        if self._closed or not self.tier.available():
+            return False  # breaker open: degrade to the synchronous path
+        try:
+            self._pool.submit(self._schedule, thread_key, min_depth)
+        except RuntimeError:  # executor shut down
+            return False
+        return True
+
+    def _schedule(self, thread_key: str, min_depth: int) -> None:
+        try:
+            man = self.tier.read_manifest(thread_key)
+            if man is None:
+                return
+            depth = self.tier._wakeable_depth(thread_key, man)
+            covered = 0
+            for r in man.get("runs") or []:
+                covered += int(r.get("tokens", 0))
+                if covered > depth:
+                    break  # absent past here: a wake would truncate anyway
+                if covered <= min_depth:
+                    continue  # locally cached: the wake skips these runs
+                key = r.get("key")
+                if key:
+                    self._begin(key, thread_key)
+        except Exception as e:
+            logger.warning("wake prefetch scheduling for %r failed: %s",
+                           thread_key, e)
+
+    def stage_runs(self, run_keys: Sequence[str], thread_key: str) -> None:
+        """Begin staging an imminent wake's full run list: the wake loop
+        consumes them in order while the GETs proceed in parallel on the
+        pool, so a multi-run wake pays ~one store RTT instead of one per
+        run.  Single-flight with any router-kicked prefetch of the same
+        content; entries the budget rejects simply fall back to the
+        caller's serial fetch."""
+        if self._closed:
+            return
+        for k in run_keys:
+            self._begin(k, thread_key)
+
+    def _begin(self, key: str, thread_key: str) -> bool:
+        with self._lock:
+            if key in self._staged:
+                return False  # single-flight per content key
+            if (self.budget_bytes
+                    and self._staged_bytes >= self.budget_bytes):
+                return False  # staging full: don't queue doomed work
+            ent = _StagedRun(thread_key)
+            self._staged[key] = ent
+        try:
+            self._pool.submit(self._fetch, key, ent)
+        except RuntimeError:  # executor shut down
+            with self._lock:
+                if self._staged.get(key) is ent:
+                    del self._staged[key]
+            return False
+        return True
+
+    # -- the worker ----------------------------------------------------
+
+    def _fetch(self, key: str, ent: _StagedRun) -> None:
+        with self._lock:
+            if self._staged.get(key) is not ent or ent.doomed:
+                # reclaimed/cancelled before the fetch started (take()
+                # dooms unstarted entries it hands to the sync path)
+                if self._staged.get(key) is ent:
+                    del self._staged[key]
+                ent.event.set()
+                return
+            ent.started = True
+        t0 = time.monotonic()
+        got = None
+        try:
+            failpoint("kv.prefetch")
+            got = self.tier.get_run(key)
+        except Exception as e:  # injected faults included: degrade
+            logger.warning("wake prefetch of run %s failed: %s", key, e)
+        nbytes = got[3] if got is not None else 0
+        with self._lock:
+            ent2 = self._staged.get(key)
+            if ent2 is not ent:
+                # superseded: take() reclaimed this entry for the sync
+                # path (or cancel dropped it) and a fresh fetch restaged
+                # the key — never touch the newer entry
+                if got is not None:
+                    self.tier.prefetch_wasted += 1
+            elif ent.doomed or got is None:
+                # cancelled mid-flight or failed: never staged
+                self._staged.pop(key, None)
+                if got is not None:
+                    self.tier.prefetch_wasted += 1
+            else:
+                ent.payload = got
+                ent.nbytes = nbytes
+                self._staged_bytes += nbytes
+                self.tier.prefetch_bytes += nbytes
+                self._evict_over_budget_locked()
+            ent.event.set()
+        record_span(
+            self.tier._ctx(), "kv.prefetch", time.monotonic() - t0,
+            attrs={"bytes": nbytes, "thread": ent.thread_key,
+                   "hit": got is not None and not ent.doomed},
+        )
+
+    def _evict_over_budget_locked(self) -> None:
+        """Oldest-staged-first eviction past the byte budget (callers
+        hold the lock).  Only READY payloads evict — an inflight entry
+        holds no bytes yet."""
+        if not self.budget_bytes:
+            return
+        for key in list(self._staged):
+            if self._staged_bytes <= self.budget_bytes:
+                return
+            ent = self._staged[key]
+            if ent.payload is None:
+                continue
+            del self._staged[key]
+            self._staged_bytes -= ent.nbytes
+            self.tier.prefetch_wasted += 1
+
+    # -- consumption (prefix_cache admission path) ---------------------
+
+    def take(
+        self, key: str
+    ) -> Optional[Tuple[List[np.ndarray], List[np.ndarray], int, int]]:
+        """Consume the staged payload for `key`, waiting out an inflight
+        fetch.  None = not prefetched (or failed/cancelled/unstarted):
+        the caller fetches synchronously, exactly today's path."""
+        with self._lock:
+            ent = self._staged.get(key)
+            if ent is None:
+                return None
+            if not ent.started and not ent.event.is_set():
+                # still queued behind other fetches: waiting could be
+                # SLOWER than fetching now — reclaim it for the sync path
+                ent.doomed = True
+                del self._staged[key]
+                return None
+        ent.event.wait()
+        with self._lock:
+            if self._staged.get(key) is not ent or ent.payload is None:
+                # failed, cancelled, or budget-evicted while we waited
+                if self._staged.get(key) is ent:
+                    del self._staged[key]
+                return None
+            del self._staged[key]
+            self._staged_bytes -= ent.nbytes
+        self.tier.prefetch_hits += 1
+        return ent.payload
+
+    # -- cancellation / introspection ----------------------------------
+
+    def cancel_thread(self, thread_key: str) -> None:
+        """Doom every entry staged for `thread_key` (request cancelled
+        before admission): ready payloads drop now and count wasted,
+        inflight fetches drop at completion."""
+        with self._lock:
+            for key in list(self._staged):
+                ent = self._staged[key]
+                if ent.thread_key != thread_key:
+                    continue
+                ent.doomed = True
+                if ent.payload is not None:
+                    del self._staged[key]
+                    self._staged_bytes -= ent.nbytes
+                    self.tier.prefetch_wasted += 1
+
+    def inflight(self) -> int:
+        """Fetches scheduled but not yet resolved (the gauge)."""
+        with self._lock:
+            return sum(
+                1 for e in self._staged.values() if not e.event.is_set()
+            )
+
+    def staged_bytes(self) -> int:
+        with self._lock:
+            return self._staged_bytes
+
+    def staged_bytes_for(self, thread_key: str) -> int:
+        """Ready staged bytes for one thread (the lane-table column)."""
+        with self._lock:
+            return sum(
+                e.nbytes for e in self._staged.values()
+                if e.thread_key == thread_key and e.payload is not None
+            )
+
+    def close(self) -> None:
+        self._closed = True
+        self._pool.shutdown(wait=False)
+        with self._lock:
+            self._staged.clear()
+            self._staged_bytes = 0
+
+
+# ---------------------------------------------------------------------------
 # the tier
 # ---------------------------------------------------------------------------
 
@@ -541,6 +900,13 @@ class ObjectTier:
         self.objects_released = 0
         self.probe_neg_cached = 0
         self.scrub_repairs = 0
+        # wake prefetch (ISSUE 19): attached by the engine when
+        # KAFKA_TPU_WAKE_PREFETCH_MB is set; counters stay zero (and
+        # fetch_run degenerates to get_run) without it
+        self.prefetcher: Optional[WakePrefetcher] = None
+        self.prefetch_hits = 0
+        self.prefetch_wasted = 0
+        self.prefetch_bytes = 0
         # opt-in background janitor (start_janitor)
         self._janitor: Optional[threading.Thread] = None
         self._janitor_stop = threading.Event()
@@ -741,6 +1107,19 @@ class ObjectTier:
                    "source": "object_tier"},
         )
         return k_leaves, v_leaves, n_pages, len(data)
+
+    def fetch_run(
+        self, key: str
+    ) -> Optional[Tuple[List[np.ndarray], List[np.ndarray], int, int]]:
+        """The wake path's fetch entry point: consume a staged prefetch
+        payload when one is ready (ISSUE 19), otherwise fetch exactly
+        like :meth:`get_run`.  Identical signature and failure shape."""
+        p = self.prefetcher
+        if p is not None:
+            got = p.take(key)
+            if got is not None:
+                return got
+        return self.get_run(key)
 
     def release(self, key: str) -> None:
         """Drop this owner's reference; delete the object when it was the
@@ -1008,6 +1387,14 @@ class ObjectTier:
             "store_breaker_state": g.breaker.state_gauge() if g else 0,
             "store_probe_neg_cached": self.probe_neg_cached,
             "store_scrub_repairs": self.scrub_repairs,
+            # wake-prefetch families (ISSUE 19): zeros when prefetch is
+            # off (no prefetcher attached)
+            "prefetch_hits": self.prefetch_hits,
+            "prefetch_wasted": self.prefetch_wasted,
+            "prefetch_bytes": self.prefetch_bytes,
+            "prefetch_inflight": (
+                self.prefetcher.inflight() if self.prefetcher else 0
+            ),
         }
 
     def scrub(self, grace_s: float = 3600.0, repair: bool = False) -> Dict[str, Any]:

@@ -478,9 +478,21 @@ class PrefixCache:
             return False
         nbytes = 0
         pos = 0
+        # fetch_run consumes payloads the wake prefetcher staged at
+        # submit time (ISSUE 19); without a prefetcher (or on a bare
+        # test tier predating it) it IS get_run
+        fetch = getattr(obj, "fetch_run", None) or obj.get_run
+        pre = getattr(obj, "prefetcher", None)
+        if pre is not None and len(wake) > 1:
+            # multi-run wake: stage every run NOW so the store GETs run
+            # in parallel on the prefetcher pool and the loop below
+            # consumes them in order — the wake pays ~one RTT instead of
+            # len(wake).  Single-flight with any router-kicked prefetch;
+            # a full staging budget degrades per-run to the serial fetch.
+            pre.stage_runs([rkey for _, rkey in wake], key)
         try:
             for n, rkey in wake:
-                got = obj.get_run(rkey)
+                got = fetch(rkey)
                 if got is None or got[2] != n:
                     # failed get of a PRESENT object (torn fetch, lost
                     # between head and get) or a payload whose span

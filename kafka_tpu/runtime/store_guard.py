@@ -203,9 +203,11 @@ class StoreGuard:
 
     # ---- deadline ----------------------------------------------------
 
-    def _with_deadline(self, fn: Callable, args: tuple) -> Any:
+    def _with_deadline(self, fn: Callable, args: tuple,
+                       deadline_scale: int = 1) -> Any:
         if self.timeout_s <= 0.0:
             return fn(*args)
+        timeout_s = self.timeout_s * max(1, int(deadline_scale))
         with self._executor_lock:
             # Abandoned calls pin workers until (if ever) the backend
             # unblocks — e.g. LocalFS on a hard NFS mount has no socket
@@ -230,19 +232,20 @@ class StoreGuard:
             ex = self._executor
         fut = ex.submit(fn, *args)
         try:
-            return fut.result(timeout=self.timeout_s)
+            return fut.result(timeout=timeout_s)
         except concurrent.futures.TimeoutError:
             fut.cancel()  # best effort; a stuck backend thread is abandoned
             with self._executor_lock:
                 if not fut.done() and ex is self._executor:
                     self._abandoned.append(fut)
             raise StoreTimeoutError(
-                f"object store op exceeded {self.timeout_s:.3f}s deadline"
+                f"object store op exceeded {timeout_s:.3f}s deadline"
             )
 
     # ---- core call path ----------------------------------------------
 
-    def _call(self, op: str, fn: Callable, *args: Any) -> Any:
+    def _call(self, op: str, fn: Callable, *args: Any,
+              deadline_scale: int = 1) -> Any:
         if not self.breaker.allow():
             raise StoreUnavailableError(f"object store breaker open ({op})")
         stats = self.op_stats.setdefault(op, [0, 0, 0.0])
@@ -250,7 +253,7 @@ class StoreGuard:
         err: Optional[BaseException] = None
         for attempt in range(self.retries + 1):
             try:
-                out = self._with_deadline(fn, args)
+                out = self._with_deadline(fn, args, deadline_scale)
             except StoreTimeoutError as e:
                 self.timeouts_total += 1
                 err = e
@@ -280,7 +283,20 @@ class StoreGuard:
     # ---- ObjectStore surface -----------------------------------------
 
     def put(self, key: str, data: bytes) -> None:
-        self._call("put", self.inner.put, key, data)
+        self._call("put", self.inner.put, key, data,
+                   deadline_scale=self._put_deadline_scale(len(data)))
+
+    @staticmethod
+    def _put_deadline_scale(nbytes: int) -> int:
+        """A multipart put is 1 + ceil(n/threshold) + 1 sequential requests
+        where a simple put is one; the per-op deadline must grow with the
+        request count or large archives time out by construction."""
+        from .object_tier import object_multipart_bytes
+
+        mp = object_multipart_bytes()
+        if not mp or nbytes <= mp:
+            return 1
+        return 1 + nbytes // mp
 
     def get(self, key: str) -> Optional[bytes]:
         return self._call("get", self.inner.get, key)

@@ -740,6 +740,30 @@ def render_prometheus(snap: Dict[str, Any]) -> str:
                      "manifests).")
             w.sample("kafka_tpu_object_store_scrub_repairs_total",
                      obj["store_scrub_repairs"])
+        # Wake-prefetch families (ISSUE 19): object GETs started at
+        # submit time so the store RTT overlaps queue wait.
+        if "prefetch_hits" in obj:
+            w.family("kafka_tpu_object_tier_prefetch_total", "counter",
+                     "Wake-prefetch outcomes: hit = staged payload "
+                     "consumed by admission (zero fetch RTT); wasted = "
+                     "staged/fetched but dropped (cancel, budget "
+                     "eviction, superseded).")
+            w.sample("kafka_tpu_object_tier_prefetch_total",
+                     obj["prefetch_hits"], {"outcome": "hit"})
+            if "prefetch_wasted" in obj:
+                w.sample("kafka_tpu_object_tier_prefetch_total",
+                         obj["prefetch_wasted"], {"outcome": "wasted"})
+        if "prefetch_bytes" in obj:
+            w.family("kafka_tpu_object_tier_prefetch_bytes_total",
+                     "counter",
+                     "Run payload bytes staged by wake prefetch.")
+            w.sample("kafka_tpu_object_tier_prefetch_bytes_total",
+                     obj["prefetch_bytes"])
+        if "prefetch_inflight" in obj:
+            w.family("kafka_tpu_object_tier_prefetch_inflight", "gauge",
+                     "Prefetch GETs scheduled but not yet resolved.")
+            w.sample("kafka_tpu_object_tier_prefetch_inflight",
+                     obj["prefetch_inflight"])
 
     # Disaggregated prefill/decode (runtime/metrics.DISAGG_METRIC_KEYS —
     # the registry a static test enforces in both files; present only
@@ -782,6 +806,26 @@ def render_prometheus(snap: Dict[str, Any]) -> str:
                      "degraded).")
             w.sample("kafka_tpu_disagg_handoffs_total",
                      disagg["disagg_handoffs"])
+        # Ship-transport dimension (ISSUE 19): which transport moved each
+        # run — host + device sum to shipped_runs — plus the host-staging
+        # high-water gauge (0 under the device transport).
+        w.family("kafka_tpu_disagg_ship_runs_by_transport_total",
+                 "counter",
+                 "Shipped runs by transport: host = staged through a "
+                 "numpy copy; device = device-to-device (zero host "
+                 "materialization).")
+        for key, transport in (("disagg_ship_host_runs", "host"),
+                               ("disagg_ship_device_runs", "device")):
+            if key in disagg:
+                w.sample("kafka_tpu_disagg_ship_runs_by_transport_total",
+                         disagg[key], {"transport": transport})
+        if "disagg_ship_staging_bytes" in disagg:
+            w.family("kafka_tpu_disagg_ship_staging_bytes", "gauge",
+                     "Peak host bytes pinned by host-staged ship chunks "
+                     "since the last scrape (peak-since-last, re-armed "
+                     "on read).")
+            w.sample("kafka_tpu_disagg_ship_staging_bytes",
+                     disagg["disagg_ship_staging_bytes"])
         if "ship_ms" in disagg:
             w.histogram_family(
                 "kafka_tpu_disagg_ship_milliseconds",

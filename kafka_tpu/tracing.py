@@ -105,6 +105,9 @@ SPANS = (
                       # pages, bytes (runtime/object_tier.py)
     "kv.object_get",  # run fetched from the shared object store during a
                       # thread wake; attrs: pages, bytes, source
+    "kv.prefetch",    # one run prefetched ahead of admission (wake
+                      # prefetch, ISSUE 19); attrs: bytes, thread, hit
+                      # (runtime/object_tier.WakePrefetcher)
     "thread.wake",    # dormant thread re-materialized from its sleep
                       # manifest; attrs: tokens, runs, bytes, source
                       # (runtime/prefix_cache.py)

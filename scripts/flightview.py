@@ -134,6 +134,8 @@ def print_lanes(lanes: List[Dict[str, Any]]) -> None:
         if ln.get("cached_tokens"):
             flags.append(f"cached:{ln['cached_tokens']}"
                          f"({ln.get('cache_source')})")
+        if ln.get("prefetch_staged_bytes"):
+            flags.append(f"prefetch:{ln['prefetch_staged_bytes']}B")
         print(
             f"  {ln.get('request_id', '?'):<28} {ln.get('state', '?'):<10} "
             f"{ln.get('slot', -1):>4} {ln.get('age_s') or 0:>7.2f} "

@@ -131,6 +131,10 @@ _HEAVY_TAIL = (
     # shapes (sleep on A / wake on B) — keep it with the tier tests on
     # the warm-cache side of test_engine
     "test_object_tier.py",
+    # zero-copy movement (ISSUE 19) reuses the shipper pool shapes and
+    # the object-tier fixtures — keep it with its neighbors on the
+    # warm-cache side (its jax work is gather/scatter compiles only)
+    "test_zero_copy.py",
     # store-guard fsck/outage acceptance builds the same engine shapes
     # (drain on A, scrub, wake on B) plus the bench store_outage smoke
     "test_store_guard.py",

@@ -11,6 +11,18 @@ faults so tier-1 exercises the network failure modes without a network:
     (a genuinely torn response);
   * ``latency_s = x``      — every request sleeps first (slow store).
 
+S3 multipart uploads (ISSUE 19) are implemented with the real control
+flow: ``POST /<key>?uploads`` initiates (XML UploadId), parts land via
+``PUT /<key>?partNumber=N&uploadId=U``, ``POST /<key>?uploadId=U``
+completes (parts concatenated in part order; all-but-last validated
+against ``min_part_size``, 400 EntityTooSmall otherwise), and
+``DELETE /<key>?uploadId=U`` aborts.  The object materializes ONLY at
+Complete — exactly S3's atomicity.  Part-level faults:
+
+  * ``fail_parts = N``      — the next N part PUTs answer 500;
+  * ``torn_part_next = N``  — the next N part PUTs send a torn response
+    (headers declare a body that never arrives, connection dropped).
+
 Usage::
 
     with StubS3Server() as srv:
@@ -18,8 +30,11 @@ Usage::
         ...
 """
 
+import hashlib
+import re
 import threading
 import time
+import uuid
 from email.utils import formatdate
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, unquote, urlsplit
@@ -57,6 +72,10 @@ class _Handler(BaseHTTPRequestHandler):
         key = self._key()
         length = int(self.headers.get("Content-Length", 0))
         body = self.rfile.read(length)
+        query = parse_qs(urlsplit(self.path).query)
+        if "partNumber" in query and "uploadId" in query:
+            self._put_part(key, body, query)
+            return
         if self._faulted():
             return
         srv = self.server
@@ -159,12 +178,130 @@ class _Handler(BaseHTTPRequestHandler):
     def do_DELETE(self):
         if self._faulted():
             return
+        query = parse_qs(urlsplit(self.path).query)
+        if "uploadId" in query:
+            uid = query["uploadId"][0]
+            with self.server.lock:
+                known = self.server.uploads.pop(uid, None)
+            # S3 answers 204 for a known upload, 404 for an unknown one
+            self.send_response(204 if known is not None else 404)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
         key = self._key()
         with self.server.lock:
             self.server.objects.pop(key, None)
         self.send_response(204)
         self.send_header("Content-Length", "0")
         self.end_headers()
+
+    # -- multipart uploads ---------------------------------------------
+
+    def do_POST(self):
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length)
+        if self._faulted():
+            return
+        key = self._key()
+        query = parse_qs(urlsplit(self.path).query, keep_blank_values=True)
+        srv = self.server
+        if "uploads" in query:  # initiate
+            uid = uuid.uuid4().hex
+            with srv.lock:
+                srv.uploads[uid] = (key, {})
+            self._xml(
+                "<?xml version='1.0'?><InitiateMultipartUploadResult>"
+                f"<Key>{key}</Key><UploadId>{uid}</UploadId>"
+                "</InitiateMultipartUploadResult>"
+            )
+            return
+        if "uploadId" in query:  # complete
+            uid = query["uploadId"][0]
+            want = [int(m) for m in re.findall(
+                r"<PartNumber>(\d+)</PartNumber>", body.decode("utf-8", "replace")
+            )]
+            with srv.lock:
+                hit = srv.uploads.get(uid)
+                if hit is None or hit[0] != key:
+                    self._error(404, "NoSuchUpload")
+                    return
+                parts = hit[1]
+                if not want or any(n not in parts for n in want):
+                    self._error(400, "InvalidPart")
+                    return
+                # real S3: every part except the last must meet the
+                # minimum part size, or Complete fails EntityTooSmall
+                if any(len(parts[n]) < srv.min_part_size
+                       for n in want[:-1]):
+                    self._error(400, "EntityTooSmall")
+                    return
+                srv.uploads.pop(uid)
+                srv.objects[key] = (
+                    b"".join(parts[n] for n in sorted(want)), time.time()
+                )
+                srv.completed_uploads += 1
+            self._xml(
+                "<?xml version='1.0'?><CompleteMultipartUploadResult>"
+                f"<Key>{key}</Key></CompleteMultipartUploadResult>"
+            )
+            return
+        self._error(400, "InvalidRequest")
+
+    def _put_part(self, key: str, body: bytes, query):
+        srv = self.server
+        with srv.lock:
+            if srv.latency_s:
+                time.sleep(srv.latency_s)
+            if srv.fail_parts > 0:
+                srv.fail_parts -= 1
+                self.send_response(500)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return
+            torn = srv.torn_part_next > 0
+            if torn:
+                srv.torn_part_next -= 1
+        if torn:
+            # declare a body that never arrives and drop the connection:
+            # the client's length check must reject this part attempt
+            self.send_response(200)
+            self.send_header("Content-Length", "10")
+            self.end_headers()
+            self.wfile.flush()
+            self.close_connection = True
+            try:
+                self.connection.close()
+            except OSError:
+                pass
+            return
+        uid = query["uploadId"][0]
+        n = int(query["partNumber"][0])
+        with srv.lock:
+            hit = srv.uploads.get(uid)
+            if hit is None or hit[0] != key or n < 1:
+                self._error(404, "NoSuchUpload")
+                return
+            hit[1][n] = body
+        self.send_response(200)
+        self.send_header("ETag", f'"{hashlib.md5(body).hexdigest()}"')
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def _xml(self, text: str):
+        body = text.encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/xml")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _error(self, status: int, code: str):
+        body = f"<?xml version='1.0'?><Error><Code>{code}</Code></Error>".encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/xml")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
 
     def _not_found(self, head: bool = False):
         self.send_response(404)
@@ -178,9 +315,14 @@ class StubS3Server(ThreadingHTTPServer):
     def __init__(self):
         super().__init__(("127.0.0.1", 0), _Handler)
         self.objects = {}  # key -> (bytes, mtime_epoch)
+        self.uploads = {}  # upload_id -> (key, {part_number: bytes})
         self.lock = threading.RLock()
         self.fail_requests = 0
         self.torn_next = 0
+        self.fail_parts = 0       # next N part PUTs answer 500
+        self.torn_part_next = 0   # next N part PUTs send a torn response
+        self.min_part_size = 0    # Complete's EntityTooSmall floor (real S3: 5 MiB)
+        self.completed_uploads = 0
         self.latency_s = 0.0
         self.max_keys = 1000  # S3's ListObjectsV2 page size; tests shrink it
         self._thread = threading.Thread(target=self.serve_forever,
