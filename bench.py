@@ -1113,6 +1113,256 @@ def sleep_wake_phase(cfg, params, n_threads: int = 4, common_len: int = 512,
     }
 
 
+def agent_gap_phase(cfg, params, n_agents: int = 3, agent_len: int = 448,
+                    gen_len: int = 8, churn_requests: int = 6,
+                    churn_len: int = 256, page_size: int = 8,
+                    tool_s: float = 0.05, tail_s: float = 0.15,
+                    seed: int = 61, object_dir=None) -> dict:
+    """Agent-native scheduling proof (ISSUE 20): N agent threads emit a
+    tool call and sit idle for the tool's (failpoint-injected) runtime
+    while interactive traffic churns through the same engine.  A/B over
+    the one knob that matters:
+
+      * OFF (``agent_demote=""``, the knobs-off baseline): the idle
+        threads' KV squats in HBM until the churn's allocation pressure
+        evicts it — and with the host tier's first rung missing
+        (``kv_host_tier_mb=0``, an HBM-heavy replica with no host
+        budget) eviction DROPS it, so every follow-up turn is a full
+        re-prefill.
+      * ON (``agent_demote="object"``): the linger expires mid-gap, the
+        chain archives to the object store and its pages free NOW
+        (measured as the pool's free-page delta); the return hint kicks
+        the wake prefetcher during the tool's tail, and the follow-up
+        wakes from the store — cache_source="object_tier", 0 coverable
+        prompt tokens recomputed.
+
+    Both arms serve identical token streams (same engine shape, same
+    prompts, greedy sampling), so outputs are asserted bit-identical —
+    the knob moves WHERE the KV waits, never WHAT the model says.  A
+    background-class rider (tool-result prefill) runs beside interactive
+    work on the ON arm to show the yield discipline's cost on
+    interactive TPOT.
+
+    Importable by the tier-1 CPU smoke (tests/test_agent_sched.py): the
+    gap-on < gap-off follow-up TTFT ordering holds by construction — a
+    prefetch-staged object wake vs a full-history re-prefill."""
+    import shutil
+    import tempfile
+
+    from kafka_tpu.failpoints import armed as fp_armed
+    from kafka_tpu.failpoints import failpoint as fp_fire
+    from kafka_tpu.runtime import EngineConfig, GenRequest, InferenceEngine
+
+    rng = random.Random(seed)
+    own_dir = object_dir is None
+    if own_dir:
+        object_dir = tempfile.mkdtemp(prefix="kafka-kv-agent-")
+    ps = page_size
+    win_pages = -(-max(agent_len + 2 * gen_len + 8,
+                       churn_len + 2 * gen_len) // ps) + 4
+    agent_pages = -(-(agent_len + gen_len) // ps)
+    # sized so the OFF arm's churn MUST evict the idle agents' KV: free
+    # HBM after turn 1 is smaller than one churn request's footprint
+    num_pages = n_agents * agent_pages + win_pages - 4
+
+    def mk(demote: str, store_dir):
+        ecfg = EngineConfig(
+            max_batch=2, page_size=ps, max_pages_per_seq=win_pages,
+            num_pages=num_pages,
+            prefill_buckets=(16, 64, 256, 512, 1024),
+            # park admission off: the ON arm's freed HBM would otherwise
+            # park churn off-slot (a path the OFF arm can't reach while
+            # page-blocked), compiling mid-measurement and skewing the A/B
+            max_parked=0,
+            kv_host_tier_mb=0, kv_object_dir=store_dir,
+            agent_demote=demote, agent_linger_s=0.0,
+        )
+        return InferenceEngine(cfg, params, ecfg)
+
+    prompts = [make_prompt(rng, agent_len, cfg.vocab_size)
+               for _ in range(n_agents)]
+    tool_results = [make_prompt(rng, 4, cfg.vocab_size)
+                    for _ in range(n_agents)]
+    churn = [make_prompt(rng, churn_len, cfg.vocab_size)
+             for _ in range(churn_requests)]
+    bg_prompts = [make_prompt(rng, churn_len, cfg.vocab_size)
+                  for _ in range(3)]
+
+    def warm_compiles(eng):
+        # buckets for turn 1 / churn (256) and the post-wake remainder
+        # (16), decode, and the tier's ship programs — compiled outside
+        # any measured span.  The two-lane CONCURRENT pass matters: the
+        # batched prefill/decode programs only compile with both lanes
+        # live, and only the gap-on arm (free HBM mid-gap) reaches them
+        # during the measured churn — a sequential warmup would hand the
+        # OFF arm an accidental compile-skew win.
+        for n in (agent_len, churn_len, 16):
+            eng.generate(make_prompt(rng, n, cfg.vocab_size),
+                         max_new_tokens=2)
+        pair = [GenRequest(request_id=f"warm-{k}",
+                           prompt_ids=make_prompt(rng, churn_len,
+                                                  cfg.vocab_size),
+                           max_new_tokens=4)
+                for k in range(2)]
+        for r in pair:
+            eng.submit(r)
+        eng.run_to_completion()
+        eng.warmup_kv_tier()
+
+    def step_serve(eng, reqs):
+        """Submit, drive, and timestamp every decoded token (client-side
+        TPOT truth — one decode token per request per step)."""
+        for r in reqs:
+            eng.submit(r)
+        seen = {r.request_id: 0 for r in reqs}
+        tok_times = {r.request_id: [] for r in reqs}
+        while eng.has_work:
+            eng.step()
+            now = time.monotonic()
+            for r in reqs:
+                if len(r.output_ids) > seen[r.request_id]:
+                    seen[r.request_id] = len(r.output_ids)
+                    tok_times[r.request_id].append(now)
+        return tok_times
+
+    def tok_gaps(tok_times, ids):
+        return [b - a for rid in ids for a, b in
+                zip(tok_times[rid], tok_times[rid][1:])]
+
+    def run_arm(demote: str, store_dir):
+        eng = mk(demote, store_dir)
+        warm_compiles(eng)
+        # ---- turn 1: the agent threads' working context ----------------
+        turn1 = []
+        for i, p in enumerate(prompts):
+            r = GenRequest(request_id=f"ag-{i}", prompt_ids=list(p),
+                           max_new_tokens=gen_len, prefix_key=f"ag-t{i}")
+            eng.submit(r)
+            eng.run_to_completion()
+            turn1.append(list(r.output_ids))
+        # ---- the gap: tool call emitted, linger expires ----------------
+        free0 = eng.pool.free_pages
+        for i in range(n_agents):
+            eng.note_tool_gap(f"ag-t{i}")
+        eng.step()  # linger 0: demotions fire on the next iteration
+        pages_freed = eng.pool.free_pages - free0
+        # ---- the tool runs (failpoint-injected latency) while
+        #      interactive traffic churns through the freed HBM ---------
+        with fp_armed("agent.tool", "delay", arg=tool_s):
+            for _ in range(n_agents):
+                fp_fire("agent.tool")
+        churn_reqs = [GenRequest(request_id=f"ch-{demote or 'off'}-{j}",
+                                 prompt_ids=list(c),
+                                 max_new_tokens=gen_len,
+                                 prefix_key=f"ch-t{j}")
+                      for j, c in enumerate(churn)]
+        churn_times = step_serve(eng, churn_reqs)
+        churn_gaps = tok_gaps(churn_times,
+                              [r.request_id for r in churn_reqs])
+        churn_ttft = [r.first_token_time - r.submit_time
+                      for r in churn_reqs]
+        # ---- tool returned: hint + prefetch overlap the tail -----------
+        for i in range(n_agents):
+            eng.note_tool_return(f"ag-t{i}")
+        time.sleep(tail_s)  # the tail the wake prefetch overlaps
+        # ---- follow-up turn: context + turn-1 output + tool result -----
+        follow = []
+        for i in range(n_agents):
+            p2 = prompts[i] + turn1[i] + tool_results[i]
+            r = GenRequest(request_id=f"fu-{i}", prompt_ids=p2,
+                           max_new_tokens=gen_len, prefix_key=f"ag-t{i}")
+            eng.submit(r)
+            eng.run_to_completion()
+            follow.append(r)
+        recomputed = 0
+        for i, r in enumerate(follow):
+            stored = agent_len + len(turn1[i]) - 1
+            coverable = min((stored // ps) * ps,
+                            ((len(r.prompt_ids) - 1) // ps) * ps)
+            recomputed += max(0, coverable - r.cached_tokens)
+        # ---- background rider: interactive TPOT beside a bg prefill ----
+        bg = GenRequest(request_id="bg-0", prompt_ids=list(bg_prompts[0]),
+                        max_new_tokens=gen_len, prefix_key="bg-t0",
+                        background=True)
+        fg = [GenRequest(request_id=f"fg-{j}",
+                         prompt_ids=list(bg_prompts[1 + j]),
+                         max_new_tokens=gen_len, prefix_key=f"fg-t{j}")
+              for j in range(2)]
+        bg_times = step_serve(eng, [bg] + fg)
+        fg_gaps = tok_gaps(bg_times, [r.request_id for r in fg])
+        return {
+            "eng": eng,
+            "turn1": turn1,
+            "follow": follow,
+            "pages_freed": pages_freed,
+            "churn_ttft": churn_ttft,
+            "churn_gaps": churn_gaps,
+            "churn_out": [list(r.output_ids) for r in churn_reqs],
+            "recomputed": recomputed,
+            "fg_gaps": fg_gaps,
+        }
+
+    on = run_arm("object", os.path.join(object_dir, "on"))
+    off = run_arm("", os.path.join(object_dir, "off"))
+
+    on_ttft = [round((r.first_token_time - r.submit_time) * 1e3, 2)
+               for r in on["follow"]]
+    off_ttft = [round((r.first_token_time - r.submit_time) * 1e3, 2)
+                for r in off["follow"]]
+    outputs_match = (
+        on["turn1"] == off["turn1"]
+        and on["churn_out"] == off["churn_out"]
+        and all(list(a.output_ids) == list(b.output_ids)
+                for a, b in zip(on["follow"], off["follow"]))
+    )
+    agent_snap = on["eng"].agent_section()
+    if own_dir:
+        shutil.rmtree(object_dir, ignore_errors=True)
+    return {
+        "n_agents": n_agents,
+        "tool_latency_s": tool_s,
+        "followup_ttft_ms": {"gap_on": on_ttft, "gap_off": off_ttft},
+        "followup_ttft_mean_ms": {
+            "gap_on": round(sum(on_ttft) / len(on_ttft), 2),
+            "gap_off": round(sum(off_ttft) / len(off_ttft), 2),
+        },
+        "speedup": round(
+            (sum(off_ttft) / len(off_ttft))
+            / (sum(on_ttft) / len(on_ttft)), 2)
+        if sum(on_ttft) else None,
+        "hbm_pages_freed_mid_gap": {"gap_on": on["pages_freed"],
+                                    "gap_off": off["pages_freed"]},
+        "cache_sources_on": [r.cache_source for r in on["follow"]],
+        "prompt_tokens_recomputed": {"gap_on": on["recomputed"],
+                                     "gap_off": off["recomputed"]},
+        "interactive_churn_ttft_ms": {
+            "gap_on": percentiles_ms(on["churn_ttft"]),
+            "gap_off": percentiles_ms(off["churn_ttft"]),
+        },
+        "interactive_churn_tpot_ms": {
+            "gap_on": percentiles_ms(on["churn_gaps"]),
+            "gap_off": percentiles_ms(off["churn_gaps"]),
+        },
+        "interactive_tpot_with_bg_ms": percentiles_ms(on["fg_gaps"]),
+        "bg": {"admitted": agent_snap["bg_admitted"],
+               "chunks": agent_snap["bg_chunks"],
+               "yields": agent_snap["bg_yields"]},
+        "agent": {k: agent_snap[k] for k in
+                  ("agent_gaps", "agent_gap_demotions",
+                   "agent_gap_pages_demoted", "agent_hint_hits",
+                   "agent_hint_misses")},
+        "outputs_match": outputs_match,
+        "note": ("N agent threads mid-tool-call under interactive churn, "
+                 "host tier's first rung missing (kv_host_tier_mb=0): "
+                 "gap-on archives to the object store at the linger and "
+                 "frees HBM mid-gap, the return hint prefetches during "
+                 "the tool tail, and the follow-up wakes "
+                 "(cache_source=object_tier, 0 coverable prompt tokens "
+                 "recomputed) vs gap-off's pressure-evicted full "
+                 "re-prefill; outputs bit-identical across arms"),
+    }
+
+
 def store_outage_phase(cfg, params, n_threads: int = 5,
                        common_len: int = 128, suffix_len: int = 16,
                        gen_len: int = 8, page_size: int = 8,
@@ -2546,7 +2796,8 @@ def main() -> None:
     ap.add_argument("scenario", nargs="?", default="all",
                     choices=("all", "speculative", "constrained", "kv_tier",
                              "sleep_wake", "store_outage", "disagg",
-                             "autoscale", "device_truth", "zero_copy"),
+                             "autoscale", "device_truth", "zero_copy",
+                             "agent_gap"),
                     help="'speculative' runs ONLY the speculative-decoding "
                          "A/B phase; 'constrained' runs ONLY the on-device "
                          "grammar FSM vs host-mask A/B; 'kv_tier' runs ONLY "
@@ -2754,6 +3005,33 @@ def main() -> None:
             f"outputs_match {out['outputs_match']}")
         print(json.dumps({
             "metric": f"sleep_wake_cross_host_resume_speedup_{cfg.name}",
+            "value": out["speedup"],
+            "unit": "x",
+            "extras": out,
+        }))
+        return
+
+    if args.scenario == "agent_gap":
+        # bench.py agent_gap: ONLY the agent tool-call-gap A/B
+        out = agent_gap_phase(
+            cfg, params,
+            n_agents=3,
+            agent_len=448 if args.quick else 960,
+            churn_requests=6 if args.quick else 8,
+            churn_len=256 if args.quick else 512,
+            page_size=8 if args.quick else 16,
+        )
+        log(f"agent_gap: follow-up TTFT gap-on "
+            f"{out['followup_ttft_mean_ms']['gap_on']}ms vs gap-off "
+            f"{out['followup_ttft_mean_ms']['gap_off']}ms "
+            f"({out['speedup']}x), "
+            f"{out['hbm_pages_freed_mid_gap']['gap_on']} HBM pages freed "
+            f"mid-gap, recomputed "
+            f"{out['prompt_tokens_recomputed']['gap_on']} (on) vs "
+            f"{out['prompt_tokens_recomputed']['gap_off']} (off) prompt "
+            f"tokens, outputs_match {out['outputs_match']}")
+        print(json.dumps({
+            "metric": f"agent_gap_followup_ttft_speedup_{cfg.name}",
             "value": out["speedup"],
             "unit": "x",
             "extras": out,
@@ -3038,6 +3316,22 @@ def main() -> None:
         f"{store_outage['ttft_p99_ms']['store_down']}ms vs baseline "
         f"{store_outage['ttft_p99_ms']['baseline_reprefill']}ms, "
         f"recovered wake {store_outage['recovered_cache_source']}")
+
+    # ---- agent_gap: tool-call-gap demote + wake prefetch (ISSUE 20) -----
+    agent_gap = agent_gap_phase(
+        cfg, params,
+        n_agents=3,
+        agent_len=448 if args.quick else 960,
+        churn_requests=6 if args.quick else 8,
+        churn_len=256 if args.quick else 512,
+        page_size=8 if args.quick else 16,
+    )
+    log(f"agent_gap: follow-up TTFT gap-on "
+        f"{agent_gap['followup_ttft_mean_ms']['gap_on']}ms vs gap-off "
+        f"{agent_gap['followup_ttft_mean_ms']['gap_off']}ms "
+        f"({agent_gap['speedup']}x), "
+        f"{agent_gap['hbm_pages_freed_mid_gap']['gap_on']} HBM pages "
+        f"freed mid-gap, outputs_match {agent_gap['outputs_match']}")
 
     # ---- disaggregated prefill/decode: colocated vs role pools ----------
     disagg = None
@@ -3342,6 +3636,7 @@ def main() -> None:
             "kv_tier": kv_tier,
             "sleep_wake": sleep_wake,
             "store_outage": store_outage,
+            "agent_gap": agent_gap,
             "disagg": disagg,
             "zero_copy": zero_copy,
             "autoscale": autoscale,

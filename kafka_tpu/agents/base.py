@@ -80,6 +80,7 @@ class Agent:
         max_iterations: int = MAX_ITERATIONS_DEFAULT,
         parallel_tools: bool = False,
         inject_idle_tool: bool = True,
+        background_tool_turns: bool = False,
     ):
         self.llm = llm_provider
         self.tools = tool_provider
@@ -89,6 +90,12 @@ class Agent:
         self.max_iterations = max_iterations
         self.parallel_tools = parallel_tools
         self.inject_idle_tool = inject_idle_tool
+        # ISSUE 20: turns that follow tool execution carry the tool
+        # RESULTS in their prompt — with this knob (and a provider that
+        # supports_background) their prefill rides the engine's
+        # background class, yielding to interactive work each scheduler
+        # iteration instead of convoying someone else's TTFT.
+        self.background_tool_turns = background_tool_turns
 
     # ------------------------------------------------------------------
 
@@ -163,12 +170,20 @@ class Agent:
         run_usage = Usage()
 
         iteration = 0
+        # set after a tool batch when background_tool_turns is on: the
+        # NEXT turn's prompt is dominated by tool results, so its
+        # prefill may ride the background class
+        next_turn_background = False
         while iteration < self.max_iterations:
             iteration += 1
             acc = ToolCallAccumulator()
             content_parts: List[str] = []
             streamed_any = False
             iter_kwargs = dict(llm_kwargs)
+            if next_turn_background and getattr(
+                self.llm, "supports_background", False
+            ):
+                iter_kwargs.setdefault("background", True)
             iter_tools = tool_defs
             if tool_choice == "none":
                 iter_tools = None  # OpenAI semantics: no tool use at all
@@ -263,6 +278,7 @@ class Agent:
             )
             exec_calls = [tc for tc in tool_calls if tc is not idle_call]
 
+            next_turn_background = False
             if exec_calls:
                 if self.parallel_tools and len(exec_calls) > 1:
                     event_iter = self._run_tools_parallel(exec_calls)
@@ -273,6 +289,17 @@ class Agent:
                         yield item
                     else:  # completed tool message to append
                         working.append(item.to_dict())
+                # The last tool's terminal event just landed — this IS
+                # the tool-gap's end, before the follow-up prompt is even
+                # composed.  Fire the thread's expected-return hint so a
+                # demote-in-linger cancels / a demoted thread's wake
+                # prefetch overlaps the message assembly (ISSUE 20; the
+                # TPU provider forwards to the engine, others lack the
+                # hook).
+                note = getattr(self.llm, "note_tool_return", None)
+                if note is not None:
+                    note(llm_kwargs.get("prefix_key"))
+                next_turn_background = self.background_tool_turns
 
             if idle_call is not None:
                 args = parse_tool_arguments(

@@ -104,6 +104,13 @@ SITES = (
     # is an overlap optimization, not a correctness dependency); `delay`
     # simulates a prefetch racing admission.
     "kv.prefetch",
+    # Tool execution (tools/provider.py run_tool_stream): fired once per
+    # tool call, before the tool runs.  `delay` injects tool latency —
+    # the agent-gap bench arms this to model a slow tool (the gap the
+    # agent-native scheduler exploits) without a sandbox round trip;
+    # `error` surfaces as a tool-error event, the shape a crashed tool
+    # produces, so the agent loop's error turn is reachable in tests.
+    "agent.tool",
     "worker.dispatch",
     "sandbox.exec",
     "sandbox.boot",

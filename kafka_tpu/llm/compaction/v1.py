@@ -235,6 +235,15 @@ class SummarizationCompactionProvider(ContextCompactionProvider):
     ) -> str:
         transcript = _render_transcript(messages)
         transcript = self._cap_transcript(transcript)
+        extra: Dict[str, Any] = {}
+        if getattr(self.llm, "supports_background", False):
+            # ISSUE 20: the summarization call is maintenance work on the
+            # serving engine — ride the background class so it never
+            # convoys an interactive request's TTFT (the output is
+            # byte-identical to a foreground run; only scheduling
+            # priority differs).  OpenAI-shaped providers would choke on
+            # the kwarg, hence the capability gate.
+            extra["background"] = True
         resp = await self.llm.completion(
             [
                 {"role": "system", "content": SUMMARY_SYSTEM_PROMPT},
@@ -246,6 +255,7 @@ class SummarizationCompactionProvider(ContextCompactionProvider):
             model=model,
             temperature=self.temperature,
             max_tokens=self.max_summary_tokens,
+            **extra,
         )
         content = resp.content or ""
         if not content.strip():

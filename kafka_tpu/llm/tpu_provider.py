@@ -113,6 +113,10 @@ class TPULLMProvider(LLMProvider):
     """Serves chat completions from the in-process TPU engine."""
 
     provider_name = "tpu"
+    # Agent-native scheduling (ISSUE 20): callers that own an agent loop
+    # feature-detect these before passing background=True or firing
+    # note_tool_return — OpenAI-shaped providers have neither.
+    supports_background = True
 
     def __init__(
         self,
@@ -203,6 +207,17 @@ class TPULLMProvider(LLMProvider):
     def max_prompt_tokens(self) -> int:
         """Largest admissible prompt (engine window, minus 1 for decode)."""
         return min(self.engine.ecfg.max_window, self.model_cfg.max_context) - 1
+
+    def note_tool_return(self, prefix_key: Optional[str]) -> None:
+        """The thread's tool finished: fire its expected-return hint.
+
+        Called by the agent loop (or the sandbox SSE terminal event) the
+        moment tool execution completes — BEFORE the follow-up turn is
+        even composed — so a demote-in-linger cancels and a demoted
+        thread's wake prefetch overlaps the tool's tail.  Engine-thread
+        op via the worker inbox; no-op with KAFKA_TPU_AGENT_DEMOTE
+        unset."""
+        self.worker.note_tool_return(prefix_key)
 
     # -- lifecycle hardening (server/app.py admission gate + drain) ------
 
@@ -339,6 +354,19 @@ class TPULLMProvider(LLMProvider):
           the device numbers, not the plan), ``pressure`` (headroom
           under the watermark — the degradation ladder's shed input),
           plus the per-replica rows.  Null before the first poll.
+        * ``agent`` (version 9, ISSUE 20): agent-native scheduling —
+          ``awaiting_threads`` / ``awaiting_bytes`` (threads mid
+          tool-call gap and the demoted KV bytes parked for them in
+          lower tiers), the expected-return hint hit/miss split, gap
+          demotion counters, and the background-class queue depth /
+          admit / chunk / yield counters.  CONTRACT NOTE for
+          controllers: awaiting-tool threads are NOT load — their KV
+          sits in host/disk/object tiers and they occupy no decode
+          slot, so they must not count toward queue depth or occupancy
+          when sizing the fleet (scale on ``queue`` and ``batch`` as
+          before; ``awaiting_threads`` only predicts FUTURE wake
+          traffic).  All zeros when KAFKA_TPU_AGENT_DEMOTE is unset
+          and no background-class work ran.
 
         Everything is read torn-tolerantly from the engine thread's
         single-writer metrics; no locks, safe at scrape frequency.
@@ -519,7 +547,34 @@ class TPULLMProvider(LLMProvider):
                 "pressure": max(r["hbm_pressure"] for r in mem_reps),
                 "replicas": mem_reps,
             }
+        # Agent-native scheduling (version 9, ISSUE 20).  awaiting_*
+        # describes threads parked mid-tool-gap: NOT load (no decode
+        # slot, KV in lower tiers) — a controller must exclude them
+        # from demand sizing and read them only as a wake-traffic
+        # forecast.  All zeros knobs-off.
+        ag = snap.get("agent") or {}
+        agent_section = {
+            "awaiting_threads": ag.get("agent_awaiting_threads", 0),
+            "awaiting_bytes": ag.get("agent_awaiting_bytes", 0),
+            "gaps": ag.get("agent_gaps", 0),
+            "gap_demotions": ag.get("agent_gap_demotions", 0),
+            "gap_pages_demoted": ag.get("agent_gap_pages_demoted", 0),
+            "gap_bytes_demoted": ag.get("agent_gap_bytes_demoted", 0),
+            "gap_cancelled": ag.get("agent_gap_cancelled", 0),
+            "hint_hits": ag.get("agent_hint_hits", 0),
+            "hint_misses": ag.get("agent_hint_misses", 0),
+            "bg_queue_depth": ag.get("bg_queue_depth", 0),
+            "bg_admitted": ag.get("bg_admitted", 0),
+            "bg_chunks": ag.get("bg_chunks", 0),
+            "bg_yields": ag.get("bg_yields", 0),
+        }
         return {
+            # version 9 (ISSUE 20): agent-native scheduling — the
+            # ``agent`` section (awaiting-tool threads + demoted bytes,
+            # expected-return hint hit/miss, gap-demotion counters,
+            # background-class queue/admit/chunk/yield).  Contract:
+            # awaiting-tool threads are NOT load — exclude them when
+            # sizing; they only forecast wake traffic.
             # version 8 (ISSUE 19): zero-host-copy movement — the
             # object_tier section gains ``prefetch`` (wake-prefetch
             # hits/wasted/bytes/inflight: zeros when
@@ -549,10 +604,11 @@ class TPULLMProvider(LLMProvider):
             # counters; version 2 (ISSUE 11) the anomalies section,
             # per-replica anomalies_active, and the
             # measured-utilization fields under utilization.*.
-            "version": 8,
+            "version": 9,
             "dp": len(replicas),
             "queue": dict(snap.get("queue") or {}),
             "anomalies": anomalies,
+            "agent": agent_section,
             "compiles": compiles_section,
             "memory": memory_section,
             "pools": pools,
@@ -895,6 +951,7 @@ class TPULLMProvider(LLMProvider):
         seed: Optional[int] = None,
         logits_mask_fn=None,
         prefix_key: Optional[str] = None,
+        background: bool = False,
         **kwargs: Any,
     ) -> AsyncIterator[StreamChunk]:
         self.validate_messages(messages)
@@ -979,6 +1036,10 @@ class TPULLMProvider(LLMProvider):
             logits_mask_fn=logits_mask_fn,
             grammar=grammar,
             prefix_key=prefix_key,
+            # background class (ISSUE 20): tool-result prefill and
+            # in-engine compaction ride idle capacity, yielding to
+            # interactive work at every scheduler iteration
+            background=background,
             override_pos=override_pos,
             override_rows=override_rows,
             # carry the ambient trace context across the thread boundary:
@@ -1058,6 +1119,12 @@ class TPULLMProvider(LLMProvider):
                         # not read as client-saved compute
                         cached_tokens=req.usage_cached_tokens or 0,
                     )
+                    if any(c.finish_reason == "tool_calls" for c in final):
+                        # the thread is about to leave for a tool call:
+                        # start the demote linger + expected-return hint
+                        # (engine-thread op via the inbox; no-op with
+                        # KAFKA_TPU_AGENT_DEMOTE unset)
+                        self.worker.note_tool_gap(req.prefix_key)
                     for chunk in final:
                         yield chunk
                     return

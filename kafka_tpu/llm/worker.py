@@ -125,6 +125,18 @@ class EngineWorker:
         """Abort a request from the serving side (client disconnect)."""
         self._inbox.put(("cancel", request_id))
 
+    def note_tool_gap(self, prefix_key: str) -> None:
+        """Agent-native scheduling (ISSUE 20): the provider saw a lane
+        finish with finish_reason=tool_calls — route the gap signal onto
+        the engine thread (single-writer: all gap state lives there)."""
+        self._inbox.put(("agent", ("gap", prefix_key)))
+
+    def note_tool_return(self, prefix_key: str) -> None:
+        """The thread's tool completed (sandbox SSE terminal): cancel a
+        lingering demote or kick the return-prefetch, on the engine
+        thread."""
+        self._inbox.put(("agent", ("return", prefix_key)))
+
     # -- engine thread -------------------------------------------------
 
     def _run(self) -> None:
@@ -248,6 +260,19 @@ class EngineWorker:
                         finish_reason=f"error:{e}",
                     )
                 )
+        elif kind == "agent":
+            # ("gap"|"return", prefix_key) — the engine may be a single
+            # InferenceEngine or a DataParallelEngines router (both
+            # implement the note_tool_* pair); getattr keeps the worker
+            # duck-typed against engine shims in tests
+            verb, key = payload  # type: ignore[misc]
+            fn = getattr(self.engine, f"note_tool_{verb}", None)
+            if fn is not None:
+                try:
+                    fn(key)
+                except Exception:  # an optimization must never kill steps
+                    logger.exception("agent %s signal failed for %r",
+                                     verb, key)
         elif kind == "cancel":
             rid: str = payload  # type: ignore[assignment]
             if self.engine.cancel(rid):

@@ -251,6 +251,14 @@ class DataParallelEngines:
             OrderedDict()
         )
         self._probe_memo_cap = 32
+        # Expected-return hints (ISSUE 20): prefix_key -> replica whose
+        # engine holds the thread's gap state.  Registered when a lane
+        # finishes into a tool-call gap, fired by the sandbox-completion
+        # return signal (note_tool_return), popped by the follow-up
+        # turn's submit.  LRU-capped like the affinity map — a hint for a
+        # thread that never returns must not leak.
+        self._expected_returns: "OrderedDict[str, int]" = OrderedDict()
+        self._expected_cap = 4096
         # which replica raised out of step(), so recovery targets it alone
         self._failed_replica: Optional[int] = None
         self._pre_failure_events: List[TokenEvent] = []
@@ -760,6 +768,10 @@ class DataParallelEngines:
 
     def submit(self, req: GenRequest) -> None:
         idx = self._pick(req)
+        if req.prefix_key is not None and self._expected_returns:
+            # the thread is back: its expected-return hint is consumed
+            # (the engine's own gap state pops inside engine.submit)
+            self._expected_returns.pop(req.prefix_key, None)
         if req.prefix_key is not None and not req.handoff:
             # kick BEFORE the engine sees the request: admission can run
             # the wake inline (off-slot prefix attach fires on submit),
@@ -793,6 +805,38 @@ class DataParallelEngines:
         pc = e.prefix_cache
         local = pc.match_tokens(req.prompt_ids) if pc is not None else 0
         pre.prefetch_thread(req.prefix_key, min_depth=local)
+
+    # -- agent tool-call gaps (ISSUE 20) --------------------------------
+
+    def note_tool_gap(self, prefix_key: Optional[str]) -> None:
+        """Register an expected-return hint for `prefix_key` and forward
+        the gap signal to its affinity replica's engine (where the
+        thread's KV lives — affinity was pinned at its last submit).
+        Runs on the worker's engine thread like submit/cancel."""
+        if not prefix_key:
+            return
+        idx = self._affinity.get(prefix_key)
+        if idx is None or idx >= len(self.engines):
+            return  # affinity evicted: nothing locatable to demote
+        self._expected_returns.pop(prefix_key, None)
+        self._expected_returns[prefix_key] = idx
+        while len(self._expected_returns) > self._expected_cap:
+            self._expected_returns.popitem(last=False)
+        self.engines[idx].note_tool_gap(prefix_key)
+
+    def note_tool_return(self, prefix_key: Optional[str]) -> None:
+        """Fire the expected-return hint: forward to the replica that
+        holds the thread's gap state so it can cancel a lingering demote
+        or kick its wake prefetcher — the follow-up turn's promotion /
+        object GETs overlap the tool's tail."""
+        if not prefix_key:
+            return
+        idx = self._expected_returns.pop(prefix_key, None)
+        if idx is None:
+            idx = self._affinity.get(prefix_key)
+        if idx is None or idx >= len(self.engines):
+            return
+        self.engines[idx].note_tool_return(prefix_key)
 
     def cancel(self, request_id: str, reason: str = "cancelled") -> bool:
         idx = self._route.pop(request_id, None)
@@ -1465,6 +1509,14 @@ class _AggregateMetrics:
         if flights:
             agg["flight"] = {
                 k: sum(f[k] for f in flights) for k in flights[0]
+            }
+        # Agent-native scheduling (ISSUE 20, AGENT_METRIC_KEYS): every
+        # key is per-replica (counters and queue/awaiting gauges alike),
+        # so the fleet view is a straight sum across replicas.
+        agents = [s["agent"] for s in snaps if "agent" in s]
+        if agents:
+            agg["agent"] = {
+                k: sum(a[k] for a in agents) for k in agents[0]
             }
         # Live HBM accounting (ISSUE 18, MEMORY_METRIC_KEYS): the fleet
         # view is worst-case — the plan is per-replica, so the tightest

@@ -124,6 +124,35 @@ WAITING, PREFILLING, PARKED, ACTIVE, DRAINING, FINISHED = (
 # multiple engine instances (tests, restarts) reuse compilations.
 _FN_CACHE: Dict[Tuple, Callable] = {}
 
+# Agent-native scheduling (ISSUE 20, README "Agent-native scheduling").
+AGENT_DEMOTE_ENV = "KAFKA_TPU_AGENT_DEMOTE"
+AGENT_LINGER_ENV = "KAFKA_TPU_AGENT_LINGER_MS"
+
+
+def agent_demote_default() -> str:
+    """KAFKA_TPU_AGENT_DEMOTE -> "" (off) | "host" | "object".  "1"/"on"
+    mean host — the tier ladder's first rung; "object" additionally
+    archives the gap-demoted chain + sleep manifest so the return hint's
+    wake prefetch works cross-replica.  Nonsense = off."""
+    raw = (os.environ.get(AGENT_DEMOTE_ENV) or "").strip().lower()
+    if raw in ("1", "on", "true", "host"):
+        return "host"
+    if raw == "object":
+        return "object"
+    return ""
+
+
+def agent_linger_default() -> float:
+    """KAFKA_TPU_AGENT_LINGER_MS -> seconds (default 250ms): how long a
+    tool-call gap lingers before the thread's KV demotes.  Sub-linger
+    tools (the common quick calls) never pay the round trip."""
+    raw = os.environ.get(AGENT_LINGER_ENV)
+    try:
+        ms = float(raw) if raw not in (None, "") else 250.0
+    except ValueError:
+        ms = 250.0
+    return max(0.0, ms) / 1e3
+
 
 @dataclasses.dataclass
 class EngineConfig:
@@ -252,6 +281,22 @@ class EngineConfig:
     # hook is one `if flight is not None` branch).  Default honors
     # KAFKA_TPU_FLIGHT_RING at construction time.
     flight_ring: int = dataclasses.field(default_factory=ring_default)
+    # Agent-native scheduling (ISSUE 20): a lane that finishes into a
+    # tool-call gap (the provider signals note_tool_gap on
+    # finish_reason=tool_calls) has its thread's KV proactively demoted
+    # down the tier ladder after agent_linger_s without a return — dead
+    # HBM freed mid-gap instead of waiting for eviction pressure.
+    # "" (default) disables: note_tool_gap/note_tool_return are no-ops
+    # and every scheduler path is byte-identical to before.  "host"
+    # demotes into the host/disk tier; "object" additionally archives
+    # the chain + sleep manifest (cross-replica return prefetch).
+    # Requires the prefix cache + KV tier; inert without them.
+    agent_demote: str = dataclasses.field(
+        default_factory=agent_demote_default
+    )
+    agent_linger_s: float = dataclasses.field(
+        default_factory=agent_linger_default
+    )
 
     @property
     def max_window(self) -> int:
@@ -394,6 +439,16 @@ class GenRequest:
     # drain) and the lane is masked out of every dispatch until it drains.
     spec: Optional[LaneSpeculator] = None
     spec_ahead: int = 0
+    # Background priority class (ISSUE 20): tool-result prefill and
+    # in-engine context-compaction summarization.  Background requests
+    # queue on engine.waiting_bg, admit only when no interactive request
+    # is waiting (and never into the page reserve), yield their prefill
+    # chunks to any interactive prefill, and are the FIRST preemption
+    # victims under page pressure.  They are exempt from the max_waiting
+    # admission bound (engine-internal work must not 429 the client that
+    # triggered it).  Nothing sets this by default — the False paths are
+    # byte-identical to before the class existed.
+    background: bool = False
     # SLO verdict (ISSUE 10): set at finalize by engine._finalize_slo —
     # True = met every configured target, False = missed, None = excluded
     # (client cancel) or not yet finalized.  The serving layer reads it
@@ -804,8 +859,30 @@ class InferenceEngine:
         B = self.ecfg.max_batch
         self.slots: List[Optional[GenRequest]] = [None] * B
         self.waiting: List[GenRequest] = []
+        # Background priority class (ISSUE 20): its own FIFO so interactive
+        # admission never has to scan past deferred background work.
+        self.waiting_bg: List[GenRequest] = []
         # off-slot lanes (state PREFILLING with slot -1, or PARKED), FIFO
         self.parked: List[GenRequest] = []
+        # Agent tool-call gaps (ISSUE 20): prefix_key -> monotonic due
+        # time (submit order == due order: the linger is constant).  A
+        # key past due demotes via prefix_cache.demote_thread; a return
+        # (note_tool_return) or a fresh submit of the thread cancels it.
+        self._agent_gaps: Dict[str, float] = {}
+        # prefix_key -> pages demoted mid-gap, awaiting the tool return
+        # (the "demoted-awaiting" gauge; cleared on return/resubmit)
+        self._awaiting_demoted: Dict[str, int] = {}
+        # AGENT_METRIC_KEYS counters (runtime/metrics.py registry)
+        self.agent_gaps = 0
+        self.agent_gap_demotions = 0
+        self.agent_gap_pages_demoted = 0
+        self.agent_gap_bytes_demoted = 0
+        self.agent_gap_cancelled = 0
+        self.agent_hint_hits = 0
+        self.agent_hint_misses = 0
+        self.bg_admitted = 0
+        self.bg_chunks = 0
+        self.bg_yields = 0
         # scheduler iterations left before off-slot admission may resume
         # after a page-pressure rollback (see _ensure_pages)
         self._park_cooldown = 0
@@ -868,6 +945,10 @@ class InferenceEngine:
         if self.ecfg.kv_object_mb < 0:
             raise ValueError(
                 "kv_object_mb must be >= 0 (0 = unbounded references)"
+            )
+        if self.ecfg.agent_demote not in ("", "host", "object"):
+            raise ValueError(
+                "agent_demote must be '' (off), 'host', or 'object'"
             )
         self.prefix_cache: Optional[PrefixCache] = (
             PrefixCache(self.pool, max_pages=self.ecfg.prefix_cache_pages)
@@ -1653,7 +1734,8 @@ class InferenceEngine:
         if len(req.prompt_ids) == 0:
             raise ValueError("empty prompt")
         if (
-            self.ecfg.max_waiting > 0
+            not req.background
+            and self.ecfg.max_waiting > 0
             and len(self.waiting) >= self.ecfg.max_waiting
         ):
             self.metrics.record_rejected()
@@ -1708,7 +1790,17 @@ class InferenceEngine:
         req.submit_time = time.monotonic()
         self.metrics.record_submit(len(req.prompt_ids))
         req.state = WAITING
-        self.waiting.append(req)
+        if req.prefix_key is not None and (
+            self._agent_gaps or self._awaiting_demoted
+        ):
+            # the thread is back (whether or not the return hint fired):
+            # a pending gap demote must not race the new turn's admission
+            self._agent_gaps.pop(req.prefix_key, None)
+            self._awaiting_demoted.pop(req.prefix_key, None)
+        if req.background:
+            self.waiting_bg.append(req)
+        else:
+            self.waiting.append(req)
         self._requests[req.request_id] = req
 
     def warmup_verify(self) -> None:
@@ -1838,8 +1930,9 @@ class InferenceEngine:
         quarantined/dead replica's queue onto healthy replicas, and
         topology rebuilds carry the queue across engine generations.
         Must run on the thread that drives step() (single-writer)."""
-        taken = list(self.waiting)
+        taken = list(self.waiting) + list(self.waiting_bg)
         self.waiting.clear()
+        self.waiting_bg.clear()
         for req in taken:
             if req.seq is not None:  # defensive: a waiting req owns no pages
                 self.pool.free_sequence(req.seq)
@@ -1856,8 +1949,12 @@ class InferenceEngine:
         bound (a migrated request losing its slot in line would turn a
         replica failure into client-visible rejections)."""
         req.state = WAITING
-        self.waiting.append(req)
-        self.waiting.sort(key=lambda r: r.submit_time)
+        if req.background:
+            self.waiting_bg.append(req)
+            self.waiting_bg.sort(key=lambda r: r.submit_time)
+        else:
+            self.waiting.append(req)
+            self.waiting.sort(key=lambda r: r.submit_time)
         self._requests[req.request_id] = req
 
     def cancel(self, request_id: str, reason: str = "cancelled") -> bool:
@@ -1875,7 +1972,8 @@ class InferenceEngine:
             return False
         if req.state == WAITING:
             try:
-                self.waiting.remove(req)
+                (self.waiting_bg if req.background
+                 else self.waiting).remove(req)
             except ValueError:
                 pass
         req.state = FINISHED
@@ -1885,6 +1983,128 @@ class InferenceEngine:
             self._release_slot(req)
         self._requests.pop(request_id, None)
         return True
+
+    # -- agent tool-call gaps (ISSUE 20) --------------------------------
+
+    def note_tool_gap(self, prefix_key: Optional[str]) -> None:
+        """The thread just finished a turn with finish_reason=tool_calls
+        and is now idle for the tool's runtime (the provider signals this
+        through the worker inbox, so it runs on the engine thread).
+        Start the linger clock: after agent_linger_s with no return, the
+        thread's KV demotes down the tier ladder.  No-op with the knob
+        off or without the cache+tier to demote into."""
+        if (
+            not prefix_key
+            or not self.ecfg.agent_demote
+            or self.prefix_cache is None
+            or self.kv_tier is None
+        ):
+            return
+        self.agent_gaps += 1
+        # re-noting an existing gap restarts its linger (dict order stays
+        # due order only if we re-insert)
+        self._agent_gaps.pop(prefix_key, None)
+        self._agent_gaps[prefix_key] = (
+            time.monotonic() + self.ecfg.agent_linger_s
+        )
+
+    def note_tool_return(self, prefix_key: Optional[str]) -> None:
+        """The tool finished (sandbox SSE terminal -> agent loop -> the
+        provider's return hint): the thread's follow-up turn is imminent.
+        Cancel a still-lingering demote (sub-linger tools never pay the
+        round trip), or — when the gap already demoted — protect the
+        thread's tier runs from second-chance eviction and kick the wake
+        prefetcher so promotion/object GETs overlap the tool's tail."""
+        if not prefix_key or not self.ecfg.agent_demote:
+            return
+        pending = self._agent_gaps.pop(prefix_key, None)
+        demoted = self._awaiting_demoted.pop(prefix_key, None)
+        if pending is not None:
+            self.agent_gap_cancelled += 1
+            self.agent_hint_hits += 1
+            return
+        if demoted is None:
+            self.agent_hint_misses += 1
+            return
+        self.agent_hint_hits += 1
+        pc = self.prefix_cache
+        if pc is None:
+            return
+        resident = pc.touch_thread(prefix_key)
+        tier = self.kv_tier
+        obj = getattr(tier, "object", None) if tier is not None else None
+        pre = getattr(obj, "prefetcher", None) if obj is not None else None
+        if pre is not None:
+            # object GETs for any runs NOT locally resident (a drained or
+            # rebuilt replica's threads) start now, overlapping the tail
+            pre.prefetch_thread(prefix_key, min_depth=resident)
+
+    def _process_agent_gaps(self) -> None:
+        """Demote threads whose tool-call linger expired (step() entry).
+        Insertion order == due order (constant linger), so the scan stops
+        at the first not-yet-due key."""
+        now = time.monotonic()
+        while self._agent_gaps:
+            key, due = next(iter(self._agent_gaps.items()))
+            if due > now:
+                break
+            del self._agent_gaps[key]
+            self._demote_gap_thread(key)
+
+    def _demote_gap_thread(self, key: str) -> None:
+        pc, tier = self.prefix_cache, self.kv_tier
+        if pc is None or tier is None:
+            return
+        stats = pc.demote_thread(
+            key, archive=(self.ecfg.agent_demote == "object")
+        )
+        pages = stats.get("pages", 0)
+        if pages:
+            self.agent_gap_demotions += 1
+            self.agent_gap_pages_demoted += pages
+            self.agent_gap_bytes_demoted += tier.bytes_for_pages(pages)
+            if self.flight is not None:
+                self.flight.note_cause("agent_demote")
+        # 0-page sweeps still register the awaiting state: the thread IS
+        # mid-gap (its KV may already be tier-resident from pressure)
+        self._awaiting_demoted[key] = (
+            self._awaiting_demoted.get(key, 0) + pages
+        )
+
+    def awaiting_tool_keys(self) -> List[str]:
+        """Threads currently mid-tool-call-gap (linger pending or
+        demoted-awaiting) — the flightview lane flag's source."""
+        return list(self._agent_gaps) + [
+            k for k in self._awaiting_demoted if k not in self._agent_gaps
+        ]
+
+    def agent_section(self) -> Dict[str, int]:
+        """AGENT_METRIC_KEYS snapshot section (runtime/metrics.py owns
+        the registry; /admin/signals v9 and /metrics both read this)."""
+        pages = sum(self._awaiting_demoted.values())
+        tier = self.kv_tier
+        return {
+            "agent_gaps": self.agent_gaps,
+            "agent_gap_demotions": self.agent_gap_demotions,
+            "agent_gap_pages_demoted": self.agent_gap_pages_demoted,
+            "agent_gap_bytes_demoted": self.agent_gap_bytes_demoted,
+            "agent_gap_cancelled": self.agent_gap_cancelled,
+            "agent_hint_hits": self.agent_hint_hits,
+            "agent_hint_misses": self.agent_hint_misses,
+            "agent_awaiting_threads": (
+                len(self._agent_gaps) + len([
+                    k for k in self._awaiting_demoted
+                    if k not in self._agent_gaps
+                ])
+            ),
+            "agent_awaiting_bytes": (
+                tier.bytes_for_pages(pages) if tier is not None else 0
+            ),
+            "bg_queue_depth": len(self.waiting_bg),
+            "bg_admitted": self.bg_admitted,
+            "bg_chunks": self.bg_chunks,
+            "bg_yields": self.bg_yields,
+        }
 
     def retry_after_estimate(self) -> float:
         """Seconds until queue relief is plausible, for 429 Retry-After.
@@ -1935,7 +2155,8 @@ class InferenceEngine:
         )
         if req.state == WAITING:
             try:
-                self.waiting.remove(req)
+                (self.waiting_bg if req.background
+                 else self.waiting).remove(req)
             except ValueError:
                 pass
         req.state = FINISHED
@@ -1960,8 +2181,12 @@ class InferenceEngine:
         return (
             self.num_active > 0
             or bool(self.waiting)
+            or bool(self.waiting_bg)
             or bool(self.parked)
             or bool(self._pending)
+            # a pending tool-call-gap linger needs step() to keep running
+            # on an otherwise-idle engine, or the demote never fires
+            or bool(self._agent_gaps)
         )
 
     def step(self) -> List[TokenEvent]:
@@ -1989,6 +2214,8 @@ class InferenceEngine:
         if self._park_cooldown > 0:
             self._park_cooldown -= 1
         self._check_deadlines()
+        if self._agent_gaps:
+            self._process_agent_gaps()
         self.metrics.record_queue_depth(len(self.waiting))
         self._drain(block=False)
         self._admit()
@@ -2135,6 +2362,24 @@ class InferenceEngine:
                 "pages": len(req.seq.pages) if req.seq is not None else 0,
                 "seq_len": req.seq.length if req.seq is not None else 0,
                 "finish_reason": req.finish_reason,
+                "background": req.background,
+            })
+        # Threads mid-tool-call gap (ISSUE 20) have NO registered request
+        # — the turn finished with tool_calls — but their state is what a
+        # postmortem reader needs to see: synthetic rows carry the linger
+        # / demoted-pages standing so "where did that thread's KV go?"
+        # is answerable from the dump alone.
+        for key in self.awaiting_tool_keys():
+            out.append({
+                "request_id": f"thread:{key[:40]}",
+                "state": "awaiting_tool",
+                "slot": -1,
+                "awaiting_tool": True,
+                "lingering": key in self._agent_gaps,
+                "demoted_pages": self._awaiting_demoted.get(key, 0),
+                "prefetch_staged_bytes": (
+                    pre.staged_bytes_for(key) if pre is not None else 0
+                ),
             })
         return out
 
@@ -2703,6 +2948,8 @@ class InferenceEngine:
             if self.flight is not None:
                 self.flight.note_cause("admit_parked")
         self._admit_offslot()
+        if self.waiting_bg:
+            self._admit_background()
 
     def _admit_waiting_head(self, slot: int) -> bool:
         """Try to start the waiting head's prefill in `slot`.
@@ -2799,6 +3046,48 @@ class InferenceEngine:
             if self.flight is not None:
                 self.flight.note_cause("park")
 
+    def _admit_background(self) -> None:
+        """Admit at most ONE background-class request per iteration, and
+        only into capacity nobody interactive wants: a free decode slot
+        with the interactive queue empty, pages outside the park reserve
+        (background prefill must never eat decode-growth headroom).
+        Tool-result prefill and compaction summarization ride this class
+        (ISSUE 20) — bulk work that should soak idle capacity, never
+        convoy a TTFT."""
+        if self.waiting:
+            return  # interactive demand owns admission
+        slot = self._free_slot()
+        if slot is None:
+            return
+        ecfg = self.ecfg
+        reserve = (
+            ecfg.park_reserve_pages
+            if ecfg.park_reserve_pages is not None
+            else 2 * ecfg.max_batch
+        )
+        req = self.waiting_bg[0]
+        self._attach_prefix(req)
+        needed = self._pages_needed(req)
+        if needed > self.pool.free_pages - reserve:
+            # cold radix cache is idle capacity too: reclaim it (the same
+            # eviction interactive admission would run) but keep the park
+            # reserve untouched — without this a cache-saturated engine
+            # starves its background queue forever even when fully idle
+            if not self._reclaim_cache(needed + reserve, req):
+                self._detach_prefix(req)
+                return
+        self.waiting_bg.pop(0)
+        try:
+            self._start_prefill(req, slot)
+        except OutOfPagesError:
+            self._detach_prefix(req)
+            req.state = WAITING
+            self.waiting_bg.insert(0, req)
+            return
+        self.bg_admitted += 1
+        if self.flight is not None:
+            self.flight.note_cause("bg_admit")
+
     def _start_prefill(self, req: GenRequest, slot: int) -> None:
         """Reserve pages + the batch slot; chunks run via _advance_prefill.
 
@@ -2874,6 +3163,14 @@ class InferenceEngine:
 
     def _prefill_bucket_for(self, req: GenRequest) -> int:
         remaining = len(req.prefill_ids) - req.seq.length
+        if req.background and any(
+            s is not None and s.state == ACTIVE and not s.background
+            for s in self.slots
+        ):
+            # background chunks shrink to the smallest bucket while any
+            # interactive lane is decoding: the added inter-token gap is
+            # bounded by one SMALL chunk's compute, not a 512-token one
+            return self.ecfg.prefill_buckets[0]
         return next(
             (b for b in self.ecfg.prefill_buckets if b >= remaining),
             self.ecfg.prefill_buckets[-1],
@@ -2899,6 +3196,25 @@ class InferenceEngine:
         ] + [r for r in self.parked if r.state == PREFILLING]
         if not prefilling:
             return
+        # Background class (ISSUE 20): background lanes yield their chunk
+        # to ANY interactive prefill this iteration — a tool-result dump
+        # or compaction prompt must never convoy an interactive TTFT.
+        # With no interactive prefill pending, at most ONE background
+        # lane advances one (decode-capped) chunk.
+        bg = [r for r in prefilling if r.background]
+        if bg:
+            interactive = [r for r in prefilling if not r.background]
+            if interactive:
+                prefilling = interactive
+                self.bg_yields += 1
+                if self.flight is not None:
+                    self.flight.note_cause("bg_yield")
+            else:
+                bg.sort(key=lambda r: r.submit_time)
+                prefilling = bg[:1]
+                self.bg_chunks += 1
+                if self.flight is not None:
+                    self.flight.note_cause("bg_prefill")
         W = min(4, self.ecfg.max_batch)
         if len(prefilling) > W:
             prefilling.sort(key=lambda r: r.submit_time)
@@ -4257,7 +4573,11 @@ class InferenceEngine:
         cands = [s for s in self.slots if s is not None]
         if len(cands) <= 1:
             return
-        self._preempt(max(cands, key=lambda r: r.submit_time))
+        # background lanes are the first victims: their whole contract is
+        # to soak idle capacity, never to hold pages an interactive lane
+        # needs (ISSUE 20)
+        bg = [r for r in cands if r.background]
+        self._preempt(max(bg or cands, key=lambda r: r.submit_time))
 
     def _preempt(self, victim: GenRequest) -> None:
         logger.warning("preempting %s (out of KV pages)", victim.request_id)
@@ -4291,4 +4611,7 @@ class InferenceEngine:
         victim.state = WAITING
         victim.resumed = bool(victim.output_ids)
         victim.prefill_allowed = None
-        self.waiting.insert(0, victim)
+        if victim.background:
+            self.waiting_bg.insert(0, victim)
+        else:
+            self.waiting.insert(0, victim)

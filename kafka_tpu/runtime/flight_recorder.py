@@ -108,6 +108,11 @@ CAUSES = (
     "overtight",      # over-tight constrained mask row
     "timeout",        # request deadline expired (finish_reason=timeout)
     "reject",         # admission rejection (waiting queue full, 429)
+    # agent-native scheduling (ISSUE 20)
+    "agent_demote",   # tool-gap linger expired: thread KV demoted
+    "bg_admit",       # background-class request admitted (idle capacity)
+    "bg_prefill",     # background lane advanced one prefill chunk
+    "bg_yield",       # background prefill yielded to interactive work
 )
 CAUSE_INDEX = {name: i for i, name in enumerate(CAUSES)}
 

@@ -62,6 +62,7 @@ from __future__ import annotations
 
 import email.utils
 import hashlib
+import hmac
 import http.client
 import json
 import logging
@@ -72,7 +73,7 @@ import time
 import uuid
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Sequence, Tuple
-from urllib.parse import quote, urlsplit
+from urllib.parse import quote, unquote, urlsplit
 
 import numpy as np
 
@@ -102,6 +103,17 @@ ENV_OBJECT_MULTIPART_MB = "KAFKA_TPU_KV_OBJECT_MULTIPART_MB"
 # bucket across model revisions (weights change, config doesn't) bump this
 # to fence off incompatible KV.
 ENV_OBJECT_NAMESPACE = "KAFKA_TPU_KV_OBJECT_NAMESPACE"
+# Real-bucket auth for the S3/GCS-shaped HTTP backend (ISSUE 20).
+# "sigv4" signs every request AWS-SigV4 style from AWS_ACCESS_KEY_ID /
+# AWS_SECRET_ACCESS_KEY (+ optional AWS_SESSION_TOKEN), region from
+# KAFKA_TPU_OBJECT_REGION or AWS_REGION (default us-east-1).  "bearer"
+# attaches ``Authorization: Bearer`` from KAFKA_TPU_OBJECT_BEARER_TOKEN
+# (GCS JSON/XML API with an OAuth access token).  Unset = no auth
+# (the in-cluster stub / pre-signed gateway case).  Missing credentials
+# for a selected mode fail LOUDLY at mount, not with per-request 403s.
+ENV_OBJECT_AUTH = "KAFKA_TPU_OBJECT_AUTH"
+ENV_OBJECT_REGION = "KAFKA_TPU_OBJECT_REGION"
+ENV_OBJECT_BEARER = "KAFKA_TPU_OBJECT_BEARER_TOKEN"
 
 MiB = 1024 * 1024
 
@@ -272,6 +284,114 @@ class _TornBodyError(OSError):
     """Response body did not match its declared Content-Length."""
 
 
+def _sigv4_headers(
+    method: str,
+    host: str,
+    path: str,
+    headers: Dict[str, str],
+    body: Optional[bytes],
+    access_key: str,
+    secret_key: str,
+    region: str,
+    session_token: str = "",
+    now: Optional[time.struct_time] = None,
+) -> Dict[str, str]:
+    """AWS Signature Version 4 for one S3 request (stdlib-only).
+
+    ``path`` is the request target as it goes on the wire (already
+    percent-encoded key path plus raw query).  S3's canonical URI is the
+    path VERBATIM (single-encoded — S3 is the one AWS service that does
+    not double-encode); the canonical query re-normalizes each
+    name/value through unquote->quote(safe="-_.~") so characters the
+    caller encoded loosely (e.g. '/' in a list prefix) land in the
+    canonical %2F form the service recomputes.  ``now`` pins the clock
+    for tests."""
+    amz_date = time.strftime("%Y%m%dT%H%M%SZ", now or time.gmtime())
+    datestamp = amz_date[:8]
+    payload_hash = hashlib.sha256(body or b"").hexdigest()
+    raw_path, _, raw_query = path.partition("?")
+    pairs = []
+    for item in raw_query.split("&") if raw_query else []:
+        name, _, value = item.partition("=")
+        pairs.append((quote(unquote(name), safe="-_.~"),
+                      quote(unquote(value), safe="-_.~")))
+    pairs.sort()
+    canonical_query = "&".join(f"{n}={v}" for n, v in pairs)
+    to_sign = {
+        "host": host,
+        "x-amz-content-sha256": payload_hash,
+        "x-amz-date": amz_date,
+    }
+    if session_token:
+        to_sign["x-amz-security-token"] = session_token
+    signed_names = ";".join(sorted(to_sign))
+    canonical_headers = "".join(
+        f"{k}:{to_sign[k]}\n" for k in sorted(to_sign)
+    )
+    canonical = "\n".join([
+        method, raw_path, canonical_query, canonical_headers,
+        signed_names, payload_hash,
+    ])
+    scope = f"{datestamp}/{region}/s3/aws4_request"
+    string_to_sign = "\n".join([
+        "AWS4-HMAC-SHA256", amz_date, scope,
+        hashlib.sha256(canonical.encode()).hexdigest(),
+    ])
+    key = ("AWS4" + secret_key).encode()
+    for part in (datestamp, region, "s3", "aws4_request"):
+        key = hmac.new(key, part.encode(), hashlib.sha256).digest()
+    signature = hmac.new(
+        key, string_to_sign.encode(), hashlib.sha256
+    ).hexdigest()
+    out = dict(headers)
+    # explicit Host: http.client must send EXACTLY the signed value
+    out["Host"] = host
+    out["x-amz-date"] = amz_date
+    out["x-amz-content-sha256"] = payload_hash
+    if session_token:
+        out["x-amz-security-token"] = session_token
+    out["Authorization"] = (
+        f"AWS4-HMAC-SHA256 Credential={access_key}/{scope}, "
+        f"SignedHeaders={signed_names}, Signature={signature}"
+    )
+    return out
+
+
+def _load_object_auth() -> Tuple[str, Dict[str, str]]:
+    """Resolve ENV_OBJECT_AUTH into (mode, credential kwargs)."""
+    mode = os.environ.get(ENV_OBJECT_AUTH, "").strip().lower()
+    if mode in ("", "none", "off"):
+        return "", {}
+    if mode == "sigv4":
+        access = os.environ.get("AWS_ACCESS_KEY_ID", "")
+        secret = os.environ.get("AWS_SECRET_ACCESS_KEY", "")
+        if not access or not secret:
+            raise ValueError(
+                f"{ENV_OBJECT_AUTH}=sigv4 needs AWS_ACCESS_KEY_ID and "
+                "AWS_SECRET_ACCESS_KEY in the environment"
+            )
+        return "sigv4", {
+            "access_key": access,
+            "secret_key": secret,
+            "region": (os.environ.get(ENV_OBJECT_REGION)
+                       or os.environ.get("AWS_REGION")
+                       or "us-east-1"),
+            "session_token": os.environ.get("AWS_SESSION_TOKEN", ""),
+        }
+    if mode == "bearer":
+        token = os.environ.get(ENV_OBJECT_BEARER, "")
+        if not token:
+            raise ValueError(
+                f"{ENV_OBJECT_AUTH}=bearer needs "
+                f"{ENV_OBJECT_BEARER} in the environment"
+            )
+        return "bearer", {"token": token}
+    raise ValueError(
+        f"{ENV_OBJECT_AUTH} must be 'sigv4', 'bearer', or unset; "
+        f"got {mode!r}"
+    )
+
+
 class HTTPObjectStore(ObjectStore):
     """S3-shaped HTTP backend: PUT/GET/HEAD/DELETE on ``<base>/<key>``
     plus ``GET <base>?list-type=2&prefix=`` XML listings, over a small
@@ -307,6 +427,10 @@ class HTTPObjectStore(ObjectStore):
         self.multipart_puts = 0    # objects landed via multipart
         self.multipart_aborts = 0  # failed uploads aborted server-side
         self._usage_cache: Tuple[float, Tuple[int, int]] = (0.0, (0, 0))
+        # real-bucket auth (ISSUE 20): resolved once at mount so a
+        # selected-but-unconfigured mode fails loudly here, not as a
+        # stream of per-request 403s under traffic
+        self._auth_mode, self._auth = _load_object_auth()
 
     # -- transport -----------------------------------------------------
 
@@ -325,10 +449,36 @@ class HTTPObjectStore(ObjectStore):
                 return
         conn.close()
 
+    def _auth_host(self) -> str:
+        """The Host header value as http.client would send it (port
+        elided when default) — what SigV4 must sign."""
+        default = 443 if self._https else 80
+        if self._port and self._port != default:
+            return f"{self._host}:{self._port}"
+        return self._host
+
+    def _authorize(
+        self, method: str, path: str, body: Optional[bytes],
+        headers: Optional[Dict[str, str]],
+    ) -> Dict[str, str]:
+        if self._auth_mode == "sigv4":
+            return _sigv4_headers(
+                method, self._auth_host(), path, headers or {}, body,
+                **self._auth,
+            )
+        if self._auth_mode == "bearer":
+            out = dict(headers or {})
+            out["Authorization"] = "Bearer " + self._auth["token"]
+            return out
+        return headers or {}
+
     def _request(
         self, method: str, path: str, body: Optional[bytes] = None,
         headers: Optional[Dict[str, str]] = None,
     ) -> Tuple[int, Dict[str, str], bytes]:
+        # sign once per logical request: the stale-connection replay
+        # below reuses the signature (well inside S3's clock-skew window)
+        headers = self._authorize(method, path, body, headers)
         for attempt in range(2):
             pooled = self._checkout()
             conn = pooled if pooled is not None else self._new_conn()

@@ -1010,6 +1010,138 @@ class PrefixCache:
         stats["breaker_state"] = obj.breaker_state()
         return stats
 
+    # -- agent tool-call gap (ISSUE 20) ----------------------------------
+
+    def touch_thread(self, key: str) -> int:
+        """Set the second-chance reference bit on every tier-resident run
+        of `key`'s stored path (the return hint fired: the follow-up turn
+        is imminent, so the thread's runs must survive host-tier LRU for
+        the next few seconds).  Returns the thread's locally-resident
+        token depth — the same figure thread_resident_tokens reports,
+        saved a second chain walk."""
+        resident = 0
+        for node in self._claimed_chain(key):
+            resident += len(node.tokens)
+            if node.host_run is not None and self.tier is not None:
+                self.tier.touch(node.host_run)
+        return resident
+
+    def thread_resident_tokens(self, key: str) -> int:
+        """Tokens of `key`'s stored path resident LOCALLY — device pages
+        or host/disk runs, either of which a wake serves without a store
+        round trip.  The return-triggered prefetch passes this as
+        ``min_depth``: object GETs only help beyond it."""
+        return sum(len(n.tokens) for n in self._claimed_chain(key))
+
+    def demote_thread(self, key: str, archive: bool = False) -> Dict[str, int]:
+        """Proactively demote thread `key`'s device-resident KV down the
+        tier ladder (the agent tool-call gap, ISSUE 20): the thread just
+        emitted a tool call and will sit idle for the tool's runtime, so
+        its pages serve nobody — free them NOW instead of waiting for
+        eviction pressure to find the leaf.
+
+        Walks the thread's deepest claimed chain leaf-ward and demotes
+        each exclusively-claimed node exactly like LRU eviction's demote
+        branch (node stays in the tree as a host run; content unchanged,
+        no generation bump — the follow-up turn's lookup still matches
+        and promotes).  Stops at the first SHARED node: claims form
+        root-anchored paths, so everything above it is shared too, and a
+        fan-out system prompt must stay hot for its sibling threads.  A
+        refused demote (tier budget, deferral ladder) stops the walk —
+        never drops: losing KV to save HBM would turn the follow-up turn
+        into a re-prefill, the exact cost this path exists to avoid.
+
+        With ``archive=True`` (KAFKA_TPU_AGENT_DEMOTE=object) the chain
+        is archived into the object store FIRST and the thread's sleep
+        manifest written — the same per-run protocol as
+        :meth:`sleep_to_object`, scoped to one thread — so the return
+        hint's wake prefetch works from ANY replica, not just this one.
+        A durable archive also upgrades the refusal rule: when the host
+        tier refuses a node (budget smaller than the run — the ladder's
+        first rung is missing), the node drops straight to the OBJECT
+        rung — removed from the tree, pages freed — because the store
+        now holds the bytes and the follow-up's lookup wakes the chain
+        back via the manifest.  Without a durable manifest a refusal
+        still stops the walk (never trade KV for HBM blindly)."""
+        stats = {"nodes": 0, "pages": 0, "dropped": 0}
+        if self.tier is None:
+            return stats
+        chain = self._claimed_chain(key)
+        has_obj = getattr(self.tier, "object", None) is not None
+        durable = False
+        if archive and has_obj and chain:
+            # archive BEFORE demoting: _materialize_node reads device
+            # pages or host runs, and a durable manifest licenses the
+            # direct-to-object drop below
+            stats["manifest"] = self._archive_thread_chain(key, chain)
+            durable = stats["manifest"] == 1
+        path_clear = True  # no on-path child left behind so far
+        for node in reversed(chain):  # leaf-ward: private before shared
+            if len(node.keys) > 1 or key not in node.keys:
+                break  # shared prefix: stays hot for sibling threads
+            if not node.pages:
+                path_clear = False  # tier-resident node stays in tree
+                continue
+            run = self.tier.demote(
+                node.pages,
+                path_runs=self._path_runs(node) if has_obj else None,
+                threads=list(node.keys) if has_obj else (),
+            )
+            if run is None:
+                # tier refused.  With the chain durably archived, drop to
+                # the object rung — but only a node whose children are
+                # all already gone (pure-path tail): removing a fan-out
+                # node would orphan live subtrees.
+                if durable and path_clear and not node.children:
+                    n = len(node.pages)
+                    self._remove(node)
+                    stats["dropped"] += 1
+                    stats["pages"] += n
+                    continue
+                break  # keep the remainder hot, never drop
+            n = len(node.pages)
+            self._release_pages(node.pages)
+            self._n_pages -= n
+            self._host_pages += n
+            self._host_nodes += 1
+            node.pages = []
+            node.host_run = run
+            self._leaves.pop(node, None)
+            path_clear = False  # node survives in the tree
+            stats["nodes"] += 1
+            stats["pages"] += n
+        return stats
+
+    def _archive_thread_chain(self, key: str, chain: List[_Node]) -> int:
+        """Archive one thread's chain + manifest (demote_thread's object
+        mode).  Returns 1 when the manifest landed, else 0."""
+        obj = self.tier.object
+        if not obj.available():
+            return 0
+        self.tier.drain(force=True)  # resolve in-flight demotes for peek
+        ps = self.pool.page_size
+        path: List[List[int]] = []
+        for node in chain:
+            path.append(list(node.tokens))
+            flat = [t for seg in path for t in seg]
+            if obj.has_run(obj.run_key(flat, node.n_pages(ps))):
+                ok = obj.put_run(flat, None, None,
+                                 node.n_pages(ps)) is not None
+            else:
+                payload = self._materialize_node(node)
+                ok = (payload is not None
+                      and obj.put_run(flat, payload[0], payload[1],
+                                      node.n_pages(ps)) is not None)
+            if not ok:
+                # a manifest naming an absent run would truncate every
+                # wake at the gap — better no manifest than a torn one
+                return 0
+        runs = [list(n.tokens) for n in chain]
+        tokens = [t for seg in runs for t in seg]
+        return 1 if obj.write_manifest(
+            key, tokens, obj.manifest_runs(runs)
+        ) else 0
+
     def invalidate(self, key: str) -> None:
         """Drop `key`'s claim; free only nodes no other thread claims.
 

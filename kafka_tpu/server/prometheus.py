@@ -995,6 +995,64 @@ def render_prometheus(snap: Dict[str, Any]) -> str:
                 w.sample("kafka_tpu_hbm_component_bytes", b,
                          {"component": comp_name})
 
+    # Agent-native scheduling (runtime/metrics.AGENT_METRIC_KEYS — the
+    # registry tests/test_agent_sched.py enforces in both files; all
+    # zeros unless KAFKA_TPU_AGENT_DEMOTE is set or background-class
+    # requests ran).  Event counters under one family; the awaiting /
+    # queue-depth gauges stand alone so the autoscaler contract
+    # ("awaiting-tool threads are not load") reads directly.
+    ag = snap.get("agent") or {}
+    if ag:
+        w.family("kafka_tpu_agent_events_total", "counter",
+                 "Agent tool-gap scheduling events by kind.")
+        for key, event in (
+            ("agent_gaps", "gap"),
+            ("agent_gap_demotions", "demote"),
+            ("agent_gap_cancelled", "cancel"),
+            ("agent_hint_hits", "hint_hit"),
+            ("agent_hint_misses", "hint_miss"),
+        ):
+            if key in ag:
+                w.sample("kafka_tpu_agent_events_total", ag[key],
+                         {"event": event})
+        if "agent_gap_pages_demoted" in ag:
+            w.family("kafka_tpu_agent_gap_pages_demoted_total", "counter",
+                     "KV pages freed from HBM by tool-gap demotions.")
+            w.sample("kafka_tpu_agent_gap_pages_demoted_total",
+                     ag["agent_gap_pages_demoted"])
+        if "agent_gap_bytes_demoted" in ag:
+            w.family("kafka_tpu_agent_gap_bytes_demoted_total", "counter",
+                     "KV bytes moved down-tier by tool-gap demotions.")
+            w.sample("kafka_tpu_agent_gap_bytes_demoted_total",
+                     ag["agent_gap_bytes_demoted"])
+        if "agent_awaiting_threads" in ag:
+            w.family("kafka_tpu_agent_awaiting_threads", "gauge",
+                     "Threads mid-tool-gap (lingering or demoted); not "
+                     "load — the autoscaler must not count them.")
+            w.sample("kafka_tpu_agent_awaiting_threads",
+                     ag["agent_awaiting_threads"])
+        if "agent_awaiting_bytes" in ag:
+            w.family("kafka_tpu_agent_awaiting_bytes", "gauge",
+                     "Demoted KV bytes parked in lower tiers awaiting "
+                     "a tool return.")
+            w.sample("kafka_tpu_agent_awaiting_bytes",
+                     ag["agent_awaiting_bytes"])
+        if "bg_queue_depth" in ag:
+            w.family("kafka_tpu_bg_queue_depth", "gauge",
+                     "Background-class requests queued (admit only "
+                     "into idle capacity).")
+            w.sample("kafka_tpu_bg_queue_depth", ag["bg_queue_depth"])
+        w.family("kafka_tpu_bg_events_total", "counter",
+                 "Background-class scheduling events by kind.")
+        for key, event in (
+            ("bg_admitted", "admit"),
+            ("bg_chunks", "chunk"),
+            ("bg_yields", "yield"),
+        ):
+            if key in ag:
+                w.sample("kafka_tpu_bg_events_total", ag[key],
+                         {"event": event})
+
     sandbox = snap.get("sandbox") or {}
     if sandbox:
         w.family("kafka_tpu_sandbox_total", "counter",

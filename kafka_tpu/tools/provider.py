@@ -18,6 +18,7 @@ import logging
 from typing import Any, AsyncIterator, Dict, List, Optional, Sequence
 
 from .. import tracing
+from ..failpoints import failpoint
 from .base import ToolProvider
 from .types import MCPServerConfig, Tool, ToolEvent, parse_tool_arguments
 
@@ -29,6 +30,7 @@ class AgentToolProvider(ToolProvider):
         self,
         tools: Optional[Sequence[Tool]] = None,
         mcp_servers: Optional[Sequence[MCPServerConfig]] = None,
+        on_tool_complete: Optional[Any] = None,
     ):
         self._tools: Dict[str, Tool] = {}
         for t in tools or []:
@@ -36,6 +38,12 @@ class AgentToolProvider(ToolProvider):
         self._mcp_configs = list(mcp_servers or [])
         self._mcp_connections: List[Any] = []  # MCPConnection, tools/mcp.py
         self._connected = False
+        # ISSUE 20: fired with (tool_name, tool_call_id) on each tool's
+        # terminal event — the sandbox SSE stream's completion — so a
+        # serving tier can kick the thread's expected-return hint (wake
+        # prefetch) without waiting for the agent loop to come around.
+        # Must never raise into the event stream.
+        self.on_tool_complete = on_tool_complete
 
     # -- registry ------------------------------------------------------
 
@@ -104,8 +112,21 @@ class AgentToolProvider(ToolProvider):
                 tool_name=name,
                 tool_call_id=tool_call_id,
             )
+            self._notify_complete(name, tool_call_id)
             return
         args = parse_tool_arguments(arguments)
+        # injected tool latency/faults (agent-gap benches arm
+        # `agent.tool=delay(...)` to model a slow tool without a real
+        # sandbox round trip)
+        try:
+            failpoint("agent.tool")
+        except Exception as e:
+            yield ToolEvent(
+                "error", f"tool fault injected: {e}",
+                tool_name=name, tool_call_id=tool_call_id,
+            )
+            self._notify_complete(name, tool_call_id)
+            return
         # one span per tool call; sandbox tools propagate the resulting
         # context over the wire so child spans recorded INSIDE the sandbox
         # subprocess stitch back under this one (sandbox/local.py)
@@ -118,3 +139,16 @@ class AgentToolProvider(ToolProvider):
                 if s is not None and ev.kind == "error":
                     s.attrs["error"] = True
                 yield ev
+        self._notify_complete(name, tool_call_id)
+
+    def _notify_complete(
+        self, name: str, tool_call_id: Optional[str]
+    ) -> None:
+        """Terminal-event listener dispatch: a hint, never a failure."""
+        cb = self.on_tool_complete
+        if cb is None:
+            return
+        try:
+            cb(name, tool_call_id)
+        except Exception:
+            logger.exception("on_tool_complete listener failed")

@@ -136,6 +136,15 @@ def print_lanes(lanes: List[Dict[str, Any]]) -> None:
                          f"({ln.get('cache_source')})")
         if ln.get("prefetch_staged_bytes"):
             flags.append(f"prefetch:{ln['prefetch_staged_bytes']}B")
+        if ln.get("background"):
+            flags.append("bg")
+        if ln.get("awaiting_tool"):
+            # mid-tool-call gap (ISSUE 20): lingering = demote timer
+            # still running; demoted = pages already moved down-tier
+            flags.append("await-tool" + ("(linger)" if ln.get("lingering")
+                                         else ""))
+            if ln.get("demoted_pages"):
+                flags.append(f"demoted:{ln['demoted_pages']}pg")
         print(
             f"  {ln.get('request_id', '?'):<28} {ln.get('state', '?'):<10} "
             f"{ln.get('slot', -1):>4} {ln.get('age_s') or 0:>7.2f} "

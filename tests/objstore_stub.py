@@ -31,13 +31,21 @@ Usage::
 """
 
 import hashlib
+import hmac
 import re
 import threading
 import time
 import uuid
 from email.utils import formatdate
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from urllib.parse import parse_qs, unquote, urlsplit
+from urllib.parse import parse_qs, quote, unquote, urlsplit
+
+# Authorization header shape HTTPObjectStore emits under sigv4 mode;
+# verified by INDEPENDENT recomputation from the wire data below.
+_SIGV4_RE = re.compile(
+    r"AWS4-HMAC-SHA256 Credential=([^/]+)/(\d{8})/([^/]+)/s3/"
+    r"aws4_request, SignedHeaders=([^,]+), Signature=([0-9a-f]{64})$"
+)
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -64,6 +72,77 @@ class _Handler(BaseHTTPRequestHandler):
     def _key(self) -> str:
         return unquote(urlsplit(self.path).path.lstrip("/"))
 
+    # -- auth verification (ISSUE 20) ----------------------------------
+
+    def _rejected(self, body_read: bool = True) -> bool:
+        """True = request failed auth and was answered 401/403.
+
+        ``auth_secret`` set: recompute SigV4 from the RAW request line,
+        headers, and the client's payload hash — a wrong canonicalization
+        anywhere (query sort, header set, key derivation) surfaces as
+        SignatureDoesNotMatch, exactly like real S3.  ``bearer_token``
+        set: require the exact Bearer header.  Headers are captured for
+        test assertions either way."""
+        srv = self.server
+        auth = self.headers.get("Authorization", "")
+        with srv.lock:
+            srv.captured_headers.append(
+                {k.lower(): v for k, v in self.headers.items()}
+            )
+            secret = srv.auth_secret
+            bearer = srv.bearer_token
+        if bearer is not None:
+            if auth != "Bearer " + bearer:
+                self._deny(401)
+                return True
+            return False
+        if secret is None:
+            return False
+        akid, sk = secret
+        m = _SIGV4_RE.match(auth)
+        if m is None or m.group(1) != akid:
+            self._deny(403)
+            return True
+        datestamp, region, signed_names = m.group(2), m.group(3), m.group(4)
+        raw_path, _, raw_query = self.path.partition("?")
+        pairs = []
+        for item in raw_query.split("&") if raw_query else []:
+            name, _, value = item.partition("=")
+            pairs.append((quote(unquote(name), safe="-_.~"),
+                          quote(unquote(value), safe="-_.~")))
+        pairs.sort()
+        canonical_query = "&".join(f"{n}={v}" for n, v in pairs)
+        names = signed_names.split(";")
+        canonical_headers = "".join(
+            f"{n}:{(self.headers.get(n) or '').strip()}\n" for n in names
+        )
+        payload = self.headers.get("x-amz-content-sha256", "")
+        canonical = "\n".join([
+            self.command, raw_path, canonical_query, canonical_headers,
+            signed_names, payload,
+        ])
+        string_to_sign = "\n".join([
+            "AWS4-HMAC-SHA256",
+            self.headers.get("x-amz-date", ""),
+            f"{datestamp}/{region}/s3/aws4_request",
+            hashlib.sha256(canonical.encode()).hexdigest(),
+        ])
+        key = ("AWS4" + sk).encode()
+        for part in (datestamp, region, "s3", "aws4_request"):
+            key = hmac.new(key, part.encode(), hashlib.sha256).digest()
+        want = hmac.new(
+            key, string_to_sign.encode(), hashlib.sha256
+        ).hexdigest()
+        if want != m.group(5):
+            self._deny(403)
+            return True
+        return False
+
+    def _deny(self, status: int) -> None:
+        self.send_response(status)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
     # -- verbs ---------------------------------------------------------
 
     def do_PUT(self):
@@ -72,6 +151,8 @@ class _Handler(BaseHTTPRequestHandler):
         key = self._key()
         length = int(self.headers.get("Content-Length", 0))
         body = self.rfile.read(length)
+        if self._rejected():
+            return
         query = parse_qs(urlsplit(self.path).query)
         if "partNumber" in query and "uploadId" in query:
             self._put_part(key, body, query)
@@ -92,6 +173,8 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
 
     def do_GET(self):
+        if self._rejected():
+            return
         if self._faulted():
             return
         parts = urlsplit(self.path)
@@ -161,6 +244,8 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def do_HEAD(self):
+        if self._rejected():
+            return
         if self._faulted():
             return
         key = self._key()
@@ -176,6 +261,8 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
 
     def do_DELETE(self):
+        if self._rejected():
+            return
         if self._faulted():
             return
         query = parse_qs(urlsplit(self.path).query)
@@ -200,6 +287,8 @@ class _Handler(BaseHTTPRequestHandler):
     def do_POST(self):
         length = int(self.headers.get("Content-Length", 0))
         body = self.rfile.read(length)
+        if self._rejected():
+            return
         if self._faulted():
             return
         key = self._key()
@@ -325,6 +414,13 @@ class StubS3Server(ThreadingHTTPServer):
         self.completed_uploads = 0
         self.latency_s = 0.0
         self.max_keys = 1000  # S3's ListObjectsV2 page size; tests shrink it
+        # auth verification (ISSUE 20): set auth_secret = (akid, secret)
+        # to require valid SigV4 on every request, bearer_token = "tok"
+        # to require the Bearer header; captured_headers records every
+        # request's (lowercased) headers for assertions
+        self.auth_secret = None
+        self.bearer_token = None
+        self.captured_headers = []
         self._thread = threading.Thread(target=self.serve_forever,
                                         daemon=True)
 

@@ -690,7 +690,7 @@ class TestRegistry:
                               kv_dtype=jnp.float32)
         shim = _shim(eng)
         snap = shim.signals()
-        assert snap["version"] == 8
+        assert snap["version"] == 9
         assert snap["autoscaler"] is None
         assert "window_1m_requests" in snap["slo"]
         ctl = AutoscalerController(shim, cfg_(mode="recommend"))

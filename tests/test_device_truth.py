@@ -678,7 +678,7 @@ class TestServerEndpoints:
         provider = self._provider(eng)
         try:
             sig = provider.signals()
-            assert sig["version"] == 8
+            assert sig["version"] == 9
             assert sig["compiles"]["compiles_total"] >= 1
             assert sig["compiles"]["storm_active"] is False
             mem = sig["memory"]
@@ -700,7 +700,7 @@ class TestServerEndpoints:
         provider = self._provider(eng)
         try:
             sig = provider.signals()
-            assert sig["version"] == 8
+            assert sig["version"] == 9
             assert sig["compiles"] is None
             assert sig["memory"] is None
         finally:

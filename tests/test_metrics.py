@@ -602,7 +602,7 @@ class TestMetricsEndpoint:
                 s = await client.get("/admin/signals")
                 assert s.status == 200
                 sig = await s.json()
-                assert sig["version"] == 8
+                assert sig["version"] == 9
                 assert sig["dp"] == 1
                 # version 4 (ISSUE 13): the autoscaler echo (null when
                 # KAFKA_TPU_AUTOSCALE is off — the default here) and

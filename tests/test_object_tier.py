@@ -845,7 +845,7 @@ class TestDrainEndpoint:
                 # store health (ISSUE 17)
                 s = await client.get("/admin/signals", headers=hdr)
                 sig = await s.json()
-                assert sig["version"] == 8
+                assert sig["version"] == 9
                 assert sig["object_tier"]["store_objects"] >= 1
                 assert "dedupe_ratio" in sig["object_tier"]
                 assert sig["object_tier"]["breaker_state"] == "closed"
